@@ -1,0 +1,168 @@
+"""Actor-side method server.
+
+Reference: the actor path of ``CoreWorker`` task receiving
+(``ActorTaskSubmitter`` peer; SURVEY.md §3.3): callers connect directly to
+the actor's worker, calls execute in arrival order (single-threaded by
+default; ``max_concurrency>1`` → thread pool; async methods run on a
+dedicated event loop), results go back on the caller connection (fast path)
+and are sealed with the GCS (authoritative path) so any process can get them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import protocol, rtlog
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.serialization import serialize_to_bytes
+from ray_tpu import exceptions as exc
+
+logger = rtlog.get("actor")
+
+
+class ActorExit(SystemExit):
+    """Raised by exit_actor() inside a method to terminate gracefully."""
+
+
+class ActorServer:
+    def __init__(self, worker, spec: dict, instance: Any):
+        self.worker = worker
+        self.spec = spec
+        self.instance = instance
+        self.actor_id = spec["actor_id"]
+        self.max_concurrency = int(spec.get("max_concurrency") or 1)
+        sock_name = f"a_{self.actor_id[:12]}_{os.getpid()}.sock"
+        self.addr = worker.session.socket_path(sock_name)
+        self._listener = protocol.make_listener(self.addr)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stopped = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        if any(inspect.iscoroutinefunction(getattr(type(instance), m, None))
+               for m in dir(type(instance))):
+            self._loop = asyncio.new_event_loop()
+            threading.Thread(target=self._loop.run_forever,
+                             name="actor-asyncio", daemon=True).start()
+        threading.Thread(target=self._accept_loop, name="actor-accept",
+                         daemon=True).start()
+
+    # ------------------------------------------------------------- transport
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            threading.Thread(target=self._conn_reader, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_reader(self, conn) -> None:
+        while not self._stopped.is_set():
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            self._queue.put((conn, msg))
+
+    def serve_forever(self) -> None:
+        if self.max_concurrency > 1:
+            threads = [threading.Thread(target=self._exec_loop, daemon=True)
+                       for _ in range(self.max_concurrency - 1)]
+            for t in threads:
+                t.start()
+        self._exec_loop()
+
+    def _exec_loop(self) -> None:
+        while not self._stopped.is_set():
+            item = self._queue.get()
+            if item is None:
+                return
+            conn, msg = item
+            try:
+                self._handle_call(conn, msg)
+            except ActorExit:
+                self._shutdown()
+                return
+            except Exception:  # noqa: BLE001
+                logger.exception("actor call handling failed")
+
+    # -------------------------------------------------------------- execution
+    def _run_method(self, method_name: str, args: list, kwargs: dict) -> Any:
+        if method_name == "__ray_terminate__":
+            raise ActorExit(0)
+        if method_name == "__ray_ready__":
+            return True
+        method = getattr(self.instance, method_name)
+        if inspect.iscoroutinefunction(method):
+            if self._loop is not None:
+                fut = asyncio.run_coroutine_threadsafe(
+                    method(*args, **kwargs), self._loop)
+                return fut.result()
+            return asyncio.run(method(*args, **kwargs))
+        return method(*args, **kwargs)
+
+    def _handle_call(self, conn, msg: dict) -> None:
+        return_ids: List[str] = msg["return_ids"]
+        num_returns = msg["num_returns"]
+        w = self.worker
+        try:
+            args, kwargs = w._unpack_args(msg)
+            value = self._run_method(msg["method"], args, kwargs)
+            results = w._store_results(return_ids, value, num_returns)
+            ok = True
+        except ActorExit:
+            err_res = {"loc": "error",
+                       "data": serialize_to_bytes(
+                           exc.RayActorError(self.actor_id, "actor exited"))[0]}
+            results = [err_res for _ in return_ids]
+            ok = False
+            self._seal_and_reply(conn, msg, results, ok)
+            raise
+        except Exception as e:  # noqa: BLE001
+            err = exc.RayTaskError.from_exception(
+                f"{self.spec.get('class_name', 'Actor')}.{msg['method']}", e)
+            err_res = {"loc": "error", "data": serialize_to_bytes(err)[0]}
+            results = [err_res for _ in return_ids]
+            ok = False
+        self._seal_and_reply(conn, msg, results, ok)
+
+    def _seal_and_reply(self, conn, msg: dict, results: List[dict], ok: bool) -> None:
+        w = self.worker
+        # authoritative: seal with GCS (one-way on the worker's task channel)
+        w._send_event({"kind": "actor_result", "return_ids": msg["return_ids"],
+                       "results": results})
+        # release the caller's in-flight arg pins
+        if msg.get("arg_ledger"):
+            w.rpc_oneway("release_all", ledger=msg["arg_ledger"])
+        # fast path: inline values straight back to the caller (errors go via
+        # the GCS so the caller's local cache never masks a raise)
+        inline = [r.get("data") if r["loc"] == "inline" else None
+                  for r in results]
+        try:
+            conn.send({"call_id": msg["call_id"], "return_ids": msg["return_ids"],
+                       "inline_results": inline, "ok": ok})
+        except (OSError, ValueError):
+            pass  # caller went away; results are in the GCS regardless
+
+    def _shutdown(self) -> None:
+        # tell the control plane this exit is intentional → no restart
+        self.worker._send_event({"kind": "actor_exit", "actor_id": self.actor_id})
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        # unblock sibling exec threads
+        for _ in range(self.max_concurrency):
+            self._queue.put(None)
+
+
+def exit_actor() -> None:
+    """Terminate the current actor gracefully (reference: ray.actor.exit_actor)."""
+    raise ActorExit(0)

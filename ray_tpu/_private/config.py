@@ -1,0 +1,137 @@
+"""Runtime flag registry for ray_tpu.
+
+TPU-native analog of the reference's ``RayConfig`` (reference:
+``src/ray/common/ray_config_def.h`` — one macro per flag, env-overridable via
+``RAY_<NAME>``; see SURVEY.md §5.6).  Here every flag is declared once in
+``_FLAG_DEFS`` and is overridable via the environment variable
+``RTPU_<NAME>`` (uppercased).  ``ray_tpu.init(_system_config={...})`` merges a
+dict on top, mirroring the reference's ``_system_config`` JSON passthrough.
+
+Design difference from the reference: there is no separate native flag
+registry — the C++ components read their few knobs through their ctypes init
+call, so this single Python registry is the source of truth for both worlds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+_ENV_PREFIX = "RTPU_"
+
+
+@dataclass(frozen=True)
+class _FlagDef:
+    name: str
+    default: Any
+    type: Callable[[str], Any]
+    doc: str
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _flag(name: str, default: Any, doc: str) -> _FlagDef:
+    if isinstance(default, bool):
+        typ: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        typ = int
+    elif isinstance(default, float):
+        typ = float
+    else:
+        typ = str
+    return _FlagDef(name, default, typ, doc)
+
+
+# One entry per runtime knob.  Keep alphabetized within section.
+_FLAG_DEFS = [
+    # --- session / logging ---------------------------------------------------
+    _flag("log_level", "INFO", "Root log level for ray_tpu processes."),
+    _flag("log_to_driver", True, "Ship worker stdout/stderr lines to the driver."),
+    # NOT /tmp/ray_tpu: a directory named exactly like the package would
+    # shadow the import for any process with cwd=/tmp.
+    _flag("session_dir_root", "/tmp/rtpu_sessions", "Root for session_* directories."),
+    # --- object store --------------------------------------------------------
+    _flag("object_store_memory_mb", 2048, "Shared-memory object store capacity."),
+    _flag("inline_object_max_bytes", 100 * 1024,
+          "Objects <= this are inlined in the control plane (in-memory store) "
+          "instead of shared memory (reference: core worker memory store)."),
+    _flag("object_spill_dir", "", "Directory for spilled objects ('' = <session>/spill)."),
+    _flag("object_store_eviction", True, "LRU-evict sealed unreferenced objects to disk when full."),
+    _flag("use_native_store", True, "Use the C++ shm store if the extension builds."),
+    # --- scheduler / workers -------------------------------------------------
+    _flag("num_workers_per_node", 0, "Size of worker pool (0 = num_cpus)."),
+    _flag("worker_register_timeout_s", 30.0, "Timeout for a spawned worker to register."),
+    _flag("worker_lease_cache", True, "Reuse leased idle workers for same-shape tasks."),
+    _flag("scheduler_spread_threshold", 0.5,
+          "Hybrid policy: prefer local until local load exceeds this fraction."),
+    _flag("health_check_period_s", 1.0, "Control-plane node health check period."),
+    _flag("health_check_timeout_s", 10.0, "Node declared dead after this long w/o heartbeat."),
+    # --- tasks / actors ------------------------------------------------------
+    _flag("task_default_max_retries", 3, "Default max_retries for tasks (-1 = infinite)."),
+    _flag("actor_default_max_restarts", 0, "Default max_restarts for actors."),
+    # --- collectives / TPU ---------------------------------------------------
+    _flag("collective_chunk_bytes", 4 * 1024 * 1024,
+          "Chunk size for DCN object-plane fallback collectives."),
+    _flag("tpu_topology", "", "Override detected TPU topology (e.g. 'v4-8')."),
+    # --- metrics / tracing ---------------------------------------------------
+    _flag("metrics_export_period_s", 5.0, "Metrics agent export period."),
+    _flag("timeline_enabled", True, "Record profile events for `ray_tpu timeline`."),
+]
+
+_DEFS: Dict[str, _FlagDef] = {d.name: d for d in _FLAG_DEFS}
+
+
+class RayTpuConfig:
+    """Resolved config: defaults < env (RTPU_*) < _system_config dict."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._overrides: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        d = _DEFS.get(name)
+        if d is None:
+            raise AttributeError(f"unknown ray_tpu config flag: {name!r}")
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        if env is not None:
+            return d.type(env)
+        return d.default
+
+    def apply_system_config(self, system_config: Optional[Dict[str, Any]]) -> None:
+        if not system_config:
+            return
+        with self._lock:
+            for k, v in system_config.items():
+                if k not in _DEFS:
+                    raise ValueError(f"unknown _system_config key: {k!r}")
+                self._overrides[k] = v
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Full resolved view (for propagation to child processes / debugging)."""
+        return {name: getattr(self, name) for name in _DEFS}
+
+    def to_env(self) -> Dict[str, str]:
+        """Encode the resolved config as RTPU_* env vars for child processes."""
+        out = {}
+        for name, val in self.snapshot().items():
+            out[_ENV_PREFIX + name.upper()] = (
+                json.dumps(val) if isinstance(val, bool) else str(val)
+            )
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._overrides.clear()
+
+
+GLOBAL_CONFIG = RayTpuConfig()

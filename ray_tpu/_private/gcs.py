@@ -1,0 +1,1341 @@
+"""GCS: the cluster control plane.
+
+Reference: ``src/ray/gcs/gcs_server/`` + the raylet's ``ClusterTaskManager``
+(SURVEY.md §2.1, §3).  One GCS per cluster, owning:
+
+- node table + health (``GcsNodeManager`` analog),
+- the object directory + centralized refcounting (deviation from the
+  reference's owner-based protocol, documented in DESIGN.md — owner ids are
+  embedded in ObjectIDs so a later migration to owner-based counting does not
+  change the API),
+- task scheduling: hybrid/spread/affinity policies + worker-pool management
+  (the reference splits this between GCS and per-node raylets; on one host a
+  single scheduler with per-"node" logical resource views is equivalent and
+  is how the reference's own ``cluster_utils.Cluster`` tests behave),
+- actor lifecycle FSM (``GcsActorManager``: PENDING→ALIVE→RESTARTING→DEAD),
+- placement groups with PACK/SPREAD/STRICT_* and TPU-topology bundles
+  (``GcsPlacementGroupManager``),
+- function/class table, KV store, named actors, job table,
+- lineage for object reconstruction (reference keeps lineage at owners'
+  ``TaskManager``; centralized here).
+
+Threading model: listener accept loop + one handler thread per connection +
+a worker-process monitor thread; all state under one lock with a single
+condition variable (every state change notifies; waiters recheck predicates).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import protocol, rtlog
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.serialization import dumps_call
+from ray_tpu._private.session import Session
+from ray_tpu._private.shm_store import ShmObjectStore
+from ray_tpu import exceptions as exc
+
+logger = rtlog.get("gcs")
+
+# object meta states
+PENDING, READY, ERROR = "pending", "ready", "error"
+# actor states (reference FSM)
+A_PENDING, A_ALIVE, A_RESTARTING, A_DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+class NodeState:
+    def __init__(self, node_id: str, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None):
+        self.node_id = node_id
+        self.resources_total = dict(resources)
+        self.resources_avail = dict(resources)
+        self.labels = labels or {}
+        self.alive = True
+        self.workers: Set[str] = set()
+        self.idle_workers: deque = deque()
+        self.last_heartbeat = time.monotonic()
+
+    def load(self) -> float:
+        cpu_t = self.resources_total.get("CPU", 0.0)
+        if cpu_t <= 0:
+            return 1.0
+        return 1.0 - self.resources_avail.get("CPU", 0.0) / cpu_t
+
+    def fits(self, req: Dict[str, float]) -> bool:
+        return all(self.resources_avail.get(k, 0.0) >= v - 1e-9
+                   for k, v in req.items() if v > 0)
+
+    def acquire(self, req: Dict[str, float]) -> None:
+        for k, v in req.items():
+            self.resources_avail[k] = self.resources_avail.get(k, 0.0) - v
+
+    def release_res(self, req: Dict[str, float]) -> None:
+        for k, v in req.items():
+            self.resources_avail[k] = self.resources_avail.get(k, 0.0) + v
+
+
+class WorkerState:
+    def __init__(self, worker_id: str, node_id: str, pid: int):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.pid = pid
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = "starting"  # starting|idle|busy|actor|dead
+        self.task_conn = None    # Connection for pushes
+        self.task_conn_lock = threading.Lock()
+        self.blocked = False     # task currently parked in get() (CPU released)
+        self.current_task: Optional[dict] = None
+        self.actor_id: Optional[str] = None
+        self.actor_addr: Optional[str] = None
+
+    def push(self, msg: dict) -> bool:
+        with self.task_conn_lock:
+            if self.task_conn is None:
+                return False
+            try:
+                self.task_conn.send(msg)
+                return True
+            except (OSError, ValueError):
+                return False
+
+
+class ObjMeta:
+    __slots__ = ("state", "loc", "data", "size", "node_id", "refcount",
+                 "lineage_task", "contained")
+
+    def __init__(self):
+        self.state = PENDING
+        self.loc = None          # inline|shm|spilled
+        self.data: Optional[bytes] = None
+        self.size = 0
+        self.node_id: Optional[str] = None
+        self.refcount = 0
+        self.lineage_task: Optional[str] = None
+        self.contained: List[str] = []  # refs nested inside the value
+
+
+class ActorState:
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.actor_id = spec["actor_id"]
+        self.state = A_PENDING
+        self.worker_id: Optional[str] = None
+        self.addr: Optional[str] = None
+        self.restarts_left = spec.get("max_restarts", 0)
+        self.name = spec.get("name")
+        self.namespace = spec.get("namespace", "default")
+        self.detached = spec.get("detached", False)
+        self.death_reason: Optional[str] = None
+        self.incarnation = 0
+
+
+class PgState:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]], strategy: str,
+                 name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles              # requested resources per bundle
+        self.strategy = strategy
+        self.name = name
+        self.state = PENDING                # pending|ready|removed
+        self.assignment: List[Optional[str]] = [None] * len(bundles)  # node ids
+        self.bundle_avail: List[Dict[str, float]] = [dict(b) for b in bundles]
+
+
+class GcsServer:
+    def __init__(self, session: Session, head_resources: Dict[str, float]):
+        self.session = session
+        self.store = ShmObjectStore(spill_dir=str(session.spill_dir))
+        self.lock = threading.RLock()
+        self.cv = threading.Condition(self.lock)
+
+        self.nodes: Dict[str, NodeState] = {}
+        self.workers: Dict[str, WorkerState] = {}
+        self.objects: Dict[str, ObjMeta] = {}
+        self.client_refs: Dict[str, Dict[str, int]] = defaultdict(dict)
+        self.pending_tasks: deque = deque()
+        self.infeasible_tasks: List[dict] = []
+        self.running: Dict[str, Tuple[str, dict]] = {}   # task_id -> (worker, spec)
+        self.actors: Dict[str, ActorState] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}
+        self.functions: Dict[str, bytes] = {}
+        self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)
+        self.pgs: Dict[str, PgState] = {}
+        self.lineage: Dict[str, dict] = {}
+        self.lineage_order: deque = deque(maxlen=20000)
+        self.events: List[dict] = []                      # timeline events
+        self.dead_clients: Set[str] = set()
+        self.driver_ids: Set[str] = set()
+        self.log_sink = None                              # callable(line)
+        self._shutdown = False
+        self._spawn_counter = 0
+
+        self.head_node_id = NodeID.new()
+        self.add_node_internal(self.head_node_id, head_resources, is_head=True)
+
+        self.rpc_path = session.socket_path("gcs.sock")
+        self._listener = protocol.make_listener(self.rpc_path)
+        self._threads: List[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, name="gcs-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        m = threading.Thread(target=self._monitor_loop, name="gcs-monitor", daemon=True)
+        m.start()
+        self._threads.append(m)
+
+    # ------------------------------------------------------------------ nodes
+    def add_node_internal(self, node_id: str, resources: Dict[str, float],
+                          is_head: bool = False,
+                          labels: Optional[Dict[str, str]] = None) -> str:
+        with self.cv:
+            res = dict(resources)
+            res.setdefault("CPU", float(os.cpu_count() or 4) if is_head else 1.0)
+            node = NodeState(node_id, res, labels)
+            # node-id resource enables NodeAffinity via plain resource matching
+            node.resources_total[f"node:{node_id}"] = 1.0
+            node.resources_avail[f"node:{node_id}"] = 1.0
+            self.nodes[node_id] = node
+            self.cv.notify_all()
+        return node_id
+
+    def remove_node_internal(self, node_id: str) -> None:
+        """Cluster fixture: simulate node failure (SURVEY.md §4 Cluster.remove_node)."""
+        with self.cv:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            node.alive = False
+            workers = [self.workers[w] for w in list(node.workers)]
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+        with self.cv:
+            for w in workers:
+                self._handle_worker_death(w)
+            # objects whose primary copy lived there are lost → reconstruction
+            for oid, meta in self.objects.items():
+                if meta.node_id == node_id and meta.state == READY and meta.loc != "inline":
+                    self._mark_object_lost(oid, meta)
+            del self.nodes[node_id]
+            self.cv.notify_all()
+        self._pump()
+
+    # ---------------------------------------------------------------- objects
+    def _get_or_create_meta(self, oid: str) -> ObjMeta:
+        meta = self.objects.get(oid)
+        if meta is None:
+            meta = ObjMeta()
+            self.objects[oid] = meta
+        return meta
+
+    def _seal_object(self, oid: str, loc: str, data: Optional[bytes], size: int,
+                     node_id: Optional[str], contained: List[str],
+                     lineage_task: Optional[str] = None) -> None:
+        meta = self._get_or_create_meta(oid)
+        meta.state = READY
+        meta.loc = loc
+        meta.data = data
+        meta.size = size
+        meta.node_id = node_id
+        meta.contained = contained
+        if lineage_task:
+            meta.lineage_task = lineage_task
+        for c in contained:
+            cm = self._get_or_create_meta(c)
+            cm.refcount += 1  # the container holds a ref on nested objects
+        self.cv.notify_all()
+
+    def _seal_error(self, oid: str, err_bytes: bytes) -> None:
+        meta = self._get_or_create_meta(oid)
+        meta.state = ERROR
+        meta.loc = "inline"
+        meta.data = err_bytes
+        self.cv.notify_all()
+
+    def _mark_object_lost(self, oid: str, meta: ObjMeta) -> None:
+        if meta.lineage_task and meta.lineage_task in self.lineage:
+            meta.state = PENDING
+            meta.data = None
+            spec = dict(self.lineage[meta.lineage_task])
+            spec["is_reconstruction"] = True
+            logger.info("reconstructing %s via task %s", oid, spec["task_id"])
+            self.pending_tasks.append(spec)
+        else:
+            owner_dead = oid[:16] in self.dead_clients
+            e = exc.OwnerDiedError(oid) if owner_dead else exc.ObjectLostError(oid)
+            from ray_tpu._private.serialization import serialize_to_bytes
+            meta.state = ERROR
+            meta.loc = "inline"
+            meta.data = serialize_to_bytes(e)[0]
+
+    def _decref(self, oid: str, n: int = 1) -> None:
+        meta = self.objects.get(oid)
+        if meta is None:
+            return
+        meta.refcount -= n
+        if meta.refcount <= 0 and meta.state != PENDING:
+            for c in meta.contained:
+                self._decref(c)
+            if meta.loc in ("shm", "spilled"):
+                self.store.delete_object(oid)
+            del self.objects[oid]
+
+    # ------------------------------------------------------------- scheduling
+    def _task_resources(self, spec: dict) -> Dict[str, float]:
+        req = dict(spec.get("resources") or {})
+        req["CPU"] = float(spec.get("num_cpus", 1))
+        if spec.get("num_tpus"):
+            req["TPU"] = float(spec["num_tpus"])
+        return {k: v for k, v in req.items() if v > 0}
+
+    def _deps_status(self, spec: dict) -> str:
+        """ready | waiting | error:<oid>"""
+        for dep in spec.get("deps", ()):
+            meta = self.objects.get(dep)
+            if meta is None or meta.state == PENDING:
+                return "waiting"
+            if meta.state == ERROR:
+                return f"error:{dep}"
+        return "ready"
+
+    def _pick_node(self, spec: dict, req: Dict[str, float]) -> Optional[NodeState]:
+        strategy = spec.get("scheduling_strategy") or "DEFAULT"
+        alive = [n for n in self.nodes.values() if n.alive]
+        if isinstance(strategy, dict) and strategy.get("type") == "node_affinity":
+            node = self.nodes.get(strategy["node_id"])
+            if node is not None and node.alive and node.fits(req):
+                return node
+            if strategy.get("soft"):
+                strategy = "DEFAULT"
+            else:
+                return None
+        if isinstance(strategy, dict) and strategy.get("type") == "placement_group":
+            return None  # handled by _pick_pg_node
+        fitting = [n for n in alive if n.fits(req)]
+        if not fitting:
+            return None
+        if strategy == "SPREAD":
+            fitting.sort(key=lambda n: n.load())
+            return fitting[0]
+        # hybrid (reference hybrid_policy): pack onto low-index nodes until the
+        # spread threshold, then least-loaded.
+        thresh = GLOBAL_CONFIG.scheduler_spread_threshold
+        for n in fitting:
+            if n.load() < thresh:
+                return n
+        fitting.sort(key=lambda n: n.load())
+        return fitting[0]
+
+    def _pick_pg_node(self, spec: dict, req: Dict[str, float]):
+        st = spec["scheduling_strategy"]
+        pg = self.pgs.get(st["pg_id"])
+        if pg is None or pg.state != READY:
+            return None, None
+        idxs = [st["bundle_index"]] if st.get("bundle_index", -1) >= 0 \
+            else range(len(pg.bundles))
+        for i in idxs:
+            avail = pg.bundle_avail[i]
+            if all(avail.get(k, 0.0) >= v - 1e-9 for k, v in req.items()):
+                node = self.nodes.get(pg.assignment[i])
+                if node is not None and node.alive:
+                    return node, (pg, i)
+        return None, None
+
+    def _idle_worker_on(self, node: NodeState) -> Optional[WorkerState]:
+        while node.idle_workers:
+            wid = node.idle_workers.popleft()
+            w = self.workers.get(wid)
+            if w is not None and w.state == "idle":
+                return w
+        return None
+
+    def _spawn_worker(self, node_id: str) -> None:
+        """Fork a new worker process for a node (reference: WorkerPool pop/fork)."""
+        self._spawn_counter += 1
+        env = dict(os.environ)
+        env.update(GLOBAL_CONFIG.to_env())
+        env["RTPU_SESSION_DIR"] = str(self.session.path)
+        env["RTPU_NODE_ID"] = node_id
+        # Workers never grab the TPU: jax must not lock the chip in every
+        # spawned process (the driver owns device access by default; TPU
+        # actors opt in via runtime_env {"env_vars": {"RTPU_TPU_WORKER": "1"}}).
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # Skip the axon/jax PJRT registration in sitecustomize (3.4s import
+        # tax per process) — CPU workers don't touch the TPU tunnel.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, cwd=os.getcwd(),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        w = WorkerState(WorkerID(f"spawn{self._spawn_counter:06d}"), node_id, proc.pid)
+        w.proc = proc
+        # registered properly once the process connects; keep it for monitor
+        self.workers[w.worker_id] = w
+
+    def _count_node_workers(self, node: NodeState, include_starting=True) -> int:
+        """Workers counted against the spawn cap.
+
+        Blocked workers (parked in get(), CPU released) don't count — else
+        nested task chains deadlock once the cap's worth of workers are all
+        blocked waiting on children (reference: raylet spawns replacement
+        workers for blocked ones).
+        """
+        n = 0
+        for wid in list(self.workers):
+            w = self.workers[wid]
+            if w.node_id == node.node_id and not w.blocked and w.state in (
+                    ("starting",) if include_starting else ()) + ("idle", "busy"):
+                n += 1
+        return n
+
+    def _pump(self) -> None:
+        """Try to dispatch pending work. Call with lock NOT held."""
+        with self.cv:
+            self._pump_locked()
+
+    def _pump_locked(self) -> None:
+        progressed = True
+        while progressed:
+            progressed = False
+            for _ in range(len(self.pending_tasks)):
+                spec = self.pending_tasks.popleft()
+                if spec.get("cancelled"):
+                    continue
+                status = self._deps_status(spec)
+                if status.startswith("error:"):
+                    dep = status.split(":", 1)[1]
+                    self._fail_task_with_dep_error(spec, dep)
+                    progressed = True
+                    continue
+                if status == "waiting":
+                    self.pending_tasks.append(spec)
+                    continue
+                req = self._task_resources(spec)
+                st = spec.get("scheduling_strategy")
+                pg_claim = None
+                if isinstance(st, dict) and st.get("type") == "placement_group":
+                    node, pg_claim = self._pick_pg_node(spec, req)
+                else:
+                    node = self._pick_node(spec, req)
+                if node is None:
+                    self.pending_tasks.append(spec)
+                    continue
+                worker = self._idle_worker_on(node)
+                if worker is None:
+                    # spawn if below cap (cap = node CPU count, min 1)
+                    cap = int(max(1, node.resources_total.get("CPU", 1)))
+                    cap = GLOBAL_CONFIG.num_workers_per_node or cap
+                    if self._count_node_workers(node) < cap + len(
+                            [a for a in self.actors.values()
+                             if a.state in (A_PENDING, A_RESTARTING)]):
+                        self._spawn_worker(node.node_id)
+                    self.pending_tasks.append(spec)
+                    continue
+                # dispatch
+                if pg_claim is not None:
+                    pg, i = pg_claim
+                    for k, v in req.items():
+                        pg.bundle_avail[i][k] = pg.bundle_avail[i].get(k, 0.0) - v
+                    spec["_pg_claim"] = (pg.pg_id, i)
+                else:
+                    node.acquire(req)
+                spec["_req"] = req
+                spec["_node"] = node.node_id
+                worker.state = "busy"
+                worker.current_task = spec
+                self.running[spec["task_id"]] = (worker.worker_id, spec)
+                kind = ("create_actor" if spec.get("is_actor_creation")
+                        else "execute_task")
+                if not worker.push({"kind": kind, "spec": spec}):
+                    # push failed: worker died between idle and now
+                    self._handle_worker_death(worker)
+                    self.pending_tasks.append(spec)
+                    continue
+                progressed = True
+            self.cv.notify_all()
+
+    def _release_task_resources(self, spec: dict) -> None:
+        req = spec.pop("_req", None)
+        node_id = spec.pop("_node", None)
+        pg_claim = spec.pop("_pg_claim", None)
+        if spec.pop("_cpu_released", None) and req:
+            req = dict(req)
+            req.pop("CPU", None)  # already released at task_blocked time
+        if pg_claim is not None:
+            pg, i = self.pgs.get(pg_claim[0]), pg_claim[1]
+            if pg is not None:
+                for k, v in (req or {}).items():
+                    pg.bundle_avail[i][k] = pg.bundle_avail[i].get(k, 0.0) + v
+        elif req and node_id in self.nodes:
+            self.nodes[node_id].release_res(req)
+
+    def _release_deps(self, spec: dict) -> None:
+        """Drop the scheduler's hold on arg objects once the task is terminal."""
+        if spec.get("_deps_released"):
+            return
+        spec["_deps_released"] = True
+        for dep in list(spec.get("deps", ())) + list(spec.get("borrows", ())):
+            self._decref(dep)
+
+    def _fail_task_with_dep_error(self, spec: dict, dep_oid: str) -> None:
+        dep_meta = self.objects[dep_oid]
+        for oid in spec["return_ids"]:
+            self._seal_error(oid, dep_meta.data)
+        if spec.get("is_actor_creation"):
+            # surface the dep error as the actor's creation error
+            a = self.actors.get(spec["actor_id"])
+            if a is not None and a.state != A_DEAD:
+                a.state = A_DEAD
+                a.death_reason = "actor constructor dependency failed"
+                a.spec["_creation_error"] = dep_meta.data
+                if a.name:
+                    self.named_actors.pop((a.namespace, a.name), None)
+        self._release_deps(spec)
+
+    def _fail_task(self, spec: dict, err: BaseException) -> None:
+        from ray_tpu._private.serialization import serialize_to_bytes
+        data = serialize_to_bytes(err)[0]
+        for oid in spec["return_ids"]:
+            self._seal_error(oid, data)
+        self._release_deps(spec)
+
+    # ------------------------------------------------------------- worker mgmt
+    def _handle_worker_death(self, w: WorkerState) -> None:
+        """Lock held. Failure handling per SURVEY.md §5.3."""
+        if w.state == "dead":
+            return
+        w.state = "dead"
+        self.dead_clients.add(w.worker_id)
+        node = self.nodes.get(w.node_id)
+        if node is not None:
+            node.workers.discard(w.worker_id)
+        # release refs held by this client
+        for oid, n in self.client_refs.pop(w.worker_id, {}).items():
+            self._decref(oid, n)
+        spec = w.current_task
+        w.current_task = None
+        if w.actor_id is not None:
+            self._actor_worker_died(w.actor_id)
+        elif spec is not None and spec.get("is_actor_creation"):
+            # died mid-__init__, before actor_ready assigned w.actor_id:
+            # route through the actor FSM so max_restarts is honored
+            self._release_task_resources(spec)
+            self.running.pop(spec["task_id"], None)
+            a = self.actors.get(spec["actor_id"])
+            if a is not None:
+                a.death_reason = "worker died during actor creation"
+                self._actor_worker_died(a.actor_id)
+            spec = None
+        if spec is not None:
+            self._release_task_resources(spec)
+            self.running.pop(spec["task_id"], None)
+            retries = spec.get("max_retries", GLOBAL_CONFIG.task_default_max_retries)
+            attempts = spec.get("attempt", 0)
+            if not spec.get("is_actor_creation") and (retries < 0 or attempts < retries):
+                spec = dict(spec)
+                spec["attempt"] = attempts + 1
+                logger.info("retrying task %s (attempt %d)", spec["task_id"],
+                            spec["attempt"])
+                self.pending_tasks.append(spec)
+            elif not spec.get("is_actor_creation"):
+                self._fail_task(spec, exc.WorkerCrashedError(
+                    f"worker {w.worker_id} (pid {w.pid}) died running "
+                    f"{spec.get('name', spec['task_id'])}"))
+        self.cv.notify_all()
+
+    def _actor_worker_died(self, actor_id: str) -> None:
+        a = self.actors.get(actor_id)
+        if a is None or a.state == A_DEAD:
+            return
+        # actor-creation resources are held for the actor's lifetime;
+        # give them back now that the process is gone
+        self._release_task_resources(a.spec)
+        if a.restarts_left != 0 and not a.spec.get("_killed"):
+            a.restarts_left = max(-1, a.restarts_left - 1) if a.restarts_left > 0 else -1
+            a.state = A_RESTARTING
+            a.incarnation += 1
+            a.addr = None
+            a.worker_id = None
+            respec = {k: v for k, v in a.spec.items() if not k.startswith("_")}
+            respec["attempt"] = respec.get("attempt", 0) + 1
+            a.spec = respec
+            self.pending_tasks.append(respec)
+            logger.info("restarting actor %s (incarnation %d)", actor_id, a.incarnation)
+        else:
+            a.state = A_DEAD
+            a.death_reason = a.death_reason or "worker died"
+            if a.name:
+                self.named_actors.pop((a.namespace, a.name), None)
+
+    def _monitor_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(0.1)
+            dead: List[WorkerState] = []
+            with self.lock:
+                for w in self.workers.values():
+                    if w.proc is not None and w.state != "dead" and w.proc.poll() is not None:
+                        dead.append(w)
+            if dead:
+                with self.cv:
+                    for w in dead:
+                        logger.warning("worker %s pid=%s exited", w.worker_id, w.pid)
+                        self._handle_worker_death(w)
+                self._pump()
+
+    # -------------------------------------------------------------- rpc server
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn) -> None:
+        client_id: Optional[str] = None
+        try:
+            while not self._shutdown:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    break
+                kind = msg.get("kind")
+                rid = msg.get("rid")
+                if kind == "attach_task_conn":
+                    self._attach_task_conn(msg["worker_id"], conn)
+                    return  # this thread becomes the push-channel reader
+                try:
+                    if client_id is None and "client_id" in msg:
+                        client_id = msg["client_id"]
+                    resp = self._dispatch(kind, msg)
+                    if rid is not None:
+                        conn.send({"rid": rid, "error": None, **(resp or {})})
+                except Exception as e:  # noqa: BLE001 - report to caller
+                    if rid is not None:
+                        try:
+                            conn.send({"rid": rid, "error": dumps_call(e)})
+                        except (OSError, ValueError):
+                            break
+                    else:
+                        logger.exception("one-way rpc %s failed", kind)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _attach_task_conn(self, worker_id: str, conn) -> None:
+        with self.cv:
+            w = self.workers.get(worker_id)
+            if w is None:
+                conn.close()
+                return
+            w.task_conn = conn
+            if w.state == "starting":
+                w.state = "idle"
+                node = self.nodes.get(w.node_id)
+                if node is not None:
+                    node.idle_workers.append(worker_id)
+            self.cv.notify_all()
+        self._pump()
+        # reader loop for one-way worker events
+        while not self._shutdown:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            try:
+                self._handle_worker_event(worker_id, msg)
+            except Exception:
+                logger.exception("worker event failed: %s", msg.get("kind"))
+        with self.cv:
+            w = self.workers.get(worker_id)
+            if w is not None and w.proc is None:
+                # in-process "worker" (driver) disconnected
+                self._handle_worker_death(w)
+
+    # ----------------------------------------------------------- worker events
+    def _handle_worker_event(self, worker_id: str, msg: dict) -> None:
+        kind = msg["kind"]
+        if kind == "task_done":
+            self._on_task_done(worker_id, msg)
+        elif kind == "actor_ready":
+            self._on_actor_ready(worker_id, msg)
+        elif kind == "actor_result":
+            # actor method results sealed by the actor's worker
+            with self.cv:
+                for oid, res in zip(msg["return_ids"], msg["results"]):
+                    meta = self._get_or_create_meta(oid)
+                    if res["loc"] == "error":
+                        self._seal_error(oid, res["data"])
+                    else:
+                        if res["loc"] == "shm":
+                            self.store.adopt(oid, res.get("size", 0))
+                        self._seal_object(oid, res["loc"], res.get("data"),
+                                          res.get("size", 0), None,
+                                          res.get("contained", []))
+            self._pump()  # tasks may be waiting on these objects as deps
+        elif kind == "task_blocked":
+            # reference: raylet releases the CPU while a task blocks in get().
+            # Credit whichever pool the CPU was claimed from: the PG bundle
+            # for placement-group tasks, the node otherwise.
+            with self.cv:
+                w = self.workers.get(worker_id)
+                if w is not None and w.current_task is not None:
+                    w.blocked = True
+                    spec = w.current_task
+                    cpu = (spec.get("_req") or {}).get("CPU", 0)
+                    if cpu and not spec.get("_cpu_released"):
+                        spec["_cpu_released"] = True
+                        pg_claim = spec.get("_pg_claim")
+                        if pg_claim is not None:
+                            pg = self.pgs.get(pg_claim[0])
+                            if pg is not None:
+                                avail = pg.bundle_avail[pg_claim[1]]
+                                avail["CPU"] = avail.get("CPU", 0.0) + cpu
+                        else:
+                            node = self.nodes.get(w.node_id)
+                            if node is not None:
+                                node.release_res({"CPU": cpu})
+                        self.cv.notify_all()
+            self._pump()
+        elif kind == "task_unblocked":
+            with self.cv:
+                w = self.workers.get(worker_id)
+                if w is not None:
+                    w.blocked = False
+                if w is not None and w.current_task is not None \
+                        and w.current_task.pop("_cpu_released", None):
+                    spec = w.current_task
+                    cpu = (spec.get("_req") or {}).get("CPU", 0)
+                    pg_claim = spec.get("_pg_claim")
+                    if pg_claim is not None:
+                        pg = self.pgs.get(pg_claim[0])
+                        if pg is not None:
+                            avail = pg.bundle_avail[pg_claim[1]]
+                            avail["CPU"] = avail.get("CPU", 0.0) - cpu
+                    else:
+                        node = self.nodes.get(w.node_id)
+                        if node is not None:
+                            node.acquire({"CPU": cpu})
+        elif kind == "actor_exit":
+            with self.cv:
+                a = self.actors.get(msg["actor_id"])
+                if a is not None:
+                    a.spec["_killed"] = True  # intentional exit → no restart
+                    a.death_reason = "exit_actor"
+        elif kind == "log" and self.log_sink is not None:
+            self.log_sink(msg["line"])
+        elif kind == "profile_events":
+            with self.lock:
+                self.events.extend(msg["events"])
+
+    def _on_task_done(self, worker_id: str, msg: dict) -> None:
+        with self.cv:
+            w = self.workers.get(worker_id)
+            spec = w.current_task if w else None
+            if spec is None or spec["task_id"] != msg["task_id"]:
+                return
+            self.running.pop(spec["task_id"], None)
+            self._release_task_resources(spec)
+            w.current_task = None
+            w.blocked = False
+            # store results
+            if msg["status"] == "ok":
+                for oid, res in zip(spec["return_ids"], msg["results"]):
+                    meta = self._get_or_create_meta(oid)
+                    if meta.refcount <= 0 and not spec.get("is_reconstruction"):
+                        meta.refcount += 1  # owner's initial reference
+                    if res["loc"] == "shm":
+                        self.store.adopt(oid, res.get("size", 0))
+                    self._seal_object(
+                        oid, res["loc"], res.get("data"), res.get("size", 0),
+                        spec.get("_node") or w.node_id, res.get("contained", []),
+                        lineage_task=spec["task_id"])
+                self.lineage[spec["task_id"]] = {
+                    k: v for k, v in spec.items() if not k.startswith("_")}
+                self.lineage_order.append(spec["task_id"])
+                if len(self.lineage) > self.lineage_order.maxlen:
+                    live = set(self.lineage_order)
+                    for tid in [t for t in self.lineage if t not in live]:
+                        self.lineage.pop(tid, None)
+                self._release_deps(spec)
+            elif msg["status"] == "app_error":
+                retries = spec.get("max_retries", 0) if spec.get("retry_exceptions") \
+                    else 0
+                if retries and spec.get("attempt", 0) < retries:
+                    spec2 = dict(spec)
+                    spec2["attempt"] = spec.get("attempt", 0) + 1
+                    self.pending_tasks.append(spec2)
+                else:
+                    for oid in spec["return_ids"]:
+                        self._seal_error(oid, msg["error"])
+                    self._release_deps(spec)
+            # worker back to pool
+            if w.state == "busy":
+                w.state = "idle"
+                node = self.nodes.get(w.node_id)
+                if node is not None and node.alive:
+                    node.idle_workers.append(worker_id)
+            self.cv.notify_all()
+        self._pump()
+
+    def _on_actor_ready(self, worker_id: str, msg: dict) -> None:
+        with self.cv:
+            a = self.actors.get(msg["actor_id"])
+            w = self.workers.get(worker_id)
+            if a is None or w is None:
+                return
+            self.running.pop(a.spec["task_id"], None)
+            if msg["status"] == "ok":
+                a.state = A_ALIVE
+                a.worker_id = worker_id
+                a.addr = msg["addr"]
+                w.state = "actor"
+                w.actor_id = a.actor_id
+                w.current_task = None
+                # actor creation keeps its resources until death — do NOT release
+            else:
+                spec = w.current_task
+                w.current_task = None
+                if spec is not None:
+                    self._release_task_resources(spec)
+                w.state = "idle"
+                node = self.nodes.get(w.node_id)
+                if node is not None:
+                    node.idle_workers.append(worker_id)
+                a.state = A_DEAD
+                a.death_reason = "creation failed"
+                a.spec["_creation_error"] = msg.get("error")
+                if a.name:
+                    self.named_actors.pop((a.namespace, a.name), None)
+            self.cv.notify_all()
+        self._pump()
+
+    # ---------------------------------------------------------------- dispatch
+    def _dispatch(self, kind: str, msg: dict) -> Optional[dict]:
+        handler = getattr(self, f"_h_{kind}", None)
+        if handler is None:
+            raise exc.RaySystemError(f"unknown rpc kind: {kind}")
+        return handler(msg)
+
+    # --- registration
+    def _h_register_client(self, msg: dict) -> dict:
+        with self.cv:
+            wid = msg["client_id"]
+            node_id = msg.get("node_id") or self.head_node_id
+            role = msg["role"]
+            existing = self.workers.get(wid)
+            if existing is not None:  # extra thread-local channel re-registering
+                return {"node_id": existing.node_id,
+                        "head_node_id": self.head_node_id,
+                        "store_capacity": self.store.capacity}
+            if role == "worker":
+                # find the placeholder created at spawn time by pid, else create
+                w = None
+                for cand in self.workers.values():
+                    if cand.proc is not None and cand.proc.pid == msg["pid"] \
+                            and cand.state == "starting":
+                        w = cand
+                        break
+                if w is None:
+                    w = WorkerState(wid, node_id, msg["pid"])
+                    self.workers[wid] = w
+                else:
+                    # rekey to the worker's self-chosen id
+                    del self.workers[w.worker_id]
+                    w.worker_id = wid
+                    self.workers[wid] = w
+                node = self.nodes.get(w.node_id)
+                if node is not None:
+                    node.workers.add(wid)
+            else:  # driver
+                w = WorkerState(wid, node_id, msg["pid"])
+                w.state = "driver"
+                self.workers[wid] = w
+                self.driver_ids.add(wid)
+            self.cv.notify_all()
+            return {"node_id": w.node_id, "head_node_id": self.head_node_id,
+                    "store_capacity": self.store.capacity}
+
+    # --- objects
+    def _h_put_object(self, msg: dict) -> dict:
+        with self.cv:
+            oid = msg["object_id"]
+            meta = self._get_or_create_meta(oid)
+            meta.refcount += 1  # the putting client's reference
+            self.client_refs[msg["client_id"]][oid] = \
+                self.client_refs[msg["client_id"]].get(oid, 0) + 1
+            if msg["loc"] == "shm":
+                self.store.adopt(oid, msg.get("size", 0))
+            self._seal_object(oid, msg["loc"], msg.get("data"),
+                              msg.get("size", 0), msg.get("node_id"),
+                              msg.get("contained", []))
+        self._pump()  # a pending task may have been waiting on this object
+        return {}
+
+    def _h_get_meta(self, msg: dict) -> dict:
+        deadline = None if msg.get("timeout") is None \
+            else time.monotonic() + msg["timeout"]
+        oids = msg["object_ids"]
+        with self.cv:
+            while True:
+                missing_lost = []
+                pending = []
+                for oid in oids:
+                    meta = self.objects.get(oid)
+                    if meta is None or meta.state == PENDING:
+                        pending.append(oid)
+                    elif meta.state == READY and meta.loc in ("shm", "spilled") \
+                            and not self.store.restore(oid) \
+                            and not ShmObjectStore.exists_in_shm(oid):
+                        missing_lost.append((oid, meta))
+                for oid, meta in missing_lost:
+                    self._mark_object_lost(oid, meta)
+                if missing_lost:
+                    self._pump_locked()
+                    continue
+                if not pending:
+                    break
+                # owner-death check for pending objects
+                for oid in pending:
+                    if oid[:16] in self.dead_clients:
+                        meta = self._get_or_create_meta(oid)
+                        if meta.state == PENDING and not (
+                                meta.lineage_task and meta.lineage_task in self.lineage):
+                            self._mark_object_lost(oid, meta)
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {pending[:3]}...")
+                self.cv.wait(timeout=min(1.0, remaining) if remaining else 1.0)
+            out = {}
+            for oid in oids:
+                meta = self.objects[oid]
+                self.store.touch(oid)
+                out[oid] = {"state": meta.state, "loc": meta.loc,
+                            "data": meta.data, "size": meta.size}
+            return {"metas": out}
+
+    def _h_wait(self, msg: dict) -> dict:
+        oids = msg["object_ids"]
+        num_returns = msg["num_returns"]
+        deadline = None if msg.get("timeout") is None \
+            else time.monotonic() + msg["timeout"]
+        with self.cv:
+            while True:
+                ready = [o for o in oids
+                         if (m := self.objects.get(o)) is not None
+                         and m.state != PENDING]
+                if len(ready) >= num_returns:
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                self.cv.wait(timeout=min(0.5, remaining) if remaining else 0.5)
+            ready_set = set(ready[:num_returns])
+            return {"ready": [o for o in oids if o in ready_set],
+                    "not_ready": [o for o in oids if o not in ready_set]}
+
+    def _h_add_ref(self, msg: dict) -> dict:
+        with self.cv:
+            meta = self._get_or_create_meta(msg["object_id"])
+            meta.refcount += 1
+            refs = self.client_refs[msg["client_id"]]
+            refs[msg["object_id"]] = refs.get(msg["object_id"], 0) + 1
+        return {}
+
+    def _h_add_refs(self, msg: dict) -> dict:
+        ledger = msg.get("ledger") or msg["client_id"]
+        with self.cv:
+            refs = self.client_refs[ledger]
+            for oid in msg["object_ids"]:
+                self._get_or_create_meta(oid).refcount += 1
+                refs[oid] = refs.get(oid, 0) + 1
+        return {}
+
+    def _h_release_all(self, msg: dict) -> dict:
+        """Release every ref under a transient ledger (in-flight actor args)."""
+        with self.cv:
+            for oid, n in self.client_refs.pop(msg["ledger"], {}).items():
+                self._decref(oid, n)
+            self.cv.notify_all()
+        return {}
+
+    def _h_seal_errors(self, msg: dict) -> dict:
+        with self.cv:
+            for oid in msg["object_ids"]:
+                meta = self._get_or_create_meta(oid)
+                if meta.state == PENDING:
+                    self._seal_error(oid, msg["error"])
+        self._pump()
+        return {}
+
+    def _h_release(self, msg: dict) -> dict:
+        with self.cv:
+            refs = self.client_refs.get(msg["client_id"], {})
+            oid = msg["object_id"]
+            if refs.get(oid, 0) > 0:
+                refs[oid] -= 1
+                if refs[oid] == 0:
+                    del refs[oid]
+                self._decref(oid)
+        return {}
+
+    def _h_free_objects(self, msg: dict) -> dict:
+        with self.cv:
+            for oid in msg["object_ids"]:
+                meta = self.objects.pop(oid, None)
+                if meta is not None and meta.loc in ("shm", "spilled"):
+                    self.store.delete_object(oid)
+            self.cv.notify_all()
+        return {}
+
+    # --- tasks
+    def _h_submit_task(self, msg: dict) -> dict:
+        spec = msg["spec"]
+        with self.cv:
+            refs = self.client_refs[spec["owner"]]
+            for oid in spec["return_ids"]:
+                meta = self._get_or_create_meta(oid)
+                meta.refcount += 1
+                refs[oid] = refs.get(oid, 0) + 1
+            # pin args (top-level refs) and borrows (refs nested in values)
+            # until the task reaches a terminal state
+            for dep in list(spec.get("deps", ())) + list(spec.get("borrows", ())):
+                meta = self._get_or_create_meta(dep)
+                meta.refcount += 1
+            self.pending_tasks.append(spec)
+        self._pump()
+        return {}
+
+    def _h_find_task_of_object(self, msg: dict) -> dict:
+        oid = msg["object_id"]
+        with self.lock:
+            for spec in self.pending_tasks:
+                if oid in spec["return_ids"]:
+                    return {"task_id": spec["task_id"]}
+            for wid, spec in self.running.values():
+                if oid in spec["return_ids"]:
+                    return {"task_id": spec["task_id"]}
+            meta = self.objects.get(oid)
+            if meta is not None and meta.lineage_task:
+                return {"task_id": meta.lineage_task}
+        raise ValueError(f"no task found for object {oid}")
+
+    def _h_cancel_task(self, msg: dict) -> dict:
+        tid = msg["task_id"]
+        with self.cv:
+            for spec in self.pending_tasks:
+                if spec["task_id"] == tid:
+                    spec["cancelled"] = True
+                    self._fail_task(spec, exc.TaskCancelledError(tid))
+                    self.cv.notify_all()
+                    return {"cancelled": "pending"}
+            entry = self.running.get(tid)
+            if entry is not None:
+                wid, spec = entry
+                w = self.workers.get(wid)
+                if msg.get("force"):
+                    if w is not None and w.proc is not None:
+                        w.proc.kill()
+                    return {"cancelled": "killed"}
+                if w is not None:
+                    w.push({"kind": "cancel", "task_id": tid})
+                return {"cancelled": "signalled"}
+        return {"cancelled": "not_found"}
+
+    # --- actors
+    def _h_create_actor(self, msg: dict) -> dict:
+        spec = msg["spec"]
+        a = ActorState(spec)
+        with self.cv:
+            if a.name:
+                key = (a.namespace, a.name)
+                if key in self.named_actors:
+                    existing = self.actors.get(self.named_actors[key])
+                    if existing is not None and existing.state != A_DEAD:
+                        if spec.get("get_if_exists"):
+                            return {"actor_id": existing.actor_id, "existing": True}
+                        raise ValueError(
+                            f"actor name {a.name!r} already taken in "
+                            f"namespace {a.namespace!r}")
+                self.named_actors[key] = a.actor_id
+            self.actors[a.actor_id] = a
+            self.pending_tasks.append(spec)
+        self._pump()
+        return {"actor_id": a.actor_id, "existing": False}
+
+    def _h_get_actor_info(self, msg: dict) -> dict:
+        deadline = None if msg.get("timeout") is None \
+            else time.monotonic() + msg["timeout"]
+        with self.cv:
+            while True:
+                a = self.actors.get(msg["actor_id"])
+                if a is None:
+                    raise ValueError(f"unknown actor {msg['actor_id']}")
+                if a.state == A_ALIVE:
+                    return {"state": a.state, "addr": a.addr,
+                            "incarnation": a.incarnation}
+                if a.state == A_DEAD:
+                    return {"state": a.state, "addr": None,
+                            "death_reason": a.death_reason,
+                            "creation_error": a.spec.get("_creation_error"),
+                            "incarnation": a.incarnation}
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return {"state": a.state, "addr": None,
+                            "incarnation": a.incarnation}
+                self.cv.wait(timeout=min(0.5, remaining) if remaining else 0.5)
+
+    def _h_get_actor_by_name(self, msg: dict) -> dict:
+        with self.cv:
+            aid = self.named_actors.get((msg.get("namespace", "default"), msg["name"]))
+            if aid is None:
+                raise ValueError(f"no actor named {msg['name']!r}")
+            a = self.actors[aid]
+            return {"actor_id": aid, "class_blob_id": a.spec.get("class_blob_id"),
+                    "method_meta": a.spec.get("method_meta")}
+
+    def _h_kill_actor(self, msg: dict) -> dict:
+        with self.cv:
+            a = self.actors.get(msg["actor_id"])
+            if a is None:
+                return {}
+            if msg.get("no_restart", True):
+                a.spec["_killed"] = True
+                a.restarts_left = 0
+            a.death_reason = "ray_tpu.kill"
+            w = self.workers.get(a.worker_id) if a.worker_id else None
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+        elif w is not None:
+            w.push({"kind": "stop_worker"})
+        with self.cv:
+            if a.state in (A_PENDING, A_RESTARTING) and msg.get("no_restart", True):
+                # not yet running anywhere: cancel the pending creation
+                for spec in self.pending_tasks:
+                    if spec.get("actor_id") == a.actor_id:
+                        spec["cancelled"] = True
+                a.state = A_DEAD
+                if a.name:
+                    self.named_actors.pop((a.namespace, a.name), None)
+            self.cv.notify_all()
+        return {}
+
+    # --- functions / kv
+    def _h_export_function(self, msg: dict) -> dict:
+        with self.lock:
+            self.functions.setdefault(msg["fn_id"], msg["blob"])
+        return {}
+
+    def _h_fetch_function(self, msg: dict) -> dict:
+        deadline = time.monotonic() + 30
+        with self.cv:
+            while msg["fn_id"] not in self.functions:
+                if time.monotonic() > deadline:
+                    raise exc.RaySystemError(f"function {msg['fn_id']} not exported")
+                self.cv.wait(timeout=0.5)
+            return {"blob": self.functions[msg["fn_id"]]}
+
+    def _h_kv_put(self, msg: dict) -> dict:
+        with self.lock:
+            ns = self.kv[msg.get("namespace", "default")]
+            existed = msg["key"] in ns
+            if not (msg.get("overwrite", True) is False and existed):
+                ns[msg["key"]] = msg["value"]
+        return {"existed": existed}
+
+    def _h_kv_get(self, msg: dict) -> dict:
+        with self.lock:
+            return {"value": self.kv[msg.get("namespace", "default")].get(msg["key"])}
+
+    def _h_kv_del(self, msg: dict) -> dict:
+        with self.lock:
+            existed = self.kv[msg.get("namespace", "default")].pop(msg["key"], None)
+        return {"deleted": existed is not None}
+
+    def _h_kv_keys(self, msg: dict) -> dict:
+        with self.lock:
+            ns = self.kv[msg.get("namespace", "default")]
+            prefix = msg.get("prefix", b"")
+            return {"keys": [k for k in ns if k.startswith(prefix)]}
+
+    # --- placement groups
+    def _h_pg_create(self, msg: dict) -> dict:
+        from ray_tpu._private.pg_scheduler import schedule_bundles
+        pg = PgState(msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", ""))
+        with self.cv:
+            assignment = schedule_bundles(
+                [n for n in self.nodes.values() if n.alive],
+                pg.bundles, pg.strategy)
+            if assignment is not None:
+                for i, node_id in enumerate(assignment):
+                    self.nodes[node_id].acquire(pg.bundles[i])
+                    pg.assignment[i] = node_id
+                pg.state = READY
+            self.pgs[pg.pg_id] = pg
+            self.cv.notify_all()
+        return {"state": pg.state}
+
+    def _h_pg_wait(self, msg: dict) -> dict:
+        from ray_tpu._private.pg_scheduler import schedule_bundles
+        deadline = None if msg.get("timeout") is None \
+            else time.monotonic() + msg["timeout"]
+        with self.cv:
+            while True:
+                pg = self.pgs.get(msg["pg_id"])
+                if pg is None:
+                    raise ValueError("placement group removed")
+                if pg.state == READY:
+                    return {"ready": True, "assignment": pg.assignment}
+                # retry scheduling (nodes may have joined)
+                assignment = schedule_bundles(
+                    [n for n in self.nodes.values() if n.alive],
+                    pg.bundles, pg.strategy)
+                if assignment is not None:
+                    for i, node_id in enumerate(assignment):
+                        self.nodes[node_id].acquire(pg.bundles[i])
+                        pg.assignment[i] = node_id
+                    pg.state = READY
+                    self.cv.notify_all()
+                    continue
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return {"ready": False, "assignment": None}
+                self.cv.wait(timeout=min(0.5, remaining) if remaining else 0.5)
+
+    def _h_pg_remove(self, msg: dict) -> dict:
+        with self.cv:
+            pg = self.pgs.pop(msg["pg_id"], None)
+            if pg is not None and pg.state == READY:
+                for i, node_id in enumerate(pg.assignment):
+                    node = self.nodes.get(node_id)
+                    if node is not None:
+                        node.release_res(pg.bundles[i])
+            self.cv.notify_all()
+        self._pump()
+        return {}
+
+    def _h_pg_table(self, msg: dict) -> dict:
+        with self.lock:
+            return {"pgs": {pid: {"state": pg.state, "strategy": pg.strategy,
+                                  "bundles": pg.bundles,
+                                  "assignment": pg.assignment}
+                            for pid, pg in self.pgs.items()}}
+
+    # --- cluster / state API
+    def _h_add_node(self, msg: dict) -> dict:
+        nid = self.add_node_internal(NodeID.new(), msg["resources"],
+                                     labels=msg.get("labels"))
+        self._pump()
+        return {"node_id": nid}
+
+    def _h_remove_node(self, msg: dict) -> dict:
+        self.remove_node_internal(msg["node_id"])
+        return {}
+
+    def _h_cluster_resources(self, msg: dict) -> dict:
+        with self.lock:
+            total: Dict[str, float] = defaultdict(float)
+            avail: Dict[str, float] = defaultdict(float)
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.resources_total.items():
+                    total[k] += v
+                for k, v in n.resources_avail.items():
+                    avail[k] += v
+            return {"total": dict(total), "available": dict(avail)}
+
+    def _h_list_nodes(self, msg: dict) -> dict:
+        with self.lock:
+            return {"nodes": [{
+                "node_id": n.node_id, "alive": n.alive,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_avail,
+                "num_workers": len(n.workers), "labels": n.labels,
+            } for n in self.nodes.values()]}
+
+    def _h_list_actors(self, msg: dict) -> dict:
+        with self.lock:
+            return {"actors": [{
+                "actor_id": a.actor_id, "state": a.state, "name": a.name,
+                "class_name": a.spec.get("class_name"),
+                "node_id": (self.workers[a.worker_id].node_id
+                            if a.worker_id in self.workers else None),
+                "pid": (self.workers[a.worker_id].pid
+                        if a.worker_id in self.workers else None),
+            } for a in self.actors.values()]}
+
+    def _h_list_tasks(self, msg: dict) -> dict:
+        with self.lock:
+            out = []
+            for wid, spec in self.running.values():
+                out.append({"task_id": spec["task_id"], "name": spec.get("name"),
+                            "state": "RUNNING", "worker_id": wid})
+            for spec in self.pending_tasks:
+                out.append({"task_id": spec["task_id"], "name": spec.get("name"),
+                            "state": "PENDING_SCHEDULING", "worker_id": None})
+            return {"tasks": out}
+
+    def _h_list_objects(self, msg: dict) -> dict:
+        with self.lock:
+            return {"objects": [{
+                "object_id": oid, "state": m.state, "loc": m.loc,
+                "size": m.size, "refcount": m.refcount,
+            } for oid, m in self.objects.items()]}
+
+    def _h_list_workers(self, msg: dict) -> dict:
+        with self.lock:
+            return {"workers": [{
+                "worker_id": w.worker_id, "node_id": w.node_id, "pid": w.pid,
+                "state": w.state, "actor_id": w.actor_id,
+            } for w in self.workers.values()]}
+
+    def _h_store_stats(self, msg: dict) -> dict:
+        return {"stats": self.store.stats()}
+
+    def _h_timeline(self, msg: dict) -> dict:
+        with self.lock:
+            return {"events": list(self.events)}
+
+    def _h_ping(self, msg: dict) -> dict:
+        return {"pong": True, "time": time.time()}
+
+    # ------------------------------------------------------------------ close
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self.cv:
+            procs = [w.proc for w in self.workers.values() if w.proc is not None]
+            self.cv.notify_all()
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + 2
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.05, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.store.shutdown()

@@ -1,0 +1,87 @@
+"""ID types for objects, tasks, actors, jobs, nodes, placement groups.
+
+Reference: ``src/ray/common/id.h`` (SURVEY.md §2.1) — Ray ObjectIDs embed the
+owner (task) id plus a return/put index so ownership is derivable from the id
+alone.  We keep that property: an ``ObjectID`` is
+``<owner_worker_hex16><kind:1><counter_hex10>`` so any process can read the
+owner straight off the id without a directory lookup.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+
+
+def _rand_hex(n: int) -> str:
+    return uuid.uuid4().hex[:n]
+
+
+class _Counter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def next(self) -> int:
+        with self._lock:
+            self._n += 1
+            return self._n
+
+
+class WorkerID(str):
+    @classmethod
+    def new(cls) -> "WorkerID":
+        # pid folded in for human debuggability of logs/ids.
+        return cls(f"{os.getpid():08x}{_rand_hex(8)}")
+
+
+class JobID(str):
+    @classmethod
+    def new(cls) -> "JobID":
+        return cls(_rand_hex(8))
+
+
+class NodeID(str):
+    @classmethod
+    def new(cls) -> "NodeID":
+        return cls(_rand_hex(16))
+
+
+class TaskID(str):
+    @classmethod
+    def new(cls) -> "TaskID":
+        return cls(_rand_hex(16))
+
+
+class ActorID(str):
+    @classmethod
+    def new(cls) -> "ActorID":
+        return cls(_rand_hex(16))
+
+
+class PlacementGroupID(str):
+    @classmethod
+    def new(cls) -> "PlacementGroupID":
+        return cls(_rand_hex(16))
+
+
+KIND_PUT = "p"
+KIND_RETURN = "r"
+
+
+class ObjectID(str):
+    """``<owner16><kind1><counter10>`` — owner-embedding object id."""
+
+    @classmethod
+    def make(cls, owner: str, kind: str, counter: int) -> "ObjectID":
+        assert kind in (KIND_PUT, KIND_RETURN)
+        return cls(f"{owner[:16]:>16s}{kind}{counter:010x}")
+
+    @property
+    def owner(self) -> str:
+        return self[:16]
+
+    @property
+    def is_put(self) -> bool:
+        return self[16] == KIND_PUT

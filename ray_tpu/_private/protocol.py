@@ -1,0 +1,110 @@
+"""Control-plane wire protocol.
+
+Reference analog: the gRPC service layer (``src/ray/rpc/``, SURVEY.md §2.1).
+We use unix-domain sockets via ``multiprocessing.connection`` with pickled
+dict messages — the control plane carries only small metadata (task specs,
+object metas); bulk data rides the shm object plane (``shm_store``).
+
+Connections:
+- **rpc**: client (driver/worker) → GCS, synchronous request/response.
+  One connection per thread (thread-local) so concurrent driver threads
+  (serve router, tune loop) don't serialize on one socket.
+- **task**: GCS → worker push channel (execute_task / create_actor / stop);
+  worker replies with one-way ``task_done`` events on the same socket.
+- **actor**: caller → actor-worker direct channel for ordered method calls
+  (reference: ``ActorTaskSubmitter`` direct gRPC, bypassing the raylet).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import socket
+import threading
+from multiprocessing.connection import Client, Connection, Listener
+from typing import Any, Dict
+
+AUTHKEY = b"ray_tpu"
+
+# request kinds are plain strings in msg["kind"]; responses echo msg["rid"].
+
+
+def make_listener(path: str) -> Listener:
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    return Listener(address=path, family="AF_UNIX", authkey=AUTHKEY)
+
+
+def connect(path: str) -> Connection:
+    return Client(address=path, family="AF_UNIX", authkey=AUTHKEY)
+
+
+class RpcChannel:
+    """Synchronous request/response client over one Connection."""
+
+    _rid_counter = itertools.count(1)
+
+    def __init__(self, conn: Connection):
+        self._conn = conn
+        self._lock = threading.Lock()
+
+    def call(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        rid = next(self._rid_counter)
+        msg = {"kind": kind, "rid": rid, **fields}
+        with self._lock:
+            self._conn.send(msg)
+            while True:
+                resp = self._conn.recv()
+                if resp.get("rid") == rid:
+                    break
+        if resp.get("error") is not None:
+            from ray_tpu._private.serialization import loads_call
+            raise loads_call(resp["error"])
+        return resp
+
+    def send_oneway(self, kind: str, **fields: Any) -> None:
+        with self._lock:
+            self._conn.send({"kind": kind, "rid": None, **fields})
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class RpcPool:
+    """Thread-local RpcChannel factory to a fixed socket path."""
+
+    def __init__(self, path: str, on_new=None):
+        self._path = path
+        self._on_new = on_new
+        self._tls = threading.local()
+        self._all = []
+        self._lock = threading.Lock()
+
+    def channel(self) -> RpcChannel:
+        ch = getattr(self._tls, "ch", None)
+        if ch is None:
+            ch = RpcChannel(connect(self._path))
+            self._tls.ch = ch
+            with self._lock:
+                self._all.append(ch)
+            if self._on_new is not None:
+                self._on_new(ch)
+        return ch
+
+    def call(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        return self.channel().call(kind, **fields)
+
+    def close_all(self) -> None:
+        with self._lock:
+            chans, self._all = self._all, []
+        for ch in chans:
+            ch.close()
+
+
+def hostname() -> str:
+    return socket.gethostname()

@@ -1,0 +1,45 @@
+"""Logging for ray_tpu processes.
+
+Analog of the reference's spdlog-based ``RAY_LOG`` plus the Python log monitor
+that prefixes driver-shipped worker lines with ``(pid=...)`` (reference:
+``src/ray/util/logging.h``, ``python/ray/_private/log_monitor.py``;
+SURVEY.md §5.5).  Workers log to ``<session>/logs/<component>.log``; lines a
+worker prints are also forwarded to the driver over the control-plane socket
+and re-emitted with a ``(component pid=N)`` prefix.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from pathlib import Path
+from typing import Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+_FORMAT = "%(asctime)s %(levelname).1s %(process)d %(name)s] %(message)s"
+_configured = False
+
+
+def setup(component: str, log_dir: Optional[Path] = None) -> logging.Logger:
+    """Configure the process-wide ray_tpu logger once; returns the root logger."""
+    global _configured
+    logger = logging.getLogger("ray_tpu")
+    if not _configured:
+        logger.setLevel(GLOBAL_CONFIG.log_level)
+        fmt = logging.Formatter(_FORMAT)
+        sh = logging.StreamHandler(sys.stderr)
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
+        if log_dir is not None:
+            fh = logging.FileHandler(str(Path(log_dir) / f"{component}-{os.getpid()}.log"))
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+        logger.propagate = False
+        _configured = True
+    return logger
+
+
+def get(name: str) -> logging.Logger:
+    return logging.getLogger(f"ray_tpu.{name}")

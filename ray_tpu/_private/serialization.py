@@ -1,0 +1,168 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Reference: ``python/ray/_private/serialization.py`` + vendored cloudpickle
+(SURVEY.md §2.3) — closures serialized by value; large contiguous buffers
+(numpy / jax host arrays) travel out-of-band so reads are zero-copy views
+onto shared memory; ``ObjectRef``s found inside values are surfaced so the
+control plane can track borrowed references.
+
+Wire layout of a stored object::
+
+    [8B magic+version][8B pickle_len][8B nbuf]
+    [nbuf * 16B (offset,len) table]
+    [pickle bytes][padding to 64][buf0 .. bufN  each 64-aligned]
+
+64-byte alignment keeps numpy views cache-line aligned (and XLA host-buffer
+friendly for the dlpack staging path).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+import cloudpickle
+
+_MAGIC = b"RTPUOBJ1"
+_ALIGN = 64
+_HDR = struct.Struct("<8sQQ")
+_ENT = struct.Struct("<QQ")
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class _RefCollector:
+    """Pickler hook that records ObjectRefs serialized inside a value."""
+
+    def __init__(self) -> None:
+        self.refs: List[Any] = []
+
+    def __call__(self, ref: Any) -> None:
+        self.refs.append(ref)
+
+
+# The custom-serializer registry (ray.util.register_serializer parity).
+_CUSTOM: Dict[Type, Tuple[Callable, Callable]] = {}
+
+
+def register_serializer(cls: Type, *, serializer: Callable, deserializer: Callable) -> None:
+    _CUSTOM[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: Type) -> None:
+    _CUSTOM.pop(cls, None)
+
+
+class _Pickler(cloudpickle.Pickler):
+    def __init__(self, file, protocol, buffer_callback, ref_collector):
+        super().__init__(file, protocol=protocol, buffer_callback=buffer_callback)
+        self._ref_collector = ref_collector
+
+    def persistent_id(self, obj):  # noqa: D401 - pickle hook
+        return None
+
+    def reducer_override(self, obj):
+        from ray_tpu._private.object_ref import ObjectRef, _deserialize_object_ref
+        if isinstance(obj, ObjectRef):
+            if self._ref_collector is not None:
+                self._ref_collector(obj)
+            return (_deserialize_object_ref, (str(obj.id),))
+        ser = _CUSTOM.get(type(obj))
+        if ser is not None:
+            serializer, deserializer = ser
+            return (deserializer, (serializer(obj),))
+        return NotImplemented
+
+
+def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer], List[Any]]:
+    """Returns (pickle_bytes, oob_buffers, contained_object_refs)."""
+    buffers: List[pickle.PickleBuffer] = []
+    collector = _RefCollector()
+    f = io.BytesIO()
+    p = _Pickler(f, protocol=5, buffer_callback=buffers.append,
+                 ref_collector=collector)
+    p.dump(value)
+    return f.getvalue(), buffers, collector.refs
+
+
+def serialized_size(pickled: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    n = _HDR.size + _ENT.size * len(buffers)
+    n = _align(n + len(pickled))
+    for b in buffers:
+        n = _align(n + _raw_view(b).nbytes)
+    return n
+
+
+def _raw_view(b: pickle.PickleBuffer) -> memoryview:
+    """Physical-order byte view of an out-of-band buffer.
+
+    ``raw()`` handles F-contiguous arrays (plain ``cast('B')`` is restricted
+    to C-contiguous views); unpickling rebuilds from the same physical order.
+    """
+    try:
+        return b.raw()
+    except BufferError:
+        v = memoryview(b)
+        return v if (v.ndim == 1 and v.format == "B") else memoryview(bytes(v))
+
+
+def write_to(buf: memoryview, pickled: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    """Write the wire layout into ``buf``; returns bytes written."""
+    views = [_raw_view(b) for b in buffers]
+    off = _HDR.size + _ENT.size * len(views)
+    pickle_off = off
+    off = _align(off + len(pickled))
+    entries = []
+    for v in views:
+        entries.append((off, v.nbytes))
+        off = _align(off + v.nbytes)
+    _HDR.pack_into(buf, 0, _MAGIC, len(pickled), len(views))
+    pos = _HDR.size
+    for e in entries:
+        _ENT.pack_into(buf, pos, *e)
+        pos += _ENT.size
+    buf[pickle_off:pickle_off + len(pickled)] = pickled
+    for (boff, blen), v in zip(entries, views):
+        buf[boff:boff + blen] = v
+    return off
+
+
+def serialize_to_bytes(value: Any) -> Tuple[bytes, List[Any]]:
+    """One-shot: full wire-format bytes (for inline objects / socket transport)."""
+    pickled, buffers, refs = serialize(value)
+    size = serialized_size(pickled, buffers)
+    out = bytearray(size)
+    write_to(memoryview(out), pickled, buffers)
+    return bytes(out), refs
+
+
+def deserialize_from(buf: memoryview) -> Any:
+    """Zero-copy deserialize: numpy arrays view ``buf`` directly.
+
+    Caller must keep the backing mmap alive while views are alive (handled by
+    ``ObjectRef`` pinning its ``MappedObject``).
+    """
+    magic, plen, nbuf = _HDR.unpack_from(buf, 0)
+    if magic != _MAGIC:
+        raise ValueError("corrupt ray_tpu object header")
+    pos = _HDR.size
+    entries = []
+    for _ in range(nbuf):
+        entries.append(_ENT.unpack_from(buf, pos))
+        pos += _ENT.size
+    pickled = bytes(buf[pos:pos + plen])
+    oob = [pickle.PickleBuffer(buf[o:o + l]) for o, l in entries]
+    return pickle.loads(pickled, buffers=oob)
+
+
+def dumps_call(obj: Any) -> bytes:
+    """Plain cloudpickle (functions, task specs over the control socket)."""
+    return cloudpickle.dumps(obj, protocol=5)
+
+
+def loads_call(data: bytes) -> Any:
+    return pickle.loads(data)

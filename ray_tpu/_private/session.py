@@ -1,0 +1,75 @@
+"""Session directory management.
+
+Mirrors the reference's ``/tmp/ray/session_*`` layout (reference:
+``python/ray/_private/node.py``; SURVEY.md §2.3): every ``init()`` creates a
+timestamped session dir holding logs, unix sockets, the object-store spill
+area, and a ``session.json`` descriptor that late-joining processes read to
+find the control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+
+class Session:
+    def __init__(self, root: Optional[str] = None, name: Optional[str] = None):
+        root_dir = Path(root or GLOBAL_CONFIG.session_dir_root)
+        if name is None:
+            stamp = time.strftime("%Y-%m-%d_%H-%M-%S")
+            name = f"session_{stamp}_{os.getpid()}_{uuid.uuid4().hex[:6]}"
+        self.name = name
+        self.path = root_dir / name
+        (self.path / "logs").mkdir(parents=True, exist_ok=True)
+        (self.path / "sockets").mkdir(parents=True, exist_ok=True)
+        (self.path / "spill").mkdir(parents=True, exist_ok=True)
+        latest = root_dir / "session_latest"
+        try:
+            if latest.is_symlink() or latest.exists():
+                latest.unlink()
+            latest.symlink_to(self.path)
+        except OSError:
+            pass  # concurrent sessions racing on the symlink is fine
+
+    @property
+    def log_dir(self) -> Path:
+        return self.path / "logs"
+
+    @property
+    def socket_dir(self) -> Path:
+        return self.path / "sockets"
+
+    @property
+    def spill_dir(self) -> Path:
+        spill = GLOBAL_CONFIG.object_spill_dir
+        return Path(spill) if spill else self.path / "spill"
+
+    def socket_path(self, name: str) -> str:
+        # Unix socket paths are limited to ~107 bytes; keep names short.
+        return str(self.socket_dir / name)
+
+    def write_descriptor(self, info: Dict[str, Any]) -> None:
+        desc = dict(info)
+        desc["session_name"] = self.name
+        desc["hostname"] = socket.gethostname()
+        desc["pid"] = os.getpid()
+        (self.path / "session.json").write_text(json.dumps(desc, indent=2))
+
+    def read_descriptor(self) -> Dict[str, Any]:
+        return json.loads((self.path / "session.json").read_text())
+
+    @classmethod
+    def latest(cls, root: Optional[str] = None) -> "Session":
+        root_dir = Path(root or GLOBAL_CONFIG.session_dir_root)
+        target = (root_dir / "session_latest").resolve()
+        if not target.exists():
+            raise FileNotFoundError("no ray_tpu session found")
+        return cls(root=str(root_dir), name=target.name)

@@ -1,0 +1,701 @@
+"""Worker: the per-process runtime embedded in drivers and workers.
+
+Reference: ``CoreWorker`` (``src/ray/core_worker/``, SURVEY.md §2.1) +
+``python/ray/_private/worker.py``.  One ``Worker`` instance per process:
+
+- drivers and task workers both embed it (the reference embeds CoreWorker in
+  every process via Cython; ours is pure Python talking to the GCS over the
+  control socket and to /dev/shm for data),
+- task submission (``submit``) and the ordered direct actor-call path
+  (``call_actor`` — reference ``ActorTaskSubmitter``: caller ⇄ actor socket,
+  control plane not on the hot path),
+- ``get``/``put``/``wait``/``release`` with zero-copy shm reads,
+- the executor loop run by worker processes (``run_worker_loop``): normal
+  tasks, actor instantiation, the actor method server.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import protocol, rtlog
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import KIND_PUT, KIND_RETURN, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.serialization import (
+    deserialize_from, dumps_call, loads_call, serialize_to_bytes,
+)
+from ray_tpu._private.session import Session
+from ray_tpu._private.shm_store import ShmObjectStore
+from ray_tpu import exceptions as exc
+
+logger = rtlog.get("worker")
+
+_global_worker: Optional["Worker"] = None
+_global_lock = threading.Lock()
+
+
+def global_worker() -> "Worker":
+    if _global_worker is None:
+        raise exc.RayTpuError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def try_global_worker() -> Optional["Worker"]:
+    return _global_worker
+
+
+def set_global_worker(w: Optional["Worker"]) -> None:
+    global _global_worker
+    with _global_lock:
+        _global_worker = w
+
+
+def _counter():
+    n = [0]
+    lock = threading.Lock()
+
+    def nxt() -> int:
+        with lock:
+            n[0] += 1
+            return n[0]
+    return nxt
+
+
+def shm_write_wire(oid: str, wire: bytes, overwrite: bool = False) -> None:
+    """Write pre-serialized wire bytes into the object's shm segment.
+
+    The single shm-segment writer: ``put``, task returns, and actor results
+    all go through here.  ``overwrite=True`` is for lineage reconstruction,
+    which re-creates an object id whose segment may still exist.
+    """
+    import mmap
+    path = f"/dev/shm/rtpu_{oid}"
+    flags = os.O_CREAT | os.O_RDWR | (0 if overwrite else os.O_EXCL)
+    fd = os.open(path, flags, 0o600)
+    try:
+        os.ftruncate(fd, max(len(wire), 1))
+        mm = mmap.mmap(fd, max(len(wire), 1))
+    finally:
+        os.close(fd)
+    try:
+        mm[:len(wire)] = wire
+    finally:
+        mm.close()
+
+
+class _TaskContext(threading.local):
+    def __init__(self):
+        self.task_id: Optional[str] = None
+        self.in_task = False
+
+
+class Worker:
+    def __init__(self, session: Session, role: str, node_id: Optional[str] = None):
+        self.session = session
+        self.role = role
+        self.worker_id = WorkerID.new()
+        self.node_id = node_id
+        self.gcs_path = session.socket_path("gcs.sock")
+        self.pool = protocol.RpcPool(self.gcs_path, on_new=self._on_new_channel)
+        self._put_seq = _counter()
+        self._ret_seq = _counter()
+        self._task_seq = _counter()
+        self._call_seq = _counter()
+        self._fn_cache: Dict[str, Any] = {}
+        self._exported: set = set()
+        self._local_values: "OrderedDict[str, bytes]" = OrderedDict()
+        self._local_lock = threading.Lock()
+        self._actor_channels: Dict[str, "_ActorChannel"] = {}
+        self._actor_chan_lock = threading.Lock()
+        self.ctx = _TaskContext()
+        self._task_conn = None
+        self._task_conn_lock = threading.Lock()
+        self._current_spec: Optional[dict] = None
+        self._exec_thread_id: Optional[int] = None
+        self._stop = threading.Event()
+        self._profile_events: List[dict] = []
+        # registration happens on first channel creation
+        info = self.pool.call("register_client", role=role,
+                              client_id=self.worker_id, pid=os.getpid(),
+                              node_id=node_id)
+        self.node_id = info["node_id"]
+
+    # ------------------------------------------------------------- plumbing
+    def _on_new_channel(self, ch: protocol.RpcChannel) -> None:
+        # Every extra thread-local channel re-registers (idempotent server-side)
+        if getattr(self, "node_id", None) is not None:
+            ch.call("register_client", role=self.role, client_id=self.worker_id,
+                    pid=os.getpid(), node_id=self.node_id)
+
+    def rpc(self, kind: str, **fields: Any) -> dict:
+        return self.pool.call(kind, client_id=self.worker_id, **fields)
+
+    def rpc_oneway(self, kind: str, **fields: Any) -> None:
+        self.pool.channel().send_oneway(kind, client_id=self.worker_id, **fields)
+
+    def _send_event(self, msg: dict) -> None:
+        with self._task_conn_lock:
+            if self._task_conn is not None:
+                try:
+                    self._task_conn.send(msg)
+                except (OSError, ValueError):
+                    pass
+
+    # ------------------------------------------------------------ put / get
+    def put(self, value: Any, _owner_kind: str = KIND_PUT) -> ObjectRef:
+        oid = ObjectID.make(self.worker_id, _owner_kind, self._put_seq())
+        wire, refs = serialize_to_bytes(value)
+        contained = [str(r.id) for r in refs]
+        if len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
+            self.rpc("put_object", object_id=str(oid), loc="inline", data=wire,
+                     size=len(wire), contained=contained, node_id=self.node_id)
+        else:
+            shm_write_wire(str(oid), wire)
+            self.rpc("put_object", object_id=str(oid), loc="shm", size=len(wire),
+                     contained=contained, node_id=self.node_id)
+        return ObjectRef(str(oid), worker=self)
+
+    def _materialize(self, oid: str, meta: dict) -> Any:
+        if meta["state"] == "error":
+            err = deserialize_from(memoryview(meta["data"]))
+            raise err
+        if meta["loc"] == "inline":
+            return deserialize_from(memoryview(meta["data"]))
+        mapped = ShmObjectStore.map_readonly(oid)
+        return deserialize_from(mapped.buf)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        oids = [str(r.id) for r in refs]
+        metas: Dict[str, dict] = {}
+        missing = []
+        with self._local_lock:
+            for oid in oids:
+                data = self._local_values.get(oid)
+                if data is not None:
+                    metas[oid] = {"state": "ready", "loc": "inline", "data": data}
+                else:
+                    missing.append(oid)
+        if missing:
+            blocked = self.ctx.in_task
+            if blocked:
+                self._send_event({"kind": "task_blocked"})
+            try:
+                resp = self.rpc("get_meta", object_ids=missing, timeout=timeout)
+            finally:
+                if blocked:
+                    self._send_event({"kind": "task_unblocked"})
+            metas.update(resp["metas"])
+        return [self._materialize(oid, metas[oid]) for oid in oids]
+
+    def get_one(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
+        return self.get([ref], timeout=timeout)[0]
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError(
+                f"num_returns ({num_returns}) > number of refs ({len(refs)})")
+        by_id = {str(r.id): r for r in refs}
+        with self._local_lock:
+            local_ready = [oid for oid in by_id if oid in self._local_values]
+        if len(local_ready) >= num_returns:
+            ready_set = set(local_ready[:num_returns])
+            return ([r for o, r in by_id.items() if o in ready_set],
+                    [r for o, r in by_id.items() if o not in ready_set])
+        resp = self.rpc("wait", object_ids=list(by_id), num_returns=num_returns,
+                        timeout=timeout)
+        ready = [by_id[o] for o in resp["ready"]]
+        not_ready = [by_id[o] for o in resp["not_ready"]]
+        return ready, not_ready
+
+    def release(self, oid: str) -> None:
+        if not self._stop.is_set():
+            self.rpc_oneway("release", object_id=oid)
+
+    def notify_borrow(self, oid: str) -> None:
+        if not self._stop.is_set():
+            self.rpc_oneway("add_ref", object_id=oid)
+
+    def cache_local(self, oid: str, wire: bytes) -> None:
+        with self._local_lock:
+            self._local_values[oid] = wire
+            while len(self._local_values) > 4096:
+                self._local_values.popitem(last=False)
+
+    # --------------------------------------------------------------- export
+    def export_callable(self, obj: Any) -> str:
+        blob = dumps_call(obj)
+        fn_id = hashlib.sha1(blob).hexdigest()[:16]
+        if fn_id not in self._exported:
+            self.rpc("export_function", fn_id=fn_id, blob=blob)
+            self._exported.add(fn_id)
+        return fn_id
+
+    def fetch_callable(self, fn_id: str) -> Any:
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            resp = self.rpc("fetch_function", fn_id=fn_id)
+            fn = loads_call(resp["blob"])
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    # ------------------------------------------------------- arg marshalling
+    def _pack_args(self, args: tuple, kwargs: dict
+                   ) -> Tuple[dict, List[str], List[str], List[str]]:
+        """Returns (fields, deps, borrows, transient_refs).
+
+        Top-level ObjectRef args are passed by reference and resolved to
+        values before execution (= deps).  Refs nested inside values stay
+        refs (= borrows, pinned for the task's duration).  transient_refs
+        are value-payload objects this call must release after the server
+        has pinned them as deps (returned, not stored on self: concurrent
+        submits from multiple threads must not release each other's refs).
+        """
+        layout = []
+        values = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                layout.append(("ref", str(a.id)))
+            else:
+                layout.append(("val", len(values)))
+                values.append(a)
+        klayout = {}
+        for k, v in kwargs.items():
+            if isinstance(v, ObjectRef):
+                klayout[k] = ("ref", str(v.id))
+            else:
+                klayout[k] = ("val", len(values))
+                values.append(v)
+        wire, refs = serialize_to_bytes(values)
+        borrows = [str(r.id) for r in refs]
+        deps = [oid for tag, oid in
+                [e for e in layout if e[0] == "ref"] +
+                [e for e in klayout.values() if e[0] == "ref"]]
+        fields = {"arg_layout": layout, "kwarg_layout": klayout}
+        transient: List[str] = []
+        if len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
+            fields["values_blob"] = wire
+        else:
+            # big arg payloads ride the object plane, not the control socket
+            vref = self.put(values)
+            fields["values_ref"] = str(vref.id)
+            deps = deps + [str(vref.id)]
+            vref._skip_release = True  # scheduler dep-hold takes over
+            transient.append(str(vref.id))  # drop our ledger ref post-submit
+        return fields, deps, borrows, transient
+
+    def _unpack_args(self, spec: dict) -> Tuple[list, dict]:
+        if "values_blob" in spec:
+            values = deserialize_from(memoryview(spec["values_blob"]))
+        elif "values_ref" in spec:
+            values = self.get_one(ObjectRef(spec["values_ref"], worker=self,
+                                            skip_release=True))
+        else:
+            values = []
+        ref_ids = [oid for tag, oid in spec["arg_layout"] if tag == "ref"] + \
+                  [oid for tag, oid in spec["kwarg_layout"].values() if tag == "ref"]
+        resolved = {}
+        if ref_ids:
+            vals = self.get([ObjectRef(o, worker=self, skip_release=True)
+                             for o in ref_ids])
+            resolved = dict(zip(ref_ids, vals))
+        args = []
+        for tag, v in spec["arg_layout"]:
+            args.append(resolved[v] if tag == "ref" else values[v])
+        kwargs = {}
+        for k, (tag, v) in spec["kwarg_layout"].items():
+            kwargs[k] = resolved[v] if tag == "ref" else values[v]
+        return args, kwargs
+
+    # ------------------------------------------------------------ submission
+    def submit(self, fn: Any, args: tuple, kwargs: dict, *,
+               num_returns: int = 1, num_cpus: float = 1,
+               num_tpus: float = 0, resources: Optional[dict] = None,
+               max_retries: Optional[int] = None, retry_exceptions: bool = False,
+               scheduling_strategy: Any = None, name: Optional[str] = None,
+               runtime_env: Optional[dict] = None) -> List[ObjectRef]:
+        fn_id = self.export_callable(fn)
+        fields, deps, borrows, transient = self._pack_args(args, kwargs)
+        task_id = TaskID.new()
+        return_ids = [str(ObjectID.make(self.worker_id, KIND_RETURN, self._ret_seq()))
+                      for _ in range(num_returns)]
+        spec = {
+            "task_id": task_id, "fn_id": fn_id,
+            "name": name or getattr(fn, "__name__", "task"),
+            "owner": self.worker_id,
+            "return_ids": return_ids, "num_returns": num_returns,
+            "deps": deps, "borrows": borrows,
+            "num_cpus": num_cpus, "num_tpus": num_tpus,
+            "resources": resources or {},
+            "max_retries": (GLOBAL_CONFIG.task_default_max_retries
+                            if max_retries is None else max_retries),
+            "retry_exceptions": retry_exceptions,
+            "scheduling_strategy": scheduling_strategy,
+            "runtime_env": runtime_env,
+            **fields,
+        }
+        self.rpc("submit_task", spec=spec)
+        for oid in transient:
+            self.rpc_oneway("release", object_id=oid)
+        return [ObjectRef(oid, worker=self) for oid in return_ids]
+
+    # ---------------------------------------------------------- actor client
+    def create_actor(self, cls: Any, args: tuple, kwargs: dict, *,
+                     num_cpus: float = 1, num_tpus: float = 0,
+                     resources: Optional[dict] = None,
+                     max_restarts: int = 0, max_task_retries: int = 0,
+                     max_concurrency: int = 1, name: Optional[str] = None,
+                     namespace: str = "default", detached: bool = False,
+                     get_if_exists: bool = False,
+                     scheduling_strategy: Any = None,
+                     runtime_env: Optional[dict] = None) -> dict:
+        class_blob_id = self.export_callable(cls)
+        fields, deps, borrows, transient = self._pack_args(args, kwargs)
+        from ray_tpu._private.ids import ActorID
+        actor_id = ActorID.new()
+        task_id = TaskID.new()
+        method_meta = {
+            m: {"num_returns": getattr(getattr(cls, m), "__ray_num_returns__", 1)}
+            for m in dir(cls) if callable(getattr(cls, m, None))
+            and not m.startswith("__")
+        }
+        spec = {
+            "task_id": task_id, "actor_id": actor_id,
+            "is_actor_creation": True,
+            "class_blob_id": class_blob_id,
+            "class_name": getattr(cls, "__name__", "Actor"),
+            "name": name, "namespace": namespace, "detached": detached,
+            "get_if_exists": get_if_exists,
+            "owner": self.worker_id,
+            "return_ids": [], "num_returns": 0,
+            "deps": deps, "borrows": borrows,
+            "num_cpus": num_cpus, "num_tpus": num_tpus,
+            "resources": resources or {},
+            "max_restarts": max_restarts, "max_task_retries": max_task_retries,
+            "max_concurrency": max_concurrency,
+            "scheduling_strategy": scheduling_strategy,
+            "runtime_env": runtime_env,
+            "method_meta": method_meta,
+            **fields,
+        }
+        resp = self.rpc("create_actor", spec=spec)
+        for oid in transient:
+            self.rpc_oneway("release", object_id=oid)
+        return {"actor_id": resp["actor_id"], "method_meta": method_meta,
+                "existing": resp.get("existing", False)}
+
+    def _actor_channel(self, actor_id: str, max_task_retries: int) -> "_ActorChannel":
+        with self._actor_chan_lock:
+            ch = self._actor_channels.get(actor_id)
+            if ch is None or ch.closed:
+                ch = _ActorChannel(self, actor_id, max_task_retries)
+                self._actor_channels[actor_id] = ch
+            return ch
+
+    def call_actor(self, actor_id: str, method: str, args: tuple, kwargs: dict, *,
+                   num_returns: int = 1, max_task_retries: int = 0) -> List[ObjectRef]:
+        fields, deps, borrows, transient = self._pack_args(args, kwargs)
+        call_id = f"{self.worker_id}:{self._call_seq()}"
+        return_ids = [str(ObjectID.make(self.worker_id, KIND_RETURN, self._ret_seq()))
+                      for _ in range(num_returns)]
+        # hold refs: returns for us, args for the in-flight call
+        self.rpc_oneway("add_refs", object_ids=return_ids)
+        hold = deps + borrows
+        if hold:
+            self.rpc_oneway("add_refs", object_ids=hold,
+                            ledger=f"call:{call_id}")
+        msg = {"kind": "call", "call_id": call_id, "method": method,
+               "return_ids": return_ids, "num_returns": num_returns,
+               "_retries_left": max_task_retries,
+               "arg_ledger": f"call:{call_id}" if hold else None, **fields}
+        ch = self._actor_channel(actor_id, max_task_retries)
+        ch.send_call(msg)
+        for oid in transient:
+            self.rpc_oneway("release", object_id=oid)
+        return [ObjectRef(oid, worker=self) for oid in return_ids]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self.rpc("kill_actor", actor_id=actor_id, no_restart=no_restart)
+
+    # -------------------------------------------------------------- shutdown
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._actor_chan_lock:
+            for ch in self._actor_channels.values():
+                ch.close()
+            self._actor_channels.clear()
+        self.pool.close_all()
+
+    # ====================================================== executor (worker)
+    def run_worker_loop(self) -> None:
+        """Main loop of a spawned worker process."""
+        conn = protocol.connect(self.gcs_path)
+        conn.send({"kind": "attach_task_conn", "worker_id": self.worker_id})
+        with self._task_conn_lock:
+            self._task_conn = conn
+        import queue as _q
+        tasks: "_q.Queue" = _q.Queue()
+
+        def reader():
+            while not self._stop.is_set():
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    self._stop.set()
+                    tasks.put(None)
+                    return
+                kind = msg.get("kind")
+                if kind == "cancel":
+                    self._cancel_current(msg["task_id"])
+                elif kind == "stop_worker":
+                    self._stop.set()
+                    tasks.put(None)
+                    return
+                else:
+                    tasks.put(msg)
+
+        threading.Thread(target=reader, name="task-conn-reader", daemon=True).start()
+        self._exec_thread_id = threading.get_ident()
+        while not self._stop.is_set():
+            msg = tasks.get()
+            if msg is None:
+                break
+            if msg["kind"] == "execute_task":
+                self._execute_task(msg["spec"])
+            elif msg["kind"] == "create_actor":
+                self._become_actor(msg["spec"], tasks)
+        sys.exit(0)
+
+    def _cancel_current(self, task_id: str) -> None:
+        spec = self._current_spec
+        if spec is not None and spec.get("task_id") == task_id \
+                and self._exec_thread_id is not None:
+            import ctypes
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(self._exec_thread_id),
+                ctypes.py_object(exc.TaskCancelledError))
+
+    def _serialize_result(self, value: Any) -> dict:
+        wire, refs = serialize_to_bytes(value)
+        contained = [str(r.id) for r in refs]
+        if len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
+            return {"loc": "inline", "data": wire, "size": len(wire),
+                    "contained": contained}
+        # large: straight to shm
+        oid_placeholder = None  # filled by caller
+        return {"loc": "shm", "wire": wire, "size": len(wire),
+                "contained": contained}
+
+    def _store_results(self, return_ids: List[str], value: Any,
+                       num_returns: int) -> List[dict]:
+        if num_returns == 0:
+            return []
+        values = [value] if num_returns == 1 else list(value)
+        if num_returns > 1 and len(values) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{len(values)} values")
+        out = []
+        for oid, v in zip(return_ids, values):
+            res = self._serialize_result(v)
+            if res["loc"] == "shm":
+                shm_write_wire(oid, res.pop("wire"), overwrite=True)
+            out.append(res)
+        return out
+
+    def _apply_runtime_env(self, spec: dict):
+        env = (spec.get("runtime_env") or {}).get("env_vars") or {}
+        saved = {}
+        for k, v in env.items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        return saved
+
+    def _restore_runtime_env(self, saved: dict) -> None:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _execute_task(self, spec: dict) -> None:
+        t0 = time.time()
+        self._current_spec = spec
+        self.ctx.in_task = True
+        self.ctx.task_id = spec["task_id"]
+        saved_env = self._apply_runtime_env(spec)
+        try:
+            fn = self.fetch_callable(spec["fn_id"])
+            args, kwargs = self._unpack_args(spec)
+            value = fn(*args, **kwargs)
+            results = self._store_results(spec["return_ids"], value,
+                                          spec["num_returns"])
+            self._send_event({"kind": "task_done", "task_id": spec["task_id"],
+                              "status": "ok", "results": results})
+        except Exception as e:  # noqa: BLE001
+            err = e if isinstance(e, exc.RayTaskError) else \
+                exc.RayTaskError.from_exception(spec.get("name", "task"), e)
+            self._send_event({
+                "kind": "task_done", "task_id": spec["task_id"],
+                "status": "app_error",
+                "error": serialize_to_bytes(err)[0]})
+        finally:
+            self._restore_runtime_env(saved_env)
+            self._current_spec = None
+            self.ctx.in_task = False
+            self.ctx.task_id = None
+            if GLOBAL_CONFIG.timeline_enabled:
+                self._send_event({"kind": "profile_events", "events": [{
+                    "name": spec.get("name", "task"), "cat": "task",
+                    "ph": "X", "pid": self.node_id, "tid": os.getpid(),
+                    "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6}]})
+
+    # ------------------------------------------------------------ actor side
+    def _become_actor(self, spec: dict, task_queue) -> None:
+        from ray_tpu._private.actor_server import ActorServer
+        self._current_spec = spec
+        try:
+            cls = self.fetch_callable(spec["class_blob_id"])
+            args, kwargs = self._unpack_args(spec)
+            instance = cls(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            err = exc.RayTaskError.from_exception(
+                spec.get("class_name", "Actor") + ".__init__", e)
+            self._send_event({"kind": "actor_ready", "actor_id": spec["actor_id"],
+                              "status": "error",
+                              "error": serialize_to_bytes(err)[0]})
+            self._current_spec = None
+            return
+        self._current_spec = None
+        server = ActorServer(self, spec, instance)
+        self._send_event({"kind": "actor_ready", "actor_id": spec["actor_id"],
+                          "status": "ok", "addr": server.addr})
+        server.serve_forever()  # returns on exit_actor / stop
+        self._stop.set()
+        task_queue.put(None)
+
+
+class _ActorChannel:
+    """Caller-side direct connection to one actor (pipelined, ordered)."""
+
+    def __init__(self, worker: Worker, actor_id: str, max_task_retries: int):
+        self.worker = worker
+        self.actor_id = actor_id
+        self.max_task_retries = max_task_retries
+        self.closed = False
+        self._lock = threading.Lock()
+        self._outstanding: Dict[str, dict] = {}
+        self._conn = None
+        self._incarnation = -1
+        self._connect(timeout=60.0)
+
+    def _connect(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            info = self.worker.rpc("get_actor_info", actor_id=self.actor_id,
+                                   timeout=max(0.1, deadline - time.monotonic()))
+            if info["state"] == "ALIVE":
+                break
+            if info["state"] == "DEAD":
+                cerr = info.get("creation_error")
+                if cerr is not None:
+                    raise deserialize_from(memoryview(cerr))
+                raise exc.RayActorError(self.actor_id,
+                                        info.get("death_reason") or "actor died")
+            if time.monotonic() > deadline:
+                raise exc.GetTimeoutError(
+                    f"actor {self.actor_id} not ready after {timeout}s")
+            time.sleep(0.05)
+        self._conn = protocol.connect(info["addr"])
+        self._incarnation = info["incarnation"]
+        threading.Thread(target=self._read_loop, args=(self._conn,),
+                         name=f"actor-ch-{self.actor_id[:6]}", daemon=True).start()
+
+    def send_call(self, msg: dict) -> None:
+        with self._lock:
+            if self.closed:
+                raise exc.RayActorError(self.actor_id, "channel closed")
+            self._outstanding[msg["call_id"]] = msg
+            try:
+                self._conn.send(msg)
+            except (OSError, ValueError):
+                # reconnect path handles resubmission via _read_loop EOF
+                pass
+
+    def _read_loop(self, conn) -> None:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            call_id = msg.get("call_id")
+            with self._lock:
+                self._outstanding.pop(call_id, None)
+            for oid, res in zip(msg["return_ids"], msg.get("inline_results") or []):
+                if res is not None:
+                    self.worker.cache_local(oid, res)
+        self._on_disconnect()
+
+    def _on_disconnect(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            pending = dict(self._outstanding)
+            self._outstanding.clear()
+        if not pending:
+            with self._lock:
+                self.closed = True
+            return
+        # actor died with calls in flight: per-call retry budget decides
+        # resubmission vs sealing an error (reference: max_task_retries)
+        resubmit, fail = {}, {}
+        for call_id, msg in pending.items():
+            left = msg.get("_retries_left", 0)
+            if left != 0:
+                msg["_retries_left"] = left - 1 if left > 0 else -1
+                resubmit[call_id] = msg
+            else:
+                fail[call_id] = msg
+        if resubmit:
+            try:
+                self._connect(timeout=60.0)
+                with self._lock:
+                    for call_id, msg in resubmit.items():
+                        self._outstanding[call_id] = msg
+                        try:
+                            self._conn.send(msg)
+                        except (OSError, ValueError):
+                            break
+            except (exc.RayTpuError, OSError) as e:
+                fail.update(resubmit)
+                with self._lock:
+                    self.closed = True
+        if fail:
+            err_wire = serialize_to_bytes(
+                exc.RayActorError(self.actor_id,
+                                  "actor died with calls in flight"))[0]
+            oids = [oid for msg in fail.values() for oid in msg["return_ids"]]
+            try:
+                self.worker.rpc("seal_errors", object_ids=oids, error=err_wire)
+            except Exception:  # noqa: BLE001 - gcs also going down
+                pass
+        if not resubmit:
+            with self._lock:
+                self.closed = True
+
+    def close(self) -> None:
+        with self._lock:
+            self.closed = True
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass

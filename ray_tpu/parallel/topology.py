@@ -1,0 +1,101 @@
+"""TPU slice topologies.
+
+The scheduler's "GPU count" analog is a *topology-shaped reservation*
+(SURVEY.md §7.1): a pod slice like ``v4-32`` is 4 hosts × 4 chips wired into
+one ICI domain and must be leased atomically.  This module is the registry
+mapping topology names → (hosts, chips, ICI mesh shape) used by placement
+groups, the mesh builder, and the collective layer.
+
+Chip counts follow the public naming convention: the suffix is chip count
+for v4/v5p (which have 2 TensorCores/chip, "megacore" on v4), and chips for
+v5e/v6e as well (1 core/chip).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class SliceSpec:
+    name: str                 # e.g. "v4-32"
+    generation: str           # "v4"
+    num_chips: int
+    chips_per_host: int
+    ici_mesh: Tuple[int, ...]  # physical ICI mesh shape (chips)
+    megacore: bool            # 2 TensorCores fused per chip
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.chips_per_host)
+
+
+# chips per host and megacore by generation
+_GEN = {
+    "v2": (8, False), "v3": (8, False),
+    "v4": (4, True), "v5p": (4, True),
+    "v5e": (4, False), "v5litepod": (4, False),
+    "v6e": (4, False),
+}
+
+
+def _default_mesh(num_chips: int) -> Tuple[int, ...]:
+    """Factor a chip count into a near-cubic 3D torus shape (v4-style)."""
+    if num_chips <= 4:
+        return (num_chips,) if num_chips else (1,)
+    best = (num_chips, 1, 1)
+    for x in range(1, int(round(num_chips ** (1 / 3))) + 2):
+        if num_chips % x:
+            continue
+        rest = num_chips // x
+        for y in range(x, int(rest ** 0.5) + 1):
+            if rest % y:
+                continue
+            cand = (x, y, rest // y)
+            if max(cand) - min(cand) < max(best) - min(best):
+                best = cand
+    return tuple(sorted(best))
+
+
+def slice_spec(topology: str) -> SliceSpec:
+    """Parse ``v4-32`` / ``v5e-8`` / ``v5p-128`` style names."""
+    m = re.fullmatch(r"(v\d+[a-z]*|v5litepod)-(\d+)", topology.strip().lower())
+    if m is None:
+        raise ValueError(f"unrecognized TPU topology {topology!r} "
+                         "(expected e.g. 'v4-32', 'v5e-8')")
+    gen, n = m.group(1), int(m.group(2))
+    if gen not in _GEN:
+        raise ValueError(f"unknown TPU generation {gen!r}")
+    chips_per_host, megacore = _GEN[gen]
+    return SliceSpec(name=topology, generation=gen, num_chips=n,
+                     chips_per_host=min(chips_per_host, n),
+                     ici_mesh=_default_mesh(n), megacore=megacore)
+
+
+def detect_local_topology() -> Optional[SliceSpec]:
+    """Best-effort: infer the attached slice from the jax device list."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    if GLOBAL_CONFIG.tpu_topology:
+        return slice_spec(GLOBAL_CONFIG.tpu_topology)
+    try:
+        import jax
+        devs = [d for d in jax.devices() if d.platform != "cpu"]
+    except Exception:  # noqa: BLE001
+        return None
+    if not devs:
+        return None
+    kind = getattr(devs[0], "device_kind", "").lower()
+    gen = "v4"
+    for g in _GEN:
+        if g in kind.replace(" ", ""):
+            gen = g
+    if "v5 lite" in kind or "v5e" in kind:
+        gen = "v5e"
+    return slice_spec(f"{gen}-{len(devs)}")
+
+
+def ici_domain_label(slice_name: str, slice_idx: int = 0) -> Dict[str, str]:
+    """Node labels marking co-membership in one ICI domain (for STRICT_PACK)."""
+    return {"ici_domain": f"{slice_name}/{slice_idx}"}

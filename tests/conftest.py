@@ -1,0 +1,51 @@
+"""Test rig (SURVEY.md §4 testing blueprint).
+
+- CPU JAX with 8 virtual devices stands in for a TPU slice so all
+  collective / pjit / shard_map paths run in CI without hardware
+  (reference pattern: gloo CPU tests standing in for NCCL).
+- ``ray_start_regular`` starts a fresh single-node cluster per test;
+  ``ray_start_cluster`` yields a multi-node ``Cluster`` fixture.
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RTPU_OBJECT_STORE_MEMORY_MB", "256")
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu.cluster_utils import Cluster  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2_cpus():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _ensure_shutdown():
+    yield
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()

@@ -1,0 +1,220 @@
+"""Actor semantics (reference: python/ray/tests/test_actor*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError, RayTaskError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, by=1):
+        self.n += by
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(5)) == 6
+    assert ray_tpu.get(c.read.remote()) == 6
+
+
+def test_actor_constructor_args(ray_start_regular):
+    c = Counter.remote(start=100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_calls_ordered(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("nope")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(RayTaskError) as ei:
+        ray_tpu.get(b.fail.remote())
+    assert "nope" in str(ei.value)
+    # actor survives method errors
+    assert ray_tpu.get(b.ok.remote()) == "fine"
+
+
+def test_actor_constructor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("ctor boom")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((RayTaskError, RayActorError)):
+        ray_tpu.get(b.m.remote(), timeout=10)
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="global_counter").remote()
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.inc.remote()) == 1
+    h2 = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h2.inc.remote()) == 2
+
+
+def test_named_actor_duplicate_rejected(ray_start_regular):
+    Counter.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="gie", get_if_exists=True).remote()
+    ray_tpu.get(a.inc.remote())
+    b = Counter.options(name="gie", get_if_exists=True).remote()
+    assert ray_tpu.get(b.read.remote()) == 1
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(c.inc.remote(), timeout=15)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class Crashy:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Crashy.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ray_tpu.kill(c, no_restart=False)
+    time.sleep(0.5)
+    # restarted: state reset, calls work again
+    assert ray_tpu.get(c.inc.remote(), timeout=30) == 1
+
+
+def test_actor_restart_after_crash_method(ray_start_regular):
+    @ray_tpu.remote(max_restarts=2, max_task_retries=1)
+    class Crashy:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            import os
+            os._exit(1)
+
+    c = Crashy.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    c.die.remote()  # crashes; the retried call crashes the restart too
+    time.sleep(1.0)
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+
+
+def test_actor_no_restart_raises(ray_start_regular):
+    @ray_tpu.remote
+    class Fragile:
+        def die(self):
+            import os
+            os._exit(1)
+
+        def m(self):
+            return 1
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.m.remote()) == 1
+    f.die.remote()
+    with pytest.raises(RayActorError):
+        ray_tpu.get(f.m.remote(), timeout=15)
+
+
+def test_pass_actor_handle(ray_start_regular):
+    @ray_tpu.remote
+    def use_counter(h):
+        return ray_tpu.get(h.inc.remote(10))
+
+    c = Counter.remote()
+    assert ray_tpu.get(use_counter.remote(c)) == 10
+    assert ray_tpu.get(c.read.remote()) == 10
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class AsyncActor:
+        async def slow_echo(self, x):
+            import asyncio
+            await asyncio.sleep(0.2)
+            return x
+
+    a = AsyncActor.remote()
+    t0 = time.time()
+    refs = [a.slow_echo.remote(i) for i in range(4)]
+    assert ray_tpu.get(refs, timeout=20) == [0, 1, 2, 3]
+    assert time.time() - t0 < 2.0  # ran concurrently
+
+
+def test_exit_actor(ray_start_regular):
+    @ray_tpu.remote(max_restarts=5)
+    class Quitter:
+        def quit(self):
+            from ray_tpu._private.actor_server import exit_actor
+            exit_actor()
+
+        def m(self):
+            return 1
+
+    q = Quitter.remote()
+    assert ray_tpu.get(q.m.remote()) == 1
+    q.quit.remote()
+    # intentional exit: no restart even though max_restarts > 0
+    with pytest.raises(RayActorError):
+        ray_tpu.get(q.m.remote(), timeout=15)
+
+
+def test_actor_large_payload(ray_start_regular):
+    import numpy as np
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.arr = None
+
+        def store(self, arr):
+            self.arr = arr
+            return arr.nbytes
+
+        def fetch(self):
+            return self.arr
+
+    h = Holder.remote()
+    arr = np.random.default_rng(0).standard_normal(300_000)
+    assert ray_tpu.get(h.store.remote(arr)) == arr.nbytes
+    out = ray_tpu.get(h.fetch.remote())
+    assert (out == arr).all()

@@ -1,0 +1,157 @@
+"""Core API semantics (reference: python/ray/tests/test_basic*.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, RayTaskError
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+
+
+def test_put_get_numpy_zero_copy(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    # large arrays come back as read-only views onto shm
+    assert not out.flags.writeable
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_arg(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)  # top-level ref resolved to value
+    assert ray_tpu.get(r2) == 40
+
+
+def test_task_kwargs_and_large_args(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=None):
+        return a.sum() + b
+
+    big = np.ones(500_000, dtype=np.float64)
+    assert ray_tpu.get(f.remote(big, b=5)) == 500_005.0
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1)) == 12
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom!")
+
+    with pytest.raises(RayTaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "boom!" in str(ei.value)
+
+
+def test_error_propagates_through_deps(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("origin")
+
+    @ray_tpu.remote
+    def use(x):
+        return x
+
+    with pytest.raises(RayTaskError) as ei:
+        ray_tpu.get(use.remote(boom.remote()))
+    assert "origin" in str(ei.value)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=4)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote
+    def whoami():
+        return "ok"
+
+    assert ray_tpu.get(whoami.options(num_cpus=2, name="custom").remote()) == "ok"
+
+
+def test_refs_inside_containers_stay_refs(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return 7
+
+    @ray_tpu.remote
+    def takes_list(refs):
+        # nested refs arrive as refs, not values (reference semantics)
+        assert all(isinstance(r, ray_tpu.ObjectRef) for r in refs)
+        return ray_tpu.get(refs)
+
+    refs = [make.remote() for _ in range(3)]
+    assert ray_tpu.get(takes_list.remote(refs)) == [7, 7, 7]
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 4.0
+
+
+def test_many_small_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
