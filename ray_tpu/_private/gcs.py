@@ -897,10 +897,13 @@ class GcsServer:
                     meta = self.objects.get(oid)
                     if meta is None or meta.state == PENDING:
                         pending.append(oid)
-                    elif meta.state == READY and meta.loc in ("shm", "spilled") \
-                            and not self.store.restore(oid) \
-                            and not ShmObjectStore.exists_in_shm(oid):
-                        missing_lost.append((oid, meta))
+                    elif meta.state == READY and meta.loc in ("shm", "spilled"):
+                        # the filesystem is the truth, not our bookkeeping:
+                        # a segment can vanish under us (node loss, eviction
+                        # races, operator cleanup) → reconstruction path
+                        self.store.restore(oid)
+                        if not ShmObjectStore.exists_in_shm(oid):
+                            missing_lost.append((oid, meta))
                 for oid, meta in missing_lost:
                     self._mark_object_lost(oid, meta)
                 if missing_lost:

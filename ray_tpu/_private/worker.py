@@ -193,7 +193,21 @@ class Worker:
                 if blocked:
                     self._send_event({"kind": "task_unblocked"})
             metas.update(resp["metas"])
-        return [self._materialize(oid, metas[oid]) for oid in oids]
+        out = []
+        for oid in oids:
+            for attempt in range(3):
+                try:
+                    out.append(self._materialize(oid, metas[oid]))
+                    break
+                except FileNotFoundError:
+                    # segment vanished between meta reply and mmap (loss or
+                    # eviction race): re-resolve, which triggers
+                    # reconstruction server-side
+                    if attempt == 2:
+                        raise exc.ObjectLostError(oid, "shm segment vanished")
+                    resp = self.rpc("get_meta", object_ids=[oid], timeout=timeout)
+                    metas[oid] = resp["metas"][oid]
+        return out
 
     def get_one(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
         return self.get([ref], timeout=timeout)[0]

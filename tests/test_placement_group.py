@@ -1,0 +1,83 @@
+"""PG semantics (reference: python/ray/tests/test_placement_group*.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (
+    placement_group, placement_group_table, remove_placement_group,
+    tpu_slice_bundles,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_pg_pack_ready(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=5)
+    table = placement_group_table()
+    assert table[pg.id]["state"] == "ready"
+    # PACK on one node → same node for both bundles
+    assert len(set(table[pg.id]["assignment"])) == 1
+    remove_placement_group(pg)
+
+
+def test_pg_task_uses_bundle(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout_seconds=5)
+
+    @ray_tpu.remote
+    def inside():
+        return "in-pg"
+
+    strat = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    assert ray_tpu.get(inside.options(
+        scheduling_strategy=strat).remote(), timeout=20) == "in-pg"
+    remove_placement_group(pg)
+
+
+def test_pg_infeasible_until_node_added(ray_start_cluster):
+    cluster = ray_start_cluster
+    pg = placement_group([{"CPU": 8}], strategy="PACK")
+    assert not pg.wait(timeout_seconds=0.5)
+    cluster.add_node(num_cpus=8)
+    assert pg.wait(timeout_seconds=10)
+
+
+def test_strict_spread_needs_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=10)
+    table = placement_group_table()
+    assert len(set(table[pg.id]["assignment"])) == 3
+
+
+def test_strict_pack_single_ici_domain(ray_start_cluster):
+    cluster = ray_start_cluster
+    # two hosts of one slice share an ici_domain label
+    cluster.add_node(num_cpus=1, num_tpus=4, labels={"ici_domain": "v4-16/0"})
+    cluster.add_node(num_cpus=1, num_tpus=4, labels={"ici_domain": "v4-16/0"})
+    # a different slice
+    cluster.add_node(num_cpus=1, num_tpus=4, labels={"ici_domain": "v4-16/1"})
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="STRICT_PACK")
+    assert pg.wait(timeout_seconds=10)
+    table = placement_group_table()
+    assigned = table[pg.id]["assignment"]
+    nodes = {n["node_id"]: n for n in ray_tpu.nodes()}
+    domains = {nodes[a]["labels"].get("ici_domain") for a in assigned}
+    assert len(domains) == 1  # all bundles inside one ICI domain
+
+
+def test_pg_removal_frees_resources(ray_start_regular):
+    pg = placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.wait(timeout_seconds=5)
+    assert ray_tpu.available_resources().get("CPU", 0) == 0
+    remove_placement_group(pg)
+    assert ray_tpu.available_resources().get("CPU", 0) == 4
+
+
+def test_tpu_slice_bundles():
+    bundles = tpu_slice_bundles("v4-32")
+    assert bundles == [{"TPU": 4.0}] * 8
+    bundles = tpu_slice_bundles("v5e-8")
+    assert bundles == [{"TPU": 4.0}] * 2
