@@ -890,6 +890,7 @@ class GcsServer:
             else time.monotonic() + msg["timeout"]
         oids = msg["object_ids"]
         with self.cv:
+            verify_fs = True
             while True:
                 missing_lost = []
                 pending = []
@@ -897,14 +898,24 @@ class GcsServer:
                     meta = self.objects.get(oid)
                     if meta is None or meta.state == PENDING:
                         pending.append(oid)
-                    elif meta.state == READY and meta.loc in ("shm", "spilled"):
+                    elif verify_fs and meta.state == READY and \
+                            meta.loc in ("shm", "spilled"):
                         # the filesystem is the truth, not our bookkeeping:
                         # a segment can vanish under us (node loss, eviction
-                        # races, operator cleanup) → reconstruction path
+                        # races, operator cleanup) → reconstruction path.
+                        # Checked once per get_meta call, not on every cv
+                        # wakeup — the worker retries on FileNotFoundError,
+                        # which covers races after this point.
                         self.store.restore(oid)
                         if not ShmObjectStore.exists_in_shm(oid):
                             missing_lost.append((oid, meta))
+                verify_fs = False
                 for oid, meta in missing_lost:
+                    # purge stale store bookkeeping first: the segment is
+                    # gone, but _sealed/_used may still account for it, which
+                    # would corrupt capacity tracking and crash later
+                    # evictions (os.replace on a nonexistent path)
+                    self.store.delete_object(oid)
                     self._mark_object_lost(oid, meta)
                 if missing_lost:
                     self._pump_locked()

@@ -174,6 +174,7 @@ class Worker:
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         oids = [str(r.id) for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
         metas: Dict[str, dict] = {}
         missing = []
         with self._local_lock:
@@ -184,15 +185,7 @@ class Worker:
                 else:
                     missing.append(oid)
         if missing:
-            blocked = self.ctx.in_task
-            if blocked:
-                self._send_event({"kind": "task_blocked"})
-            try:
-                resp = self.rpc("get_meta", object_ids=missing, timeout=timeout)
-            finally:
-                if blocked:
-                    self._send_event({"kind": "task_unblocked"})
-            metas.update(resp["metas"])
+            metas.update(self._blocking_get_meta(missing, deadline))
         out = []
         for oid in oids:
             for attempt in range(3):
@@ -205,9 +198,26 @@ class Worker:
                     # reconstruction server-side
                     if attempt == 2:
                         raise exc.ObjectLostError(oid, "shm segment vanished")
-                    resp = self.rpc("get_meta", object_ids=[oid], timeout=timeout)
-                    metas[oid] = resp["metas"][oid]
+                    metas.update(self._blocking_get_meta([oid], deadline))
         return out
+
+    def _blocking_get_meta(self, oids: List[str],
+                           deadline: Optional[float]) -> dict:
+        """get_meta RPC that (a) releases this task's CPU while blocked so
+        dependency/reconstruction tasks can schedule, and (b) honors the
+        caller's overall deadline across retries."""
+        remaining = None
+        if deadline is not None:
+            remaining = max(0.0, deadline - time.monotonic())
+        blocked = self.ctx.in_task
+        if blocked:
+            self._send_event({"kind": "task_blocked"})
+        try:
+            resp = self.rpc("get_meta", object_ids=oids, timeout=remaining)
+        finally:
+            if blocked:
+                self._send_event({"kind": "task_unblocked"})
+        return resp["metas"]
 
     def get_one(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
         return self.get([ref], timeout=timeout)[0]

@@ -1,0 +1,240 @@
+"""GPT-2 family, TPU-first (flagship model for baseline #5, BASELINE.md).
+
+The reference framework (Ray) ships no models — its GPT-2 benchmark runs
+torch + DeepSpeed inside Train worker actors (reference:
+``python/ray/train/``).  Here the model is a first-class citizen so the
+trainer, the mesh layer, and the benchmarks have a common flagship.
+
+Design notes (TPU-first, not a torch translation):
+- Pure-JAX pytree params (nested dicts) — transparent to `ray_tpu.parallel.
+  mesh` regex sharding rules, `jax.tree_util`, and Orbax checkpointing.
+- Per-layer params are STACKED on a leading ``n_layer`` axis and the forward
+  pass is a single ``lax.scan`` over blocks: one trace/compile of one block
+  regardless of depth (compile-time O(1) in layers), and the leading axis is
+  what pipeline parallelism shards.
+- ``jax.checkpoint`` (remat) around each block trades FLOPs for HBM.
+- bf16 activations / f32 params+optimizer by default: MXU-native.
+- Attention is pluggable (``attn_impl``): dense causal (XLA fuses to a good
+  kernel), or ring/Ulysses context-parallel kernels from ``ray_tpu.ops``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+AttnImpl = Callable[..., jax.Array]  # (q, k, v, config) -> out
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dtype: Any = jnp.bfloat16          # activation dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attn_impl: str = "dense"           # "dense" | "ring" | "ulysses"
+    context_axis: Optional[str] = None  # mesh axis for SP/CP ("context")
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+# Presets (approx. parameter counts follow the GPT-2 paper sizes).
+def gpt2_small() -> GPT2Config:   # 124M
+    return GPT2Config(n_embd=768, n_layer=12, n_head=12)
+
+
+def gpt2_medium() -> GPT2Config:  # 350M
+    return GPT2Config(n_embd=1024, n_layer=24, n_head=16)
+
+
+def gpt2_large() -> GPT2Config:   # 774M
+    return GPT2Config(n_embd=1280, n_layer=36, n_head=20)
+
+
+def gpt2_xl() -> GPT2Config:      # 1.5B — baseline #5 flagship
+    return GPT2Config(n_embd=1600, n_layer=48, n_head=25)
+
+
+def tiny(vocab: int = 256, seq: int = 64) -> GPT2Config:
+    """Tiny config for tests and multi-chip dry-runs."""
+    return GPT2Config(vocab_size=vocab, n_positions=seq, n_embd=64,
+                      n_layer=2, n_head=4)
+
+
+PRESETS = {"gpt2": gpt2_small, "gpt2-124m": gpt2_small,
+           "gpt2-medium": gpt2_medium, "gpt2-large": gpt2_large,
+           "gpt2-xl": gpt2_xl, "gpt2-1.5b": gpt2_xl, "tiny": tiny}
+
+
+# ------------------------------------------------------------------- params
+def _dense_init(key, shape, dtype, scale=0.02):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init_params(rng: jax.Array, cfg: GPT2Config) -> Params:
+    """Initialize params; block leaves stacked on a leading n_layer axis."""
+    pd = cfg.param_dtype
+    E, H, L = cfg.n_embd, cfg.n_head, cfg.n_layer
+    k = iter(jax.random.split(rng, 8 + 4 * L))
+
+    def stack(f):
+        return jnp.stack([f(next(k), i) for i in range(L)])
+
+    blocks = {
+        "ln_1": {"scale": jnp.ones((L, E), pd), "bias": jnp.zeros((L, E), pd)},
+        "attn_qkv": {
+            "kernel": stack(lambda kk, i: _dense_init(kk, (E, 3, E), pd)),
+            "bias": jnp.zeros((L, 3, E), pd),
+        },
+        "attn_out": {
+            # GPT-2 residual-scaled init: 1/sqrt(2*L)
+            "kernel": stack(lambda kk, i: _dense_init(
+                kk, (E, E), pd, 0.02 / math.sqrt(2 * L))),
+            "bias": jnp.zeros((L, E), pd),
+        },
+        "ln_2": {"scale": jnp.ones((L, E), pd), "bias": jnp.zeros((L, E), pd)},
+        "mlp_in": {
+            "kernel": stack(lambda kk, i: _dense_init(kk, (E, 4 * E), pd)),
+            "bias": jnp.zeros((L, 4 * E), pd),
+        },
+        "mlp_out": {
+            "kernel": stack(lambda kk, i: _dense_init(
+                kk, (4 * E, E), pd, 0.02 / math.sqrt(2 * L))),
+            "bias": jnp.zeros((L, E), pd),
+        },
+    }
+    return {
+        "wte": _dense_init(next(k), (cfg.vocab_size, E), pd),
+        "wpe": _dense_init(next(k), (cfg.n_positions, E), pd, 0.01),
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.ones((E,), pd), "bias": jnp.zeros((E,), pd)},
+    }
+
+
+def param_count(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+# ------------------------------------------------------------------ forward
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def dense_causal_attention(q, k, v, cfg: GPT2Config) -> jax.Array:
+    """Reference attention: (B, T, H, D) → (B, T, H, D). XLA fuses this well
+    on the MXU for moderate T; long-context paths use ray_tpu.ops kernels."""
+    del cfg
+    T = q.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _resolve_attn(cfg: GPT2Config) -> AttnImpl:
+    if cfg.attn_impl == "dense":
+        return dense_causal_attention
+    if cfg.attn_impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention_for_model
+        return partial(ring_attention_for_model, axis_name=cfg.context_axis)
+    if cfg.attn_impl == "ulysses":
+        from ray_tpu.ops.ulysses import ulysses_attention_for_model
+        return partial(ulysses_attention_for_model, axis_name=cfg.context_axis)
+    raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+
+
+def _block(x: jax.Array, lp: Params, cfg: GPT2Config,
+           attn: AttnImpl) -> jax.Array:
+    B, T, E = x.shape
+    H, D = cfg.n_head, cfg.head_dim
+    h = _layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"])
+    qkv = jnp.einsum("bte,eck->btck",
+                     h, lp["attn_qkv"]["kernel"].astype(cfg.dtype))
+    qkv = qkv + lp["attn_qkv"]["bias"].astype(cfg.dtype)
+    q, k, v = [qkv[:, :, i, :].reshape(B, T, H, D) for i in range(3)]
+    a = attn(q, k, v, cfg).reshape(B, T, E)
+    a = a @ lp["attn_out"]["kernel"].astype(cfg.dtype) \
+        + lp["attn_out"]["bias"].astype(cfg.dtype)
+    x = x + a
+    h = _layer_norm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"])
+    h = h @ lp["mlp_in"]["kernel"].astype(cfg.dtype) \
+        + lp["mlp_in"]["bias"].astype(cfg.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ lp["mlp_out"]["kernel"].astype(cfg.dtype) \
+        + lp["mlp_out"]["bias"].astype(cfg.dtype)
+    return x + h
+
+
+def forward(params: Params, tokens: jax.Array,
+            cfg: GPT2Config) -> jax.Array:
+    """tokens (B, T) int32 → logits (B, T, vocab) in f32."""
+    B, T = tokens.shape
+    attn = _resolve_attn(cfg)
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    if cfg.context_axis is not None:
+        # Sequence is sharded: each shard holds a contiguous T-chunk whose
+        # global offset is shard_index * T (ring/Ulysses kernels handle the
+        # cross-shard attention; positions must be global).
+        idx = lax.axis_index(cfg.context_axis)
+        pos = idx * T + jnp.arange(T)
+    else:
+        pos = jnp.arange(T)
+    x = x + params["wpe"].astype(cfg.dtype)[pos]
+
+    block = partial(_block, cfg=cfg, attn=attn)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(carry, lp):
+        return block(carry, lp), None
+
+    x, _ = lax.scan(scan_body, x, params["blocks"])
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(cfg.dtype))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: GPT2Config) -> jax.Array:
+    """Next-token cross entropy. batch: {"tokens": (B, T+1) int32} or
+    {"inputs","targets"} pair of (B, T)."""
+    if "inputs" in batch:
+        inp, tgt = batch["inputs"], batch["targets"]
+    else:
+        inp, tgt = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    logits = forward(params, inp, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def flops_per_token(cfg: GPT2Config, seq_len: int) -> float:
+    """Approximate train-step FLOPs/token (fwd+bwd ≈ 6*N + attention term)."""
+    n = param_count_analytic(cfg)
+    attn = 12 * cfg.n_layer * cfg.n_embd * seq_len  # 2*2*3 * L * E * T
+    return 6 * n + attn
+
+
+def param_count_analytic(cfg: GPT2Config) -> int:
+    E, L, V, Pn = cfg.n_embd, cfg.n_layer, cfg.vocab_size, cfg.n_positions
+    per_layer = 12 * E * E + 13 * E
+    return V * E + Pn * E + L * per_layer + 2 * E

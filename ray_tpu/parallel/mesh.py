@@ -1,0 +1,207 @@
+"""Device-mesh assembly and sharding rules (the GSPMD heart of the framework).
+
+The reference (Ray) has no notion of a device mesh: its parallelism is
+"N actors + NCCL process groups" (reference: ``python/ray/util/collective``,
+``python/ray/train/_internal/backend_executor.py``).  The TPU-native design
+inverts this (SURVEY.md §7.1): parallelism *inside* a worker group is a single
+compiled pjit/shard_map program over a ``jax.sharding.Mesh``, and the
+framework's job is assembling that mesh and placing named shardings.
+
+Canonical logical mesh axes (superset of every parallelism the reference's
+ecosystem reaches via third-party libs, SURVEY.md §2.4):
+
+======== ============================================ =====================
+axis     shards                                       collective traffic
+======== ============================================ =====================
+data     batch (pure DP)                              grad allreduce
+fsdp     batch + parameter shards (ZeRO-3 style)      allgather/reducescatter
+pipeline transformer layer blocks (PP stages)         ppermute activations
+context  sequence dimension (SP/CP, ring attention)   ppermute KV blocks
+tensor   hidden/heads (Megatron TP)                   allreduce activations
+expert   MoE experts (EP)                             all-to-all tokens
+======== ============================================ =====================
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "fsdp", "pipeline", "context", "tensor", "expert")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical parallelism layout; -1 on ``data`` absorbs remaining devices."""
+
+    data: int = -1
+    fsdp: int = 1
+    pipeline: int = 1
+    context: int = 1
+    tensor: int = 1
+    expert: int = 1
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        sizes = self.as_dict()
+        fixed = [v for v in sizes.values() if v != -1]
+        free = [k for k, v in sizes.items() if v == -1]
+        if len(free) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        prod = math.prod(fixed)
+        if free:
+            if n_devices % prod:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[free[0]] = n_devices // prod
+        elif prod != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {prod} devices, have {n_devices}")
+        return MeshConfig(**sizes)
+
+    def as_dict(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXES}
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(v for v in self.as_dict().values())
+
+    def batch_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the global batch is sharded over."""
+        return tuple(a for a in ("data", "fsdp") if self.as_dict()[a] != 1) \
+            or ("data",)
+
+
+def build_mesh(config: MeshConfig,
+               devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """Assemble a ``jax.sharding.Mesh`` with the canonical axis names.
+
+    Axis order puts ``pipeline``/``data`` outermost (DCN-friendly) and
+    ``tensor`` innermost (highest-traffic → shortest ICI hops), matching how
+    ``jax.experimental.mesh_utils`` assigns physical adjacency.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    cfg = config.resolved(len(devices))
+    shape = tuple(cfg.as_dict()[a] for a in AXES)
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices))
+    except Exception:  # noqa: BLE001 - heterogeneous/virtual devices
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh(device: Optional[Any] = None) -> Mesh:
+    dev = device if device is not None else jax.devices()[0]
+    return Mesh(np.asarray([dev]).reshape((1,) * len(AXES)), AXES)
+
+
+# --------------------------------------------------------------------------
+# Logical → physical sharding rules (t5x-style, but regex over param paths).
+# --------------------------------------------------------------------------
+
+# (param-path regex, PartitionSpec) — first match wins.  Paths are
+# "/"-joined pytree keys, e.g. "blocks/attn_qkv/kernel".
+Rules = List[Tuple[str, P]]
+
+# Megatron-style 2D(+) sharding for transformer blocks.  ``fsdp`` shards the
+# non-tensor dim of every matrix (ZeRO-3); ``tensor`` shards heads/hidden.
+# ``blocks/...`` params are STACKED with a leading n_layer axis (lax.scan
+# layout, see ray_tpu/models/gpt2.py) — that axis maps to ``pipeline``
+# (size 1 unless PP is on, in which case stages own layer ranges).
+TRANSFORMER_RULES: Rules = [
+    (r".*wte$",                     P("tensor", "fsdp")),   # (vocab, embed)
+    (r".*wpe$",                     P(None, "fsdp")),       # (pos, embed)
+    (r".*blocks/attn_qkv/kernel$",  P("pipeline", "fsdp", None, "tensor")),
+    (r".*blocks/attn_qkv/bias$",    P("pipeline", None, "tensor")),
+    (r".*blocks/attn_out/kernel$",  P("pipeline", "tensor", "fsdp")),
+    (r".*blocks/attn_out/bias$",    P("pipeline", "fsdp")),
+    (r".*blocks/mlp_in/kernel$",    P("pipeline", "fsdp", "tensor")),
+    (r".*blocks/mlp_in/bias$",      P("pipeline", "tensor")),
+    (r".*blocks/mlp_out/kernel$",   P("pipeline", "tensor", "fsdp")),
+    (r".*blocks/mlp_out/bias$",     P("pipeline", "fsdp")),
+    (r".*blocks/(ln_1|ln_2)/(scale|bias)$", P("pipeline", None)),
+    # Non-stacked variants (single-layer modules, BERT/ResNet dense layers).
+    (r".*attn_qkv/kernel$",         P("fsdp", None, "tensor")),
+    (r".*attn_out/kernel$",         P("tensor", "fsdp")),
+    (r".*mlp_in/kernel$",           P("fsdp", "tensor")),
+    (r".*mlp_out/kernel$",          P("tensor", "fsdp")),
+    (r".*(ln_1|ln_2|ln_f)/(scale|bias)$", P(None)),
+    (r".*", P(None)),
+]
+
+
+def spec_for_path(path: str, rules: Rules) -> P:
+    for pat, spec in rules:
+        if re.fullmatch(pat, path):
+            return spec
+    return P(None)
+
+
+def _tree_paths(tree: Any, prefix: str = "") -> Any:
+    if isinstance(tree, dict):
+        return {k: _tree_paths(v, f"{prefix}/{k}" if prefix else str(k))
+                for k, v in tree.items()}
+    return prefix
+
+
+def param_specs(params: Any, rules: Rules = TRANSFORMER_RULES,
+                extra_leading: Optional[str] = None) -> Any:
+    """Pytree of PartitionSpecs matching ``params``.
+
+    ``extra_leading`` prepends a mesh axis to every spec (used for stacked
+    scan-over-layers params whose leading dim is the layer index → sharded
+    over ``pipeline`` when PP is on).
+    """
+    paths = _tree_paths(params)
+
+    def leaf(path, p):
+        spec = spec_for_path(path, rules)
+        if extra_leading is not None:
+            spec = P(extra_leading, *spec)
+        nd = np.ndim(p) if not hasattr(p, "ndim") else p.ndim
+        # trim/pad the spec to the leaf's rank
+        parts = tuple(spec)[:nd]
+        parts = parts + (None,) * (nd - len(parts))
+        return P(*parts)
+
+    return jax.tree_util.tree_map(leaf, paths, params)
+
+
+def named_shardings(mesh: Mesh, specs: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(mesh: Mesh, params: Any,
+                 rules: Rules = TRANSFORMER_RULES) -> Any:
+    """Place a host pytree onto the mesh per the rules (lazy, via device_put)."""
+    shardings = named_shardings(mesh, param_specs(params, rules))
+    return jax.device_put(params, shardings)
+
+
+def batch_spec(config: MeshConfig, rank: int = 2) -> P:
+    """Sharding for a (batch, seq, ...) array: batch over data(+fsdp),
+    sequence over context."""
+    axes: List[Any] = [config.batch_axes()]
+    if rank >= 2:
+        axes.append("context" if config.context != 1 else None)
+    axes += [None] * (rank - len(axes))
+    return P(*axes)
+
+
+def local_batch_size(global_batch: int, config: MeshConfig,
+                     n_devices: int) -> int:
+    cfg = config.resolved(n_devices)
+    denom = math.prod(cfg.as_dict()[a] for a in cfg.batch_axes())
+    if global_batch % denom:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"data-parallel degree {denom}")
+    return global_batch // denom

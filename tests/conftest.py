@@ -9,13 +9,21 @@
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax import anywhere in the test process.  Force CPU even
+# when the environment tunnels a real TPU (a sitecustomize may pre-register
+# the TPU PJRT plugin, so the env var alone is not enough — the jax.config
+# update below wins): unit tests run on the 8-virtual-device rig; only
+# bench.py uses the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RTPU_OBJECT_STORE_MEMORY_MB", "256")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -64,8 +64,10 @@ def test_lineage_reconstruction(ray_start_regular):
     ref = produce.remote()
     first = ray_tpu.get(ref)
     assert first[42] == 42
-    # simulate losing the primary copy
-    os.unlink(f"/dev/shm/rtpu_{ref.id}")
+    # simulate losing the primary copy (path via the store's own helper so
+    # RTPU_SHM_DIR overrides are honored)
+    from ray_tpu._private.shm_store import _seg_path
+    os.unlink(str(_seg_path(str(ref.id))))
     again = ray_tpu.get(ref, timeout=60)
     assert again[42] == 42
 
