@@ -96,6 +96,13 @@ class ActorServer:
             raise ActorExit(0)
         if method_name == "__ray_ready__":
             return True
+        if method_name == "__ray_apply__":
+            # Run an arbitrary function against the actor instance (reference:
+            # ``__ray_call__``): fn(instance, *args, **kwargs).  Used by the
+            # collective layer and Train's WorkerGroup to execute code inside
+            # an existing actor without the user declaring a method for it.
+            fn, *rest = args
+            return fn(self.instance, *rest, **kwargs)
         method = getattr(self.instance, method_name)
         if inspect.iscoroutinefunction(method):
             if self._loop is not None:

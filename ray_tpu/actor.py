@@ -68,6 +68,18 @@ class ActorHandle:
     def __ray_terminate__(self) -> ActorMethod:
         return ActorMethod(self, "__ray_terminate__", 1)
 
+    @property
+    def __ray_apply__(self) -> ActorMethod:
+        """Run ``fn(instance, *args, **kwargs)`` inside the actor process.
+
+        Reference: ``ActorHandle.__ray_call__`` — the generic escape hatch
+        used by ``ray.util.collective`` setup and Train's worker group.
+        """
+        return ActorMethod(self, "__ray_apply__", 1)
+
+    # Reference-compatible alias.
+    __ray_call__ = __ray_apply__
+
     def __repr__(self) -> str:
         return f"ActorHandle({self._class_name}, {self._actor_id[:8]})"
 

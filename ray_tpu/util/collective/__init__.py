@@ -1,0 +1,22 @@
+"""``ray_tpu.util.collective`` — collective communication among actors.
+
+Reference: ``python/ray/util/collective/`` (SURVEY.md §2.4, §5.8).
+"""
+
+from ray_tpu.util.collective.collective import (  # noqa: F401
+    allgather, allreduce, alltoall, barrier, broadcast,
+    create_collective_group, destroy_collective_group,
+    get_collective_group_size, get_rank, init_collective_group,
+    is_group_initialized, recv, reduce, reducescatter, send,
+)
+from ray_tpu.util.collective.types import Backend, ReduceOp  # noqa: F401
+
+
+def xla_group(devices=None, group_name: str = "default"):
+    """Create an in-mesh device collective group (compiled ICI collectives).
+
+    Imported lazily so the shm backend never pays the JAX import.
+    """
+    from ray_tpu.util.collective.collective_group.xla_group import (
+        XlaCollectiveGroup)
+    return XlaCollectiveGroup(devices, group_name)

@@ -1,0 +1,1 @@
+"""Collective backends (reference: ``python/ray/util/collective/collective_group/``)."""

@@ -1,0 +1,49 @@
+"""Collective op/backend types.
+
+Reference: ``python/ray/util/collective/types.py`` — ``ReduceOp`` and
+backend identifiers (the reference's backends are NCCL and GLOO; ours are
+the object-plane ``shm`` backend and the in-mesh ``xla`` backend,
+SURVEY.md §2.4/§5.8).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+    @staticmethod
+    def coerce(op: "ReduceOp | str") -> "ReduceOp":
+        return op if isinstance(op, ReduceOp) else ReduceOp(str(op).lower())
+
+
+class Backend(str, enum.Enum):
+    """Collective transport.
+
+    SHM — object-plane backend: tensors move through the shared-memory
+    object store, rendezvous via GCS KV.  Works for any set of actors or
+    processes (the GLOO analog).
+    XLA — in-mesh backend: the group is a set of local devices and ops are
+    compiled ``shard_map`` collectives over ICI (the NCCL analog — except
+    collectives are *compiled into the program*, not runtime library calls).
+    """
+
+    SHM = "shm"
+    XLA = "xla"
+    # Reference-compatible aliases accepted by init_collective_group.
+    GLOO = "gloo"
+    NCCL = "nccl"
+
+    @staticmethod
+    def coerce(b: "Backend | str") -> "Backend":
+        b = Backend(str(b).lower()) if not isinstance(b, Backend) else b
+        if b == Backend.GLOO:
+            return Backend.SHM
+        if b == Backend.NCCL:
+            return Backend.XLA
+        return b
