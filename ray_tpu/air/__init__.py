@@ -1,0 +1,12 @@
+"""AIR umbrella: shared config/result/checkpoint types.
+
+Reference: ``python/ray/air/`` (SURVEY.md §2.5) — ``Checkpoint``, ``Result``,
+``ScalingConfig``/``RunConfig``/``FailureConfig``/``CheckpointConfig`` shared
+by Train and Tune.
+"""
+
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig, FailureConfig, RunConfig, ScalingConfig,
+)
+from ray_tpu.train._checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.result import Result  # noqa: F401

@@ -1,0 +1,99 @@
+"""Run/scaling/failure/checkpoint configuration.
+
+Reference: ``python/ray/air/config.py`` (SURVEY.md §2.5/§3.4).  The TPU
+extension (SURVEY.md §2.4 "elastic/advanced placement") is that
+``ScalingConfig`` can request *topology-shaped* reservations — a pod slice
+(``topology="v4-32"``) leased atomically to the worker group — instead of
+per-worker chip counts.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.parallel.topology import slice_spec
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers and what each one holds.
+
+    num_workers: data-parallel worker count (one actor per TPU host when
+        ``topology`` is set — all hosts of a slice are leased together).
+    use_tpu: workers get TPU chips (reference: ``use_gpu``; accepted as an
+        alias kwarg).
+    resources_per_worker: extra custom resources per worker.
+    topology: pod-slice topology string (e.g. "v4-8"); when set, the
+        placement group is STRICT_PACK over one ICI domain and
+        ``num_workers`` defaults to the slice's host count.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    topology: Optional[str] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def __post_init__(self):
+        if self.topology is not None:
+            topo = slice_spec(self.topology)
+            self.use_tpu = True
+            if self.num_workers in (0, 1) and topo.num_hosts > 1:
+                self.num_workers = topo.num_hosts
+            self.placement_strategy = "STRICT_PACK"
+
+    @property
+    def num_tpus_per_worker(self) -> float:
+        if not self.use_tpu:
+            return 0.0
+        if self.topology is not None:
+            topo = slice_spec(self.topology)
+            return topo.chips_per_host
+        return float((self.resources_per_worker or {}).get("TPU", 1.0))
+
+    def bundle(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res["TPU"] = self.num_tpus_per_worker
+        res.pop("GPU", None)
+        return res
+
+    def as_placement_group_factory(self):
+        from ray_tpu.util.placement_group import placement_group
+        bundles = [self.bundle() for _ in range(self.num_workers)]
+        return lambda: placement_group(bundles,
+                                       strategy=self.placement_strategy)
+
+
+@dataclass
+class FailureConfig:
+    """Reference: ``ray.air.FailureConfig`` — worker-group restarts from the
+    last checkpoint, up to ``max_failures`` (-1 = unlimited)."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: ``ray.air.CheckpointConfig`` — retention policy."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -1,0 +1,45 @@
+"""``ray_tpu.train`` — distributed training.
+
+Reference: ``python/ray/train/`` (SURVEY.md §2.5/§3.4).  Worker-side API:
+``report``, ``get_context``, ``get_checkpoint``, ``get_dataset_shard``.
+Driver-side: ``JaxTrainer``/``DataParallelTrainer`` + AIR configs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig, FailureConfig, RunConfig, ScalingConfig,
+)
+from ray_tpu.train._checkpoint import (  # noqa: F401
+    Checkpoint, restore_pytree, save_pytree,
+)
+from ray_tpu.train._internal.session import TrainContext, get_session
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig  # noqa: F401
+from ray_tpu.train.base_trainer import (  # noqa: F401
+    BaseTrainer, DataParallelTrainer, JaxTrainer,
+)
+from ray_tpu.train.result import Result  # noqa: F401
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Stream metrics (and optionally a checkpoint) to the driver.
+
+    Reference: ``ray.train.report`` — must be called by every worker, the
+    driver records rank 0's metrics once all ranks have reported.
+    """
+    get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return TrainContext(get_session())
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_session().get_dataset_shard(name)

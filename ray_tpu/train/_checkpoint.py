@@ -1,0 +1,100 @@
+"""Checkpoint: the portable training-state handle.
+
+Reference: ``ray.air.Checkpoint`` / ``ray.train.Checkpoint`` (SURVEY.md
+§5.4) — dir / dict / URI forms, convertible.  TPU-native addition: sharded
+pytree save/restore through Orbax (each host writes its own shards on a
+multi-host run; single-host here) via ``save_pytree``/``restore_pytree``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, Iterator, Optional
+
+
+class Checkpoint:
+    """Immutable handle to checkpoint data (a directory or a dict)."""
+
+    def __init__(self, path: Optional[str] = None,
+                 _data: Optional[Dict[str, Any]] = None):
+        if (path is None) == (_data is None):
+            raise ValueError("exactly one of path/_data")
+        self._path = path
+        self._data = _data
+
+    # -------------------------------------------------------- constructors
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path=os.path.abspath(path))
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(_data=dict(data))
+
+    # ---------------------------------------------------------- accessors
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        blob = os.path.join(self._path, "_dict_checkpoint.pkl")
+        if os.path.exists(blob):
+            with open(blob, "rb") as f:
+                return pickle.load(f)
+        raise ValueError(
+            f"directory checkpoint {self._path} has no dict payload")
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        out = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        os.makedirs(out, exist_ok=True)
+        if self._path is not None:
+            if os.path.abspath(out) != os.path.abspath(self._path):
+                shutil.copytree(self._path, out, dirs_exist_ok=True)
+        else:
+            with open(os.path.join(out, "_dict_checkpoint.pkl"), "wb") as f:
+                pickle.dump(self._data, f, protocol=5)
+        return out
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        if self._path is not None:
+            yield self._path
+        else:
+            out = self.to_directory()
+            try:
+                yield out
+            finally:
+                shutil.rmtree(out, ignore_errors=True)
+
+    def __repr__(self) -> str:
+        src = self._path if self._path is not None else "<dict>"
+        return f"Checkpoint({src})"
+
+
+# ---------------------------------------------------------------- orbax I/O
+def save_pytree(path: str, tree: Any) -> None:
+    """Write a (possibly sharded) JAX pytree with Orbax.
+
+    On a multi-host mesh each process writes only its addressable shards —
+    this is the Orbax contract, matching SURVEY.md §5.4's rebuild note.
+    """
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, tree)
+
+
+def restore_pytree(path: str, template: Optional[Any] = None) -> Any:
+    import orbax.checkpoint as ocp
+    ckptr = ocp.PyTreeCheckpointer()
+    if template is not None:
+        return ckptr.restore(os.path.abspath(path), item=template)
+    return ckptr.restore(os.path.abspath(path))

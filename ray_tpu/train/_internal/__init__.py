@@ -1,0 +1,1 @@
+"""Train internals (reference: ``python/ray/train/_internal/``)."""

@@ -1,0 +1,136 @@
+"""Per-worker training session.
+
+Reference: ``python/ray/train/_internal/session.py`` (SURVEY.md §3.4) — the
+thread-local a worker's ``train_loop_per_worker`` talks to:
+``train.report(metrics, checkpoint=)`` streams results back to the driver;
+``train.get_checkpoint()`` hands the restore point after a failure;
+``train.get_context()`` exposes rank/world/mesh info.
+
+Transport: reports go through the GCS KV (namespace "train") under
+``<run_id>/r/<iteration>/<rank>``; the driver polls (reference: a result
+queue polled by the trainable).  Checkpoints are persisted worker-side to
+the run's storage path (shared filesystem contract, like the reference's
+shared ``storage_path``) and only the path travels through the KV.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.experimental import internal_kv
+from ray_tpu.train._checkpoint import Checkpoint
+
+NAMESPACE = "train"
+
+_session: Optional["_TrainSession"] = None
+_lock = threading.Lock()
+
+
+class TrainContext:
+    """Reference: ``ray.train.get_context()`` — rank/world introspection."""
+
+    def __init__(self, session: "_TrainSession"):
+        self._s = session
+
+    def get_world_size(self) -> int:
+        return self._s.world_size
+
+    def get_world_rank(self) -> int:
+        return self._s.rank
+
+    def get_local_rank(self) -> int:
+        return self._s.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._s.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self._s.node_rank
+
+    def get_trial_name(self) -> str:
+        return self._s.run_name
+
+    def get_experiment_name(self) -> str:
+        return self._s.run_name
+
+    def get_storage_path(self) -> str:
+        return self._s.storage_dir
+
+    def get_mesh_config(self):
+        return self._s.mesh_config
+
+
+class _TrainSession:
+    def __init__(self, run_id: str, run_name: str, rank: int, world_size: int,
+                 storage_dir: str, restore_checkpoint: Optional[Checkpoint],
+                 mesh_config: Any = None, local_rank: Optional[int] = None,
+                 local_world_size: Optional[int] = None, node_rank: int = 0,
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 attempt: int = 0):
+        self.run_id = run_id
+        self.run_name = run_name
+        self.rank = rank
+        self.world_size = world_size
+        self.storage_dir = storage_dir
+        self.restore_checkpoint = restore_checkpoint
+        self.mesh_config = mesh_config
+        self.local_rank = rank if local_rank is None else local_rank
+        self.local_world_size = (world_size if local_world_size is None
+                                 else local_world_size)
+        self.node_rank = node_rank
+        self.dataset_shards = dataset_shards or {}
+        self.attempt = attempt
+        self.iteration = 0
+
+    # ------------------------------------------------------------ transport
+    def _kv_put(self, key: str, value: bytes) -> None:
+        internal_kv._internal_kv_put(key, value, namespace=NAMESPACE)
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self.iteration += 1
+        ckpt_path = None
+        if checkpoint is not None:
+            ckpt_path = os.path.join(
+                self.storage_dir, f"checkpoint_{self.iteration:06d}",
+                f"rank_{self.rank}" if self.world_size > 1 else "")
+            ckpt_path = ckpt_path.rstrip(os.sep)
+            checkpoint.to_directory(ckpt_path)
+        payload = pickle.dumps(
+            {"metrics": dict(metrics), "checkpoint_path": ckpt_path,
+             "iteration": self.iteration})
+        self._kv_put(f"{self.run_id}/r/{self.iteration}/{self.rank}", payload)
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.restore_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        return self.dataset_shards.get(name)
+
+
+# ----------------------------------------------------------------- public
+def init_session(**kwargs) -> None:
+    global _session
+    with _lock:
+        _session = _TrainSession(**kwargs)
+
+
+def shutdown_session() -> None:
+    global _session
+    with _lock:
+        _session = None
+
+
+def get_session() -> _TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No training session active — ray_tpu.train.report()/"
+            "get_context() must be called inside train_loop_per_worker")
+    return _session
+
+
+def try_session() -> Optional[_TrainSession]:
+    return _session

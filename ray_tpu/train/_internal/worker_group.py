@@ -1,0 +1,78 @@
+"""Worker group: N train-worker actors placed by one placement group.
+
+Reference: ``python/ray/train/_internal/worker_group.py`` (SURVEY.md §3.4).
+Workers are plain actors exposing ``apply(fn, *a, **kw)``; the backend
+executor drives them.  With a TPU topology the PG is STRICT_PACK over one
+ICI domain, so all hosts of the slice are leased atomically (SURVEY.md
+§7.1 inversion #2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.util.placement_group import (
+    placement_group, remove_placement_group)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@ray_tpu.remote
+class TrainWorkerActor:
+    """One training worker process (reference: ``RayTrainWorker``)."""
+
+    def __init__(self, rank: int):
+        self._rank = rank
+
+    def apply(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        return fn(*args, **kwargs)
+
+    def rank(self) -> int:
+        return self._rank
+
+
+class WorkerGroup:
+    def __init__(self, scaling: ScalingConfig):
+        self.scaling = scaling
+        self.num_workers = scaling.num_workers
+        bundles = [scaling.bundle() for _ in range(self.num_workers)]
+        self.pg = placement_group(bundles, strategy=scaling.placement_strategy)
+        ray_tpu.get(self.pg.ready())
+        self.workers: List[Any] = []
+        for i in range(self.num_workers):
+            strategy = PlacementGroupSchedulingStrategy(
+                placement_group=self.pg, placement_group_bundle_index=i)
+            actor = TrainWorkerActor.options(
+                num_cpus=scaling.bundle().get("CPU", 1.0),
+                num_tpus=scaling.bundle().get("TPU", 0.0),
+                scheduling_strategy=strategy,
+            ).remote(i)
+            self.workers.append(actor)
+        ray_tpu.get([w.__ray_ready__.remote() for w in self.workers])
+
+    def execute_async(self, fn: Callable, *args: Any, **kwargs: Any) -> List[Any]:
+        """Launch fn on every worker; returns refs (reference:
+        ``WorkerGroup.execute_async``)."""
+        return [w.apply.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute(self, fn: Callable, *args: Any, **kwargs: Any) -> List[Any]:
+        return ray_tpu.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(self.workers[rank].apply.remote(fn, *args, **kwargs))
+
+    def shutdown(self, force: bool = False) -> None:
+        for w in self.workers:
+            try:
+                if force:
+                    ray_tpu.kill(w)
+                else:
+                    w.__ray_terminate__.remote()
+            except Exception:  # noqa: BLE001 - already dead
+                pass
+        self.workers = []
+        try:
+            remove_placement_group(self.pg)
+        except Exception:  # noqa: BLE001
+            pass

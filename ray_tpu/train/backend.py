@@ -1,0 +1,119 @@
+"""Backend hooks: per-framework worker-group setup.
+
+Reference: ``python/ray/train/backend.py`` + ``train/torch/config.py``
+(SURVEY.md §3.4) — the reference's ``TorchConfig`` picks a master address
+and calls ``dist.init_process_group("nccl")`` on every worker.  The
+TPU-native analog (``JaxConfig``) wires ``jax.distributed``: the driver
+allocates a coordinator address through the control plane, every worker
+calls ``jax.distributed.initialize(coord, num_processes, process_id)``, and
+from then on the worker group is one multi-controller SPMD program domain.
+
+On the CPU test rig (single machine, JAX_PLATFORMS=cpu) multi-process XLA
+coordination is unavailable, so ``JaxConfig`` falls back to per-process
+local devices + the shm collective group for gradient sync — the same
+worker code runs in both worlds.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Worker-group lifecycle hooks (reference: ``train.backend.Backend``)."""
+
+    share_cuda_visible_devices = False
+
+    def on_start(self, worker_group, backend_config: "BackendConfig") -> None:
+        pass
+
+    def on_training_start(self, worker_group,
+                          backend_config: "BackendConfig") -> None:
+        pass
+
+    def on_shutdown(self, worker_group, backend_config: "BackendConfig") -> None:
+        pass
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """JAX/TPU worker-group backend.
+
+    init_collective_group: also install a shm collective group named
+        ``train_default`` across the workers (gradient sync path on the CPU
+        rig; on a real pod the compiled pjit program handles it and the shm
+        group is only used for control-plane style reductions of metrics).
+    """
+
+    use_distributed: Optional[bool] = None   # None = auto (TPU only)
+    init_collective_group: bool = True
+    coordinator_port: int = 0
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _jax_worker_setup(rank: int, world_size: int, coord_addr: Optional[str],
+                      group_name: str, init_col: bool) -> None:
+    if coord_addr is not None and world_size > 1:
+        import jax
+        jax.distributed.initialize(coordinator_address=coord_addr,
+                                   num_processes=world_size,
+                                   process_id=rank)
+    if init_col and world_size > 1:
+        from ray_tpu.util import collective as col
+        if not col.is_group_initialized(group_name):
+            col.init_collective_group(world_size, rank, "shm", group_name)
+
+
+class _JaxBackend(Backend):
+    # user-facing alias; the real group name is unique per run+attempt so
+    # restarted groups never rendezvous against a dead attempt's KV keys
+    GROUP = "train_default"
+
+    def on_start(self, worker_group, backend_config: JaxConfig) -> None:
+        world = worker_group.num_workers
+        use_dist = backend_config.use_distributed
+        if use_dist is None:
+            # multi-controller init only makes sense on real accelerators
+            use_dist = (os.environ.get("JAX_PLATFORMS", "") not in
+                        ("cpu", "cpu,axon") and world > 1
+                        and os.environ.get("RTPU_JAX_DISTRIBUTED") == "1")
+        coord = None
+        if use_dist:
+            import socket
+            port = backend_config.coordinator_port or _free_port()
+            coord = f"{socket.gethostbyname(socket.gethostname())}:{port}"
+        import ray_tpu
+        ray_tpu.get(worker_group.execute_async(
+            _jax_worker_setup_by_rank, world, coord, self.GROUP,
+            backend_config.init_collective_group))
+
+
+def _jax_worker_setup_by_rank(world, coord, alias, init_col):
+    # Executed via WorkerGroup.execute_async → same fn on every worker; the
+    # rank is read from the session (set before backend hooks run).
+    from ray_tpu.train._internal.session import get_session
+    from ray_tpu.util.collective import collective as col_mod
+    s = get_session()
+    group = f"train_{s.run_id}_a{s.attempt}"
+    _jax_worker_setup(s.rank, world, coord, group, init_col)
+    if init_col and world > 1:
+        col_mod._register_alias(alias, group)
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,100 @@
+"""BaseTrainer / DataParallelTrainer.
+
+Reference: ``python/ray/train/base_trainer.py`` +
+``python/ray/train/data_parallel_trainer.py`` (SURVEY.md §3.4).  The
+reference routes ``fit()`` through a 1-trial Tune run; ours calls the
+backend executor directly and Tune integrates by wrapping ``as_trainable``
+(same layering, thinner plumbing).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train._internal.backend_executor import BackendExecutor
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+from ray_tpu.train.result import Result
+
+
+class BaseTrainer:
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint=None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self) -> Callable:
+        """A Tune function-trainable wrapping this trainer (reference:
+        ``BaseTrainer.as_trainable`` returning a Trainable class)."""
+        trainer = self
+
+        def _trainable(config: Dict[str, Any]):
+            import copy
+
+            from ray_tpu import train as train_mod
+            t = copy.copy(trainer)
+            loop_cfg = dict(getattr(t, "train_loop_config", None) or {})
+            loop_cfg.update(config.get("train_loop_config", {}))
+            t.train_loop_config = loop_cfg
+            result = t.fit()
+            if result.error is not None:
+                raise result.error
+            # surface final metrics to Tune
+            if result.metrics:
+                train_mod.report(result.metrics)
+
+        return _trainable
+
+
+class DataParallelTrainer(BaseTrainer):
+    """N identical workers each running ``train_loop_per_worker``."""
+
+    _default_backend_config: BackendConfig = BackendConfig()
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 mesh_config: Any = None,
+                 resume_from_checkpoint=None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config, datasets=datasets,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or self._default_backend_config
+        self.mesh_config = mesh_config
+
+    def fit(self) -> Result:
+        executor = BackendExecutor(self.backend_config, self.scaling_config,
+                                   self.run_config, self.mesh_config)
+        try:
+            return executor.run(self.train_loop_per_worker,
+                                self.train_loop_config, self.datasets)
+        finally:
+            executor.shutdown()
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship TPU trainer (reference analog: ``TorchTrainer``).
+
+    Workers form one SPMD domain: on a pod slice, one worker per host with
+    ``jax.distributed`` init (JaxConfig); the train loop is expected to be
+    a pjit/GSPMD program built against ``get_context().get_mesh_config()``.
+    """
+
+    _default_backend_config = JaxConfig()
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 jax_config: Optional[JaxConfig] = None, **kwargs):
+        super().__init__(train_loop_per_worker,
+                         backend_config=jax_config or JaxConfig(), **kwargs)

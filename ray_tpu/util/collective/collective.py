@@ -22,17 +22,18 @@ import pickle
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
-from ray_tpu._private import worker as _worker_mod
 from ray_tpu.util.collective.collective_group.shm_group import (
-    ShmCollectiveGroup, _POLL_MAX, _POLL_MIN, NAMESPACE,
+    ShmCollectiveGroup, _POLL_MAX, _POLL_MIN,
 )
 from ray_tpu.util.collective.types import Backend, ReduceOp
 
 _groups: Dict[str, ShmCollectiveGroup] = {}
 
 
-def _w():
-    return _worker_mod.global_worker()
+def _register_alias(alias: str, group_name: str) -> None:
+    """Process-local alias → existing group (used by Train so user code can
+    say "train_default" while the KV keys use a per-run unique name)."""
+    _groups[alias] = _groups[group_name]
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
@@ -109,12 +110,20 @@ def create_collective_group(actors: Sequence[Any], world_size: Optional[int] = N
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    """Tear down THIS rank's state only (reference semantics) — deleting
+    other ranks' keys would break peers mid-collective."""
     g = _groups.pop(group_name, None)
     if g is None:
         return
+    # drop aliases pointing at the same group
+    for k, v in list(_groups.items()):
+        if v is g:
+            del _groups[k]
+    mine = f"/{g.rank}"
+    for k in g._kv_count(f"{g.group_name}/"):
+        if k.endswith(mine) or f"/{g.rank}-" in k:
+            g._kv_del(k)
     g.destroy()
-    for k in g._kv_count(f"{group_name}/"):
-        g._kv_del(k)
 
 
 def _group(group_name: str) -> ShmCollectiveGroup:

@@ -28,6 +28,7 @@ import numpy as np
 
 from ray_tpu._private import worker as _worker_mod
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.experimental import internal_kv
 from ray_tpu.util.collective.types import ReduceOp
 
 NAMESPACE = "collective"
@@ -73,9 +74,9 @@ class ShmCollectiveGroup:
         self._p2p_recv: Dict[int, int] = {}
         # refs published at seq s, released at s+2 (see module docstring)
         self._pinned: Dict[int, List[ObjectRef]] = {}
-        # p2p refs can't use the epoch rule (recv timing is unknown); keep a
-        # bounded window of recent sends alive instead.
-        self._p2p_pinned: List[ObjectRef] = []
+        # p2p refs can't use the epoch rule (recv timing is unknown); a
+        # send's ref stays pinned until the matching recv deletes its key.
+        self._p2p_pinned: List[tuple] = []  # (key, ref)
 
     # ------------------------------------------------------------------ kv
     @property
@@ -86,17 +87,16 @@ class ShmCollectiveGroup:
         return f"{self.group_name}/{seq}/{phase}/{rank}"
 
     def _kv_put(self, key: str, value: bytes) -> None:
-        self._w.rpc("kv_put", key=key, value=value, overwrite=True,
-                    namespace=NAMESPACE)
+        internal_kv._internal_kv_put(key, value, namespace=NAMESPACE)
 
     def _kv_get(self, key: str) -> Optional[bytes]:
-        return self._w.rpc("kv_get", key=key, namespace=NAMESPACE)["value"]
+        return internal_kv._internal_kv_get(key, namespace=NAMESPACE)
 
     def _kv_del(self, key: str) -> None:
-        self._w.rpc("kv_del", key=key, namespace=NAMESPACE)
+        internal_kv._internal_kv_del(key, namespace=NAMESPACE)
 
     def _kv_count(self, prefix: str) -> List[str]:
-        return self._w.rpc("kv_keys", prefix=prefix, namespace=NAMESPACE)["keys"]
+        return internal_kv._internal_kv_list(prefix, namespace=NAMESPACE)
 
     # -------------------------------------------------------------- framing
     def _publish(self, seq: int, phase: str, tensor: Any) -> None:
@@ -149,8 +149,6 @@ class ShmCollectiveGroup:
         return self._seq
 
     # ---------------------------------------------------------------- ops
-    _ALL = None  # sentinel: all ranks
-
     def _ranks(self) -> List[int]:
         return list(range(self.world_size))
 
@@ -169,22 +167,33 @@ class ShmCollectiveGroup:
 
     def reduce(self, tensor: Any, dst_rank: int = 0,
                op: ReduceOp = ReduceOp.SUM, timeout: float = 60.0) -> Any:
+        # Ack phase keeps this op blocking for ALL ranks — the epoch
+        # reclamation invariant (module docstring) requires it.
         seq = self._next_seq()
         self._publish(seq, "t", _to_numpy(tensor))
         if self.rank != dst_rank:
+            self._await_keys(seq, "b", [dst_rank], timeout)
             return tensor
         parts = self._collect(seq, "t", self._ranks(), timeout)
-        return _like(_reduce_arrays([parts[r] for r in self._ranks()], op),
-                     tensor)
+        out = _like(_reduce_arrays([parts[r] for r in self._ranks()], op),
+                    tensor)
+        self._kv_put(self._key(seq, "b", dst_rank), b"")
+        return out
 
     def broadcast(self, tensor: Any, src_rank: int = 0,
                   timeout: float = 60.0) -> Any:
+        # Receivers ack after reading; src blocks on the acks (epoch
+        # invariant — src must not run ahead and reclaim its tensor).
         seq = self._next_seq()
         if self.rank == src_rank:
             self._publish(seq, "t", _to_numpy(tensor))
+            others = [r for r in self._ranks() if r != src_rank]
+            if others:
+                self._await_keys(seq, "b", others, timeout)
             return tensor
         parts = self._collect(seq, "t", [src_rank], timeout)
-        return _like(parts[src_rank], tensor)
+        self._kv_put(self._key(seq, "b", self.rank), b"")
+        return parts[src_rank]
 
     def allgather(self, tensor: Any, timeout: float = 60.0) -> List[Any]:
         seq = self._next_seq()
@@ -224,8 +233,11 @@ class ShmCollectiveGroup:
             self._kv_put(key, b"I" + payload)
         else:
             ref = self._w.put(_to_numpy(tensor))
-            self._p2p_pinned.append(ref)
-            del self._p2p_pinned[:-32]
+            # lazily unpin completed sends (recv deletes the key on read)
+            self._p2p_pinned = [
+                (k, r) for k, r in self._p2p_pinned
+                if self._kv_get(k) is not None]
+            self._p2p_pinned.append((key, ref))
             self._kv_put(key, b"R" + ref.hex().encode())
 
     def recv(self, src_rank: int, timeout: float = 60.0) -> Any:
