@@ -61,6 +61,14 @@ class ObjectRef:
     def _deserialize(object_id: str) -> "ObjectRef":
         return _deserialize_object_ref(object_id)
 
+    def __reduce__(self):
+        # Plain-pickle path (refs inside values shipped via cloudpickle
+        # outside the framework serializer, e.g. Dataset shards handed to
+        # train workers).  The framework serializer's reducer_override
+        # additionally records the borrow; here the sender must keep the
+        # ref alive (the driver does, via the owning Dataset).
+        return (_deserialize_object_ref, (str(self.id),))
+
     def __del__(self):
         w = self._worker
         if w is not None and not self._skip_release:

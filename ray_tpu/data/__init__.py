@@ -1,0 +1,14 @@
+"""``ray_tpu.data`` — distributed datasets.
+
+Reference: ``python/ray/data/`` (SURVEY.md §2.5): blocks in the object
+store, lazy plans, streaming execution with backpressure, ``split`` for
+per-worker shards, batch/device iteration for training ingest.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
+from ray_tpu.data.context import DataContext  # noqa: F401
+from ray_tpu.data.dataset import Dataset, GroupedData  # noqa: F401
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow, from_items, from_numpy, from_pandas, range, read_binary_files,
+    read_csv, read_json, read_numpy, read_parquet, read_text,
+)

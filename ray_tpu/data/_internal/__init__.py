@@ -1,0 +1,1 @@
+"""Data internals (reference: ``python/ray/data/_internal/``)."""

@@ -1,0 +1,133 @@
+"""Blocks: the unit of distributed data.
+
+Reference: ``python/ray/data/block.py`` — there a block is a pyarrow Table
+in the object store.  TPU-native choice: the canonical block is a dict of
+column-major numpy arrays — zero-copy out of the shm object store and
+directly ``jax.device_put``-able (SURVEY.md §2.4 "GPU↔object store
+interop": the ingest path stages host arrays into HBM).  Arrow/pandas
+appear only at IO boundaries and in ``batch_format`` conversions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+VALUE_COL = "item"  # column name for non-tabular datasets (reference: same)
+
+
+def _as_array(values: List[Any]) -> np.ndarray:
+    """Column from python values; object dtype for ragged/arbitrary rows."""
+    try:
+        return np.asarray(values)
+    except Exception:  # noqa: BLE001 - truly heterogeneous
+        arr = np.empty(len(values), dtype=object)
+        arr[:] = values
+        return arr
+
+
+def block_from_rows(rows: Sequence[Any]) -> Block:
+    """Rows (dicts or scalars) → column block."""
+    if not rows:
+        return {}
+    if isinstance(rows[0], dict):
+        cols: Dict[str, List[Any]] = {k: [] for k in rows[0]}
+        for r in rows:
+            for k in cols:
+                cols[k].append(r[k])
+        return {k: _as_array(v) for k, v in cols.items()}
+    return {VALUE_COL: _as_array(list(rows))}
+
+
+class BlockAccessor:
+    """Uniform view over a block (reference: ``BlockAccessor``)."""
+
+    def __init__(self, block: Block):
+        self._b = block or {}
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        if not self._b:
+            return 0
+        return len(next(iter(self._b.values())))
+
+    def size_bytes(self) -> int:
+        return sum(a.nbytes if hasattr(a, "nbytes") else 64 * len(a)
+                   for a in self._b.values())
+
+    def columns(self) -> List[str]:
+        return list(self._b.keys())
+
+    def schema(self) -> Dict[str, Any]:
+        return {k: v.dtype for k, v in self._b.items()}
+
+    # ------------------------------------------------------------- slicing
+    def slice(self, start: int, end: int) -> Block:
+        return {k: v[start:end] for k, v in self._b.items()}
+
+    def take_idx(self, idx: np.ndarray) -> Block:
+        return {k: v[idx] for k, v in self._b.items()}
+
+    # ----------------------------------------------------------- iteration
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        keys = list(self._b.keys())
+        for i in range(self.num_rows()):
+            yield {k: self._b[k][i] for k in keys}
+
+    # --------------------------------------------------------- conversions
+    def to_batch(self, batch_format: str = "numpy") -> Any:
+        if batch_format in ("numpy", "default", None):
+            return dict(self._b)
+        if batch_format == "pandas":
+            import pandas as pd
+            return pd.DataFrame({k: list(v) if v.dtype == object else v
+                                 for k, v in self._b.items()})
+        if batch_format == "pyarrow":
+            import pyarrow as pa
+            return pa.table({k: list(v) if v.dtype == object else v
+                             for k, v in self._b.items()})
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    @staticmethod
+    def batch_to_block(batch: Any) -> Block:
+        if batch is None:
+            return {}
+        if isinstance(batch, dict):
+            return {k: v if isinstance(v, np.ndarray) else _as_array(list(v))
+                    for k, v in batch.items()}
+        mod = type(batch).__module__
+        if mod.startswith("pandas"):
+            return {k: _as_array(batch[k].tolist())
+                    if batch[k].dtype == object else batch[k].to_numpy()
+                    for k in batch.columns}
+        if mod.startswith("pyarrow"):
+            return {name: _as_array(batch.column(name).to_pylist())
+                    for name in batch.column_names}
+        if isinstance(batch, np.ndarray):
+            return {VALUE_COL: batch}
+        raise TypeError(f"cannot convert batch of type {type(batch)}")
+
+
+def concat_blocks(blocks: Sequence[Block]) -> Block:
+    blocks = [b for b in blocks if b and BlockAccessor(b).num_rows()]
+    if not blocks:
+        return {}
+    keys = list(blocks[0].keys())
+    out = {}
+    for k in keys:
+        arrs = [b[k] for b in blocks]
+        if any(a.dtype == object for a in arrs):
+            merged = np.empty(sum(len(a) for a in arrs), dtype=object)
+            i = 0
+            for a in arrs:
+                merged[i:i + len(a)] = a
+                i += len(a)
+            out[k] = merged
+        else:
+            out[k] = np.concatenate(arrs)
+    return out

@@ -1,0 +1,458 @@
+"""Dataset: lazy, distributed, block-based data.
+
+Reference: ``python/ray/data/dataset.py`` (SURVEY.md §2.5).  A Dataset is a
+plan (stage list) over source blocks; execution streams block refs through
+fused map waves with backpressure (see _internal/execution.py).  Blocks are
+dicts of numpy columns in the shm object store — ``iter_device_batches``
+stages them into TPU HBM with double buffering (the north-star ingest path,
+SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
+                    Tuple, Union)
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._internal.execution import (
+    AllToAllStage, MapStage, ReadStage, Stage, stream_refs)
+from ray_tpu.data.block import (
+    Block, BlockAccessor, VALUE_COL, block_from_rows, concat_blocks)
+from ray_tpu.data.context import DataContext
+
+
+def _batched_map_fn(fn: Callable, batch_size: Optional[int],
+                    batch_format: str) -> Callable[[Block], Block]:
+    def apply(block: Block) -> Block:
+        acc = BlockAccessor(block)
+        rows = acc.num_rows()
+        bs = batch_size or max(rows, 1)
+        outs = []
+        for s in range(0, max(rows, 1), bs):
+            if rows == 0:
+                break
+            batch = BlockAccessor(acc.slice(s, min(s + bs, rows))) \
+                .to_batch(batch_format)
+            out = fn(batch)
+            outs.append(BlockAccessor.batch_to_block(out))
+        return concat_blocks(outs)
+    return apply
+
+
+def _row_map_fn(fn: Callable) -> Callable[[Block], Block]:
+    def apply(block: Block) -> Block:
+        rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
+        return block_from_rows(rows)
+    return apply
+
+
+class Dataset:
+    def __init__(self, stages: List[Stage],
+                 input_refs: Optional[List[Any]] = None):
+        self._stages = stages
+        self._input_refs = input_refs
+        self._cached_refs: Optional[List[Any]] = None
+
+    # ------------------------------------------------------------ plumbing
+    def _with_stage(self, stage: Stage) -> "Dataset":
+        if self._cached_refs is not None:
+            return Dataset([stage], input_refs=list(self._cached_refs))
+        return Dataset(self._stages + [stage], self._input_refs)
+
+    def _iter_refs(self) -> Iterator[Any]:
+        if self._cached_refs is not None:
+            yield from self._cached_refs
+            return
+        yield from stream_refs(self._stages, self._input_refs)
+
+    def materialize(self) -> "Dataset":
+        """Execute and pin all blocks (reference: ``Dataset.materialize``)."""
+        if self._cached_refs is None:
+            self._cached_refs = list(self._iter_refs())
+        return self
+
+    # --------------------------------------------------------- transforms
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return self._with_stage(MapStage(_row_map_fn(fn), "Map"))
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    **_compat: Any) -> "Dataset":
+        return self._with_stage(
+            MapStage(_batched_map_fn(fn, batch_size, batch_format),
+                     "MapBatches"))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            rows: List[Dict] = []
+            for r in BlockAccessor(block).iter_rows():
+                rows.extend(fn(r))
+            return block_from_rows(rows)
+        return self._with_stage(MapStage(apply, "FlatMap"))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            keep = np.fromiter((bool(fn(r)) for r in acc.iter_rows()),
+                               dtype=bool, count=acc.num_rows())
+            return acc.take_idx(np.nonzero(keep)[0])
+        return self._with_stage(MapStage(apply, "Filter"))
+
+    def add_column(self, name: str, fn: Callable[[Dict], Any]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            vals = [fn(batch) for batch in [acc.to_batch("numpy")]]
+            out = dict(block)
+            out[name] = np.asarray(vals[0])
+            return out
+        return self._with_stage(MapStage(apply, "AddColumn"))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            return {k: v for k, v in block.items() if k not in cols}
+        return self._with_stage(MapStage(apply, "DropColumns"))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            return {k: block[k] for k in cols}
+        return self._with_stage(MapStage(apply, "SelectColumns"))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            return {mapping.get(k, k): v for k, v in block.items()}
+        return self._with_stage(MapStage(apply, "RenameColumns"))
+
+    # ----------------------------------------------------------- shuffles
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_stage(
+            AllToAllStage("repartition", num_blocks=num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with_stage(AllToAllStage("random_shuffle", seed=seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        # sample boundaries (reference: sort sampling in shuffle planner)
+        self.materialize()
+        samples: List[np.ndarray] = []
+        for ref in self._cached_refs:
+            block = ray_tpu.get(ref)
+            col = block.get(key)
+            if col is not None and len(col):
+                samples.append(np.random.default_rng(0).choice(
+                    col, size=min(100, len(col)), replace=False)
+                    if len(col) > 100 else col)
+        n_out = max(1, len(self._cached_refs))
+        if samples:
+            allv = np.sort(np.concatenate(samples))
+            qs = np.linspace(0, 1, n_out + 1)[1:-1]
+            boundaries = [allv[int(q * (len(allv) - 1))] for q in qs]
+        else:
+            boundaries = []
+        ds = self._with_stage(AllToAllStage(
+            "sort", key=key, descending=descending, boundaries=boundaries,
+            num_blocks=n_out))
+        if descending:
+            # partitions come back ascending-ordered; reverse block order
+            ds.materialize()
+            ds._cached_refs = list(reversed(ds._cached_refs))
+        return ds
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # -------------------------------------------------------- combination
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self.materialize()._cached_refs)
+        for o in others:
+            refs.extend(o.materialize()._cached_refs)
+        return Dataset([], input_refs=refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left = self.materialize()._cached_refs
+        right = other.materialize()._cached_refs
+
+        @ray_tpu.remote
+        def _rows(b: Block) -> int:
+            return BlockAccessor(b).num_rows()
+
+        left_counts = ray_tpu.get([_rows.remote(r) for r in left])
+        right_counts = ray_tpu.get([_rows.remote(r) for r in right])
+        if sum(left_counts) != sum(right_counts):
+            raise ValueError("zip requires datasets with equal row counts")
+
+        @ray_tpu.remote
+        def merge(a: Block, spans, *right_blocks) -> Block:
+            # spans: [(right_block_idx, lo, hi)] covering a's row range —
+            # only the needed right blocks ship to this task, never the
+            # whole right dataset to the driver
+            pieces = [BlockAccessor(right_blocks[i]).slice(lo, hi)
+                      for i, (_, lo, hi) in enumerate(spans)]
+            b = concat_blocks(pieces)
+            out = dict(a)
+            for k, v in b.items():
+                out[k if k not in a else f"{k}_1"] = v
+            return out
+
+        # map each left block's global row range onto right-block spans
+        r_starts = np.concatenate([[0], np.cumsum(right_counts)])
+        refs, pos = [], 0
+        for lref, cnt in zip(left, left_counts):
+            lo_g, hi_g = pos, pos + cnt
+            pos = hi_g
+            spans, blocks = [], []
+            for j, (s, e) in enumerate(zip(r_starts[:-1], r_starts[1:])):
+                if e <= lo_g or s >= hi_g:
+                    continue
+                spans.append((j, int(max(lo_g, s) - s), int(min(hi_g, e) - s)))
+                blocks.append(right[j])
+            refs.append(merge.remote(lref, spans, *blocks))
+        return Dataset([], input_refs=refs)
+
+    def limit(self, n: int) -> "Dataset":
+        refs_out: List[Any] = []
+        taken = 0
+        for ref in self._iter_refs():
+            block = ray_tpu.get(ref)
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            if taken + rows <= n:
+                refs_out.append(ref)
+                taken += rows
+            else:
+                refs_out.append(ray_tpu.put(acc.slice(0, n - taken)))
+                taken = n
+            if taken >= n:
+                break
+        return Dataset([], input_refs=refs_out)
+
+    # ------------------------------------------------------------- splits
+    def split(self, n: int, *, equal: bool = False,
+              locality_hints: Optional[List[Any]] = None) -> List["Dataset"]:
+        """Reference: ``Dataset.split(n, locality_hints=workers)`` — the
+        per-worker sharding primitive Train uses (SURVEY.md §3.4)."""
+        self.materialize()
+        refs = list(self._cached_refs)
+        if not equal:
+            shards = [refs[i::n] for i in range(n)]
+            return [Dataset([], input_refs=s) for s in shards]
+        blocks = [ray_tpu.get(r) for r in refs]
+        whole = concat_blocks(blocks)
+        acc = BlockAccessor(whole)
+        rows = acc.num_rows()
+        bounds = np.linspace(0, rows, n + 1).astype(int)
+        return [Dataset([], input_refs=[
+            ray_tpu.put(acc.slice(bounds[i], bounds[i + 1]))])
+            for i in range(n)]
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        whole = BlockAccessor(concat_blocks(
+            [ray_tpu.get(r) for r in self.materialize()._cached_refs]))
+        cuts = [0] + list(indices) + [whole.num_rows()]
+        return [Dataset([], input_refs=[ray_tpu.put(
+            whole.slice(cuts[i], cuts[i + 1]))])
+            for i in range(len(cuts) - 1)]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        total = ds.count()
+        cut = int(total * (1 - test_size))
+        parts = ds.split_at_indices([cut])
+        return parts[0], parts[1]
+
+    # -------------------------------------------------------- consumption
+    def count(self) -> int:
+        @ray_tpu.remote
+        def _count(b: Block) -> int:
+            return BlockAccessor(b).num_rows()
+        return sum(ray_tpu.get([_count.remote(r) for r in self._iter_refs()]))
+
+    def schema(self) -> Optional[Dict[str, Any]]:
+        for ref in self._iter_refs():
+            block = ray_tpu.get(ref)
+            if BlockAccessor(block).num_rows():
+                return BlockAccessor(block).schema()
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        return list(s) if s else None
+
+    def num_blocks(self) -> int:
+        return len(self.materialize()._cached_refs)
+
+    def size_bytes(self) -> int:
+        @ray_tpu.remote
+        def _sz(b: Block) -> int:
+            return BlockAccessor(b).size_bytes()
+        return sum(ray_tpu.get([_sz.remote(r) for r in self._iter_refs()]))
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for ref in self._iter_refs():
+            for row in BlockAccessor(ray_tpu.get(ref)).iter_rows():
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return [r for ref in self._iter_refs()
+                for r in BlockAccessor(ray_tpu.get(ref)).iter_rows()]
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self._iter_refs():
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        """Streams: pulls blocks lazily (backpressure reaches the executor)."""
+        carry: Optional[Block] = None
+        for ref in self._iter_refs():
+            block = ray_tpu.get(ref)
+            if carry:
+                block = concat_blocks([carry, block])
+                carry = None
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            s = 0
+            while rows - s >= batch_size:
+                yield BlockAccessor(acc.slice(s, s + batch_size)) \
+                    .to_batch(batch_format)
+                s += batch_size
+            if s < rows:
+                carry = acc.slice(s, rows)
+        if carry and not drop_last:
+            yield BlockAccessor(carry).to_batch(batch_format)
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           **kw) -> Iterator[Dict[str, Any]]:
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy"):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    def iter_device_batches(self, *, batch_size: int = 256,
+                            sharding: Optional[Any] = None,
+                            prefetch: int = 2) -> Iterator[Any]:
+        """Double-buffered host→HBM ingest (reference gap — SURVEY.md §2.4
+        north star).  ``jax.device_put`` is async: by keeping ``prefetch``
+        batches in flight, the H2D copy of batch k+1 overlaps step k."""
+        import collections
+
+        import jax
+        q: collections.deque = collections.deque()
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy"):
+            dev = {k: (jax.device_put(v, sharding) if v.dtype != object
+                       else v) for k, v in batch.items()}
+            q.append(dev)
+            if len(q) > prefetch:
+                yield q.popleft()
+        while q:
+            yield q.popleft()
+
+    def to_pandas(self):
+        return BlockAccessor(concat_blocks(
+            [ray_tpu.get(r) for r in self._iter_refs()])).to_batch("pandas")
+
+    # ---------------------------------------------------------------- IO
+    def write_parquet(self, path: str) -> None:
+        self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        self._write(path, "csv")
+
+    def write_json(self, path: str) -> None:
+        self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> None:
+        import os
+        os.makedirs(path, exist_ok=True)
+
+        @ray_tpu.remote
+        def _w(i: int, block: Block) -> None:
+            import os
+
+            acc = BlockAccessor(block)
+            fname = os.path.join(path, f"part-{i:05d}.{fmt}")
+            if fmt == "parquet":
+                import pyarrow.parquet as pq
+                pq.write_table(acc.to_batch("pyarrow"), fname)
+            elif fmt == "csv":
+                acc.to_batch("pandas").to_csv(fname, index=False)
+            else:
+                acc.to_batch("pandas").to_json(fname, orient="records",
+                                               lines=True)
+        ray_tpu.get([_w.remote(i, r)
+                     for i, r in enumerate(self._iter_refs())])
+
+    def __repr__(self) -> str:
+        return f"Dataset(stages={len(self._stages)})"
+
+    # reference-compat alias
+    def fully_executed(self) -> "Dataset":
+        return self.materialize()
+
+
+class GroupedData:
+    """Reference: ``python/ray/data/grouped_data.py``."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, specs) -> Dataset:
+        return self._ds._with_stage(
+            AllToAllStage("groupby", key=self._key, aggs=specs))
+
+    def count(self) -> Dataset:
+        return self._agg([("count", None, "count()")])
+
+    def sum(self, on: str) -> Dataset:
+        return self._agg([("sum", on, f"sum({on})")])
+
+    def min(self, on: str) -> Dataset:
+        return self._agg([("min", on, f"min({on})")])
+
+    def max(self, on: str) -> Dataset:
+        return self._agg([("max", on, f"max({on})")])
+
+    def mean(self, on: str) -> Dataset:
+        return self._agg([("mean", on, f"mean({on})")])
+
+    def std(self, on: str) -> Dataset:
+        return self._agg([("std", on, f"std({on})")])
+
+    def aggregate(self, *specs) -> Dataset:
+        """specs: (agg_name, on_col, out_name) triples."""
+        return self._agg(list(specs))
+
+    def map_groups(self, fn: Callable[[Dict[str, np.ndarray]], Any]) -> Dataset:
+        key = self._key
+
+        def apply(block: Block) -> Block:
+            if not block:
+                return block
+            keys = block[key]
+            acc = BlockAccessor(block)
+            outs = []
+            for val in dict.fromkeys(keys.tolist()):  # ordered unique
+                idx = np.nonzero(keys == val)[0]
+                out = fn(acc.take_idx(idx))
+                outs.append(BlockAccessor.batch_to_block(out))
+            return concat_blocks(outs)
+
+        # hash-partition so each group lands wholly in one block, then map
+        return (self._ds
+                ._with_stage(AllToAllStage("groupby_raw", key=key))
+                ._with_stage(MapStage(apply, "MapGroups")))

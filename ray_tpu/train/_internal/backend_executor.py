@@ -32,7 +32,7 @@ def _run_train_fn(run_id: str, run_name: str, rank: int, world_size: int,
                   mesh_config: Any, train_fn_blob: bytes,
                   config: Dict[str, Any],
                   dataset_shard_blobs: Optional[Dict[str, Any]],
-                  attempt: int = 0) -> Any:
+                  attempt: int = 0, start_iteration: int = 0) -> Any:
     """Runs inside each worker actor."""
     import cloudpickle
 
@@ -55,7 +55,8 @@ def _run_train_fn(run_id: str, run_name: str, rank: int, world_size: int,
     sess.init_session(run_id=run_id, run_name=run_name, rank=rank,
                       world_size=world_size, storage_dir=storage_dir,
                       restore_checkpoint=restore, mesh_config=mesh_config,
-                      dataset_shards=shards, attempt=attempt)
+                      dataset_shards=shards, attempt=attempt,
+                      start_iteration=start_iteration)
     try:
         train_fn = cloudpickle.loads(train_fn_blob)
         import inspect
@@ -163,7 +164,7 @@ class BackendExecutor:
                                latest_ckpt_path, self.mesh_config, fn_blob,
                                dict(config or {}),
                                shard_blobs[i] if shard_blobs else None,
-                               self.attempt)
+                               self.attempt, len(history))
                 for i, w in enumerate(wg.workers)]
             seen: set = set()
             error: Optional[BaseException] = None

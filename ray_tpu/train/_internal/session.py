@@ -69,7 +69,7 @@ class _TrainSession:
                  mesh_config: Any = None, local_rank: Optional[int] = None,
                  local_world_size: Optional[int] = None, node_rank: int = 0,
                  dataset_shards: Optional[Dict[str, Any]] = None,
-                 attempt: int = 0):
+                 attempt: int = 0, start_iteration: int = 0):
         self.run_id = run_id
         self.run_name = run_name
         self.rank = rank
@@ -83,7 +83,7 @@ class _TrainSession:
         self.node_rank = node_rank
         self.dataset_shards = dataset_shards or {}
         self.attempt = attempt
-        self.iteration = 0
+        self.iteration = start_iteration
 
     # ------------------------------------------------------------ transport
     def _kv_put(self, key: str, value: bytes) -> None:
@@ -94,8 +94,11 @@ class _TrainSession:
         self.iteration += 1
         ckpt_path = None
         if checkpoint is not None:
+            # attempt in the name: a restarted attempt must never collide
+            # with (and retention must never delete) a prior attempt's dirs
             ckpt_path = os.path.join(
-                self.storage_dir, f"checkpoint_{self.iteration:06d}",
+                self.storage_dir,
+                f"checkpoint_a{self.attempt}_{self.iteration:06d}",
                 f"rank_{self.rank}" if self.world_size > 1 else "")
             ckpt_path = ckpt_path.rstrip(os.sep)
             checkpoint.to_directory(ckpt_path)

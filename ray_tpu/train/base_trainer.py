@@ -46,9 +46,13 @@ class BaseTrainer:
             result = t.fit()
             if result.error is not None:
                 raise result.error
-            # surface final metrics to Tune
-            if result.metrics:
+            # surface final metrics to the enclosing session when one
+            # exists (a Tune trial session); plain function calls have no
+            # session — returning the metrics covers that path
+            from ray_tpu.train._internal.session import try_session
+            if result.metrics and try_session() is not None:
                 train_mod.report(result.metrics)
+            return result.metrics
 
         return _trainable
 

@@ -1,0 +1,14 @@
+"""Trial schedulers (reference: ``python/ray/tune/schedulers/``)."""
+
+from ray_tpu.tune.schedulers.trial_scheduler import (  # noqa: F401
+    FIFOScheduler, TrialScheduler,
+)
+from ray_tpu.tune.schedulers.async_hyperband import (  # noqa: F401
+    ASHAScheduler, AsyncHyperBandScheduler,
+)
+from ray_tpu.tune.schedulers.median_stopping import (  # noqa: F401
+    MedianStoppingRule,
+)
+from ray_tpu.tune.schedulers.pbt import (  # noqa: F401
+    PopulationBasedTraining,
+)

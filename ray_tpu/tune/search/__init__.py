@@ -1,0 +1,7 @@
+"""Search spaces and searchers (reference: ``python/ray/tune/search/``)."""
+
+from ray_tpu.tune.search.sample import (  # noqa: F401
+    Categorical, Domain, Float, Integer, choice, grid_search, loguniform,
+    qrandint, quniform, randint, randn, sample_from, uniform,
+)
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator  # noqa: F401

@@ -119,9 +119,15 @@ def destroy_collective_group(group_name: str = "default") -> None:
     for k, v in list(_groups.items()):
         if v is g:
             del _groups[k]
-    mine = f"/{g.rank}"
+    import re
+    # exactly this rank's phase keys (<group>/<seq>/<phase>/<rank>), its
+    # meta key, and p2p keys it SENT (<group>/p2p/<rank>-<dst>/<seq>) —
+    # never keys whose trailing seq number merely equals the rank
+    pat = re.compile(
+        rf"^{re.escape(g.group_name)}/(\d+/[a-z]+/{g.rank}"
+        rf"|meta/{g.rank}|p2p/{g.rank}-\d+/\d+)$")
     for k in g._kv_count(f"{g.group_name}/"):
-        if k.endswith(mine) or f"/{g.rank}-" in k:
+        if pat.match(k):
             g._kv_del(k)
     g.destroy()
 

@@ -165,35 +165,37 @@ class ShmCollectiveGroup:
         out = _reduce_arrays([parts[r] for r in self._ranks()], op)
         return _like(out, tensor)
 
+    def _ack_barrier(self, seq: int, timeout: float) -> None:
+        """Full all-rank ack: entering seq s+2 (which reclaims seq-s keys)
+        then provably implies every rank finished seq s.  Required for ops
+        where the main phase does not already collect from all ranks
+        (broadcast, reduce) — see module docstring invariant."""
+        self._kv_put(self._key(seq, "b", self.rank), b"")
+        self._await_keys(seq, "b", self._ranks(), timeout)
+
     def reduce(self, tensor: Any, dst_rank: int = 0,
                op: ReduceOp = ReduceOp.SUM, timeout: float = 60.0) -> Any:
-        # Ack phase keeps this op blocking for ALL ranks — the epoch
-        # reclamation invariant (module docstring) requires it.
         seq = self._next_seq()
         self._publish(seq, "t", _to_numpy(tensor))
-        if self.rank != dst_rank:
-            self._await_keys(seq, "b", [dst_rank], timeout)
-            return tensor
-        parts = self._collect(seq, "t", self._ranks(), timeout)
-        out = _like(_reduce_arrays([parts[r] for r in self._ranks()], op),
-                    tensor)
-        self._kv_put(self._key(seq, "b", dst_rank), b"")
+        out = tensor
+        if self.rank == dst_rank:
+            parts = self._collect(seq, "t", self._ranks(), timeout)
+            out = _like(_reduce_arrays([parts[r] for r in self._ranks()], op),
+                        tensor)
+        self._ack_barrier(seq, timeout)
         return out
 
     def broadcast(self, tensor: Any, src_rank: int = 0,
                   timeout: float = 60.0) -> Any:
-        # Receivers ack after reading; src blocks on the acks (epoch
-        # invariant — src must not run ahead and reclaim its tensor).
         seq = self._next_seq()
         if self.rank == src_rank:
             self._publish(seq, "t", _to_numpy(tensor))
-            others = [r for r in self._ranks() if r != src_rank]
-            if others:
-                self._await_keys(seq, "b", others, timeout)
-            return tensor
-        parts = self._collect(seq, "t", [src_rank], timeout)
-        self._kv_put(self._key(seq, "b", self.rank), b"")
-        return parts[src_rank]
+            out = tensor
+        else:
+            parts = self._collect(seq, "t", [src_rank], timeout)
+            out = _like(parts[src_rank], tensor)
+        self._ack_barrier(seq, timeout)
+        return out
 
     def allgather(self, tensor: Any, timeout: float = 60.0) -> List[Any]:
         seq = self._next_seq()
