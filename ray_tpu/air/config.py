@@ -94,6 +94,9 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    # Tune stop criteria: {"metric_or_time_attr": bound} — stop a trial
+    # once attribute >= bound (reference: ``air.RunConfig(stop=...)``)
+    stop: Optional[Dict[str, Any]] = None
 
     def resolved_storage_path(self) -> str:
         return self.storage_path or os.path.expanduser("~/ray_tpu_results")

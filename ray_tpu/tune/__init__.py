@@ -1,0 +1,37 @@
+"""``ray_tpu.tune`` — hyperparameter search & trial execution.
+
+Reference: ``python/ray/tune/`` (SURVEY.md §2.5).  ``tune.report`` shares
+the Train session transport (Train's ``fit`` and Tune trials are the same
+report plumbing — mirroring the reference where Train runs on Tune).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
+    MedianStoppingRule, PopulationBasedTraining, TrialScheduler,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator, choice, grid_search, loguniform, qrandint,
+    quniform, randint, randn, sample_from, uniform,
+)
+from ray_tpu.tune.trainable import Trainable  # noqa: F401
+from ray_tpu.tune.trial import Trial  # noqa: F401
+from ray_tpu.tune.tuner import (  # noqa: F401
+    ResultGrid, TuneConfig, Tuner, run,
+)
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Reference: ``ray.tune.report`` / ``session.report`` inside a trial."""
+    from ray_tpu.train._internal.session import get_session
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    from ray_tpu.train._internal.session import get_session
+    return get_session().get_checkpoint()

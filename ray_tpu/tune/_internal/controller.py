@@ -1,0 +1,221 @@
+"""TuneController: the experiment event loop.
+
+Reference: ``python/ray/tune/execution/tune_controller.py`` (older:
+``trial_runner.py``) — SURVEY.md §2.5: each trial is a remote execution;
+the controller polls streamed results, consults the scheduler
+(ASHA/PBT/median) for CONTINUE/STOP, enforces stop criteria, launches
+pending trials up to the concurrency cap, and persists experiment state.
+
+Trials run as framework TASKS (not long-lived actors): the trial wrapper
+installs a train session (world_size=1) so ``tune.report`` shares the
+Train report transport; early-stop is the session's cooperative stop flag
+— schedulers never hard-kill a trial mid-step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.experimental import internal_kv
+from ray_tpu.train._internal.session import NAMESPACE
+from ray_tpu.tune.schedulers.trial_scheduler import (FIFOScheduler,
+                                                     TrialScheduler)
+from ray_tpu.tune.trial import Trial
+
+_POLL = 0.02
+
+
+@ray_tpu.remote
+def _trial_task(run_id: str, fn_blob: bytes, config: Dict[str, Any],
+                storage_dir: str, restore_path: Optional[str],
+                start_iteration: int = 0) -> None:
+    """The trial wrapper (runs in a worker process)."""
+    import inspect
+
+    import cloudpickle
+
+    from ray_tpu.train._checkpoint import Checkpoint
+    from ray_tpu.train._internal import session as sess
+    from ray_tpu.train._internal.session import SessionStopped
+    from ray_tpu.tune.trainable import Trainable
+
+    restore = (Checkpoint.from_directory(restore_path)
+               if restore_path and os.path.isdir(restore_path) else None)
+    os.makedirs(storage_dir, exist_ok=True)
+    sess.init_session(run_id=run_id, run_name=run_id, rank=0, world_size=1,
+                      storage_dir=storage_dir, restore_checkpoint=restore,
+                      sync_report=True, start_iteration=start_iteration)
+    try:
+        obj = cloudpickle.loads(fn_blob)
+        if inspect.isclass(obj) and issubclass(obj, Trainable):
+            obj(config)._train_loop()
+        else:
+            result = obj(config)
+            if isinstance(result, dict):
+                sess.get_session().report(result)
+    except SessionStopped:
+        pass
+    finally:
+        sess.shutdown_session()
+
+
+class TuneController:
+    def __init__(self, trainable: Any, trials: List[Trial], *,
+                 scheduler: Optional[TrialScheduler] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 stop: Optional[Dict[str, Any]] = None,
+                 max_concurrent: int = 4, storage_root: str = "",
+                 experiment_name: str = ""):
+        import cloudpickle
+        self.fn_blob = cloudpickle.dumps(trainable)
+        self.trials = trials
+        self.scheduler = scheduler or FIFOScheduler()
+        self.scheduler.set_metric(metric, mode)
+        self.metric = metric
+        self.mode = mode
+        self.stop = stop or {}
+        self.max_concurrent = max_concurrent
+        self.storage_root = storage_root
+        self.experiment_name = experiment_name
+        os.makedirs(self.exp_dir, exist_ok=True)
+
+    @property
+    def exp_dir(self) -> str:
+        return os.path.join(self.storage_root, self.experiment_name)
+
+    def get_trial(self, trial_id: str) -> Optional[Trial]:
+        for t in self.trials:
+            if t.id == trial_id:
+                return t
+        return None
+
+    def request_clone(self, trial: Trial, config: Dict[str, Any],
+                      ckpt: str) -> None:
+        trial.prepare_clone(config, ckpt)
+
+    # ------------------------------------------------------------ transport
+    def _request_stop(self, trial: Trial) -> None:
+        if not trial.stop_requested:
+            internal_kv._internal_kv_put(f"{trial.run_id}/ctl/stop", b"1",
+                                         namespace=NAMESPACE)
+            trial.stop_requested = True
+
+    def _drain_reports(self, trial: Trial) -> None:
+        """Process every queued report: record → scheduler decision →
+        (maybe) set stop flag → ONLY THEN delete the key.  The reporter
+        blocks on key deletion (sync_report), so a STOP decision is always
+        visible to it at the next line it executes."""
+        prefix = f"{trial.run_id}/r/"
+        for k in sorted(internal_kv._internal_kv_list(prefix,
+                                                      namespace=NAMESPACE),
+                        key=lambda k: int(k.rsplit("/", 2)[1])):
+            it = int(k.rsplit("/", 2)[1])
+            if it in trial.seen_iters:
+                continue
+            blob = internal_kv._internal_kv_get(k, namespace=NAMESPACE)
+            if blob is None:
+                continue
+            payload = pickle.loads(blob)
+            trial.seen_iters.add(it)
+            metrics = dict(payload["metrics"])
+            metrics["training_iteration"] = it
+            metrics["trial_id"] = trial.id
+            if payload.get("checkpoint_path"):
+                trial.latest_checkpoint_path = payload["checkpoint_path"]
+            trial.metrics_history.append(metrics)
+            decision = self.scheduler.on_trial_result(self, trial, metrics)
+            if decision == TrialScheduler.STOP or \
+                    self._hit_stop_criteria(metrics):
+                self._request_stop(trial)
+            internal_kv._internal_kv_del(k, namespace=NAMESPACE)
+
+    # ---------------------------------------------------------------- loop
+    def _launch(self, trial: Trial) -> None:
+        storage = os.path.join(self.exp_dir, trial.id)
+        # clones continue the iteration numbering (no duplicate
+        # training_iteration rows; stop criteria stay run-global)
+        start_it = (max(trial.seen_iters | trial.all_seen_iters)
+                    if (trial.seen_iters or trial.all_seen_iters) else 0)
+        trial.ref = _trial_task.remote(trial.run_id, self.fn_blob,
+                                       trial.config, storage,
+                                       trial.restore_path, start_it)
+        trial.status = "RUNNING"
+
+    def _hit_stop_criteria(self, metrics: Dict[str, Any]) -> bool:
+        # reference semantics: stop once attribute >= bound
+        return any(metrics.get(k) is not None and metrics[k] >= bound
+                   for k, bound in self.stop.items())
+
+    def run(self) -> None:
+        while True:
+            running = [t for t in self.trials if t.status == "RUNNING"]
+            # launch up to the cap (scheduler picks order)
+            while len(running) < self.max_concurrent:
+                nxt = self.scheduler.choose_trial_to_run(self)
+                if nxt is None:
+                    break
+                self._launch(nxt)
+                running.append(nxt)
+            if not running:
+                break
+
+            for trial in running:
+                self._drain_reports(trial)
+                done, _ = ray_tpu.wait([trial.ref], num_returns=1,
+                                       timeout=0)
+                if not done:
+                    continue
+                self._drain_reports(trial)  # final sweep
+                try:
+                    ray_tpu.get(trial.ref)
+                    trial.status = "TERMINATED"
+                except (exc.RayTaskError, exc.RayActorError,
+                        exc.ObjectLostError) as e:
+                    trial.status = "ERROR"
+                    trial.error = e
+                self.scheduler.on_trial_complete(self, trial,
+                                                 trial.last_result)
+                # reclaim this launch's control/report keys
+                internal_kv._internal_kv_del(f"{trial.run_id}/ctl/stop",
+                                             namespace=NAMESPACE)
+                if trial.pending_clone is not None:
+                    trial.relaunch_as_clone()
+                self._save_experiment_state()
+            time.sleep(_POLL)
+        self._save_experiment_state()
+
+    # ------------------------------------------------------------- persist
+    def _save_experiment_state(self) -> None:
+        state = {
+            "experiment_name": self.experiment_name,
+            "metric": self.metric,
+            "mode": self.mode,
+            "trials": [{
+                "id": t.id, "config": _jsonable(t.config),
+                "status": t.status,
+                "metrics_history": _jsonable(t.metrics_history),
+                "latest_checkpoint_path": t.latest_checkpoint_path,
+            } for t in self.trials],
+        }
+        with open(os.path.join(self.exp_dir, "experiment_state.json"),
+                  "w") as f:
+            json.dump(state, f, indent=1)
+
+
+def _jsonable(x: Any) -> Any:
+    try:
+        json.dumps(x)
+        return x
+    except (TypeError, ValueError):
+        if isinstance(x, dict):
+            return {str(k): _jsonable(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return [_jsonable(v) for v in x]
+        return repr(x)

@@ -1,0 +1,67 @@
+"""Trainable: the unit Tune runs.
+
+Reference: ``python/ray/tune/trainable/trainable.py`` — function API
+(``def f(config): tune.report(...)``) and class API (``setup``/``step``/
+``save_checkpoint``/``load_checkpoint``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+class Trainable:
+    """Class-API trainable.  The trial wrapper drives: setup(config), then
+    step() per iteration (reporting its return dict), checkpointing via
+    save_checkpoint/load_checkpoint around PBT clones and restores."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = dict(config or {})
+        self.iteration = 0
+        self.setup(self.config)
+
+    # -- user hooks
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[str]:
+        return None
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- driver used by the trial wrapper
+    def _train_loop(self) -> None:
+        import shutil
+        import tempfile
+
+        from ray_tpu.train._internal.session import get_session
+        sess = get_session()
+        restore = sess.get_checkpoint()
+        if restore is not None:
+            with restore.as_directory() as d:
+                self.load_checkpoint(d)
+            self.iteration = max(self.iteration, sess.iteration)
+        try:
+            while True:
+                self.iteration += 1
+                metrics = dict(self.step())
+                tmp = tempfile.mkdtemp(prefix="rtpu_trainable_ckpt_")
+                try:
+                    self.save_checkpoint(tmp)
+                    ckpt = (Checkpoint.from_directory(tmp)
+                            if os.listdir(tmp) else None)
+                    sess.report(metrics, checkpoint=ckpt)
+                finally:
+                    shutil.rmtree(tmp, ignore_errors=True)
+        finally:
+            self.cleanup()
