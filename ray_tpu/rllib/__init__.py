@@ -1,0 +1,25 @@
+"""ray_tpu.rllib: reinforcement learning on TPU actors.
+
+Reference: ``rllib/`` (SURVEY.md §2.5, §3.5).  Rollout workers are CPU
+actors stepping vectorized envs with one jitted policy call per step; the
+learner is a jitted XLA program (PPO: all SGD epochs in one jit; IMPALA:
+V-trace update) that on TPU hardware runs on the chip.
+"""
+
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+from ray_tpu.rllib.env import RandomEnv, VectorEnv, register_env
+from ray_tpu.rllib.policy import Policy, compute_gae
+from ray_tpu.rllib.evaluation import (
+    RolloutWorker, WorkerSet, collect_metrics, synchronous_parallel_sample)
+from ray_tpu.rllib.algorithms import (
+    Algorithm, AlgorithmConfig, DQN, DQNConfig, IMPALA, IMPALAConfig, PPO,
+    PPOConfig)
+from ray_tpu.rllib.algorithms.impala import vtrace
+
+__all__ = [
+    "SampleBatch", "concat_samples", "RandomEnv", "VectorEnv",
+    "register_env", "Policy", "compute_gae", "RolloutWorker", "WorkerSet",
+    "collect_metrics", "synchronous_parallel_sample", "Algorithm",
+    "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA", "IMPALAConfig",
+    "DQN", "DQNConfig", "vtrace",
+]

@@ -1,0 +1,208 @@
+"""DQN: off-policy Q-learning with replay + target network (double-DQN).
+
+Reference: ``rllib/algorithms/dqn/`` (SURVEY.md §2.5) — epsilon-greedy
+rollouts feed a replay buffer; the learner samples uniform minibatches and
+minimizes the double-DQN TD error against a periodically-synced target net.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import models
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.evaluation import synchronous_parallel_sample
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, NEXT_OBS, OBS, REWARDS, SampleBatch, TERMINATEDS, VF_PREDS,
+    ACTION_LOGP, ACTION_DIST_INPUTS)
+
+
+class DQNPolicy:
+    """Epsilon-greedy policy over a Q-network (replaces the actor-critic
+    Policy inside RolloutWorker via ``config['policy_class']``)."""
+
+    def __init__(self, observation_space, action_space,
+                 config: Optional[dict] = None):
+        config = config or {}
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config
+        hiddens = tuple(config.get("fcnet_hiddens", (64, 64)))
+        self.model_config = models.ModelConfig(
+            obs_dim=models.flat_obs_dim(observation_space),
+            num_outputs=int(action_space.n), hiddens=hiddens)
+        self._num_layers = len(hiddens) + 1
+        seed = config.get("seed", 0)
+        self.params = models.init_q_net(jax.random.key(seed),
+                                        self.model_config)
+        self.epsilon = float(config.get("initial_epsilon", 1.0))
+        self._rng = np.random.default_rng(seed)
+        n_layers = self._num_layers
+
+        @jax.jit
+        def _q(params, obs):
+            return models.q_net_apply(params, obs, n_layers)
+
+        self._q = _q
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        q = np.asarray(self._q(self.params, jnp.asarray(obs, jnp.float32)))
+        actions = q.argmax(axis=-1)
+        if explore:
+            mask = self._rng.uniform(size=len(actions)) < self.epsilon
+            rand = self._rng.integers(0, q.shape[-1], size=len(actions))
+            actions = np.where(mask, rand, actions)
+        # VF_PREDS/logp filled so GAE postprocessing stays well-defined
+        # (unused by the DQN learner).
+        extras = {VF_PREDS: q.max(axis=-1).astype(np.float32),
+                  ACTION_LOGP: np.zeros(len(actions), np.float32),
+                  ACTION_DIST_INPUTS: q.astype(np.float32)}
+        return actions.astype(np.int64), extras
+
+    def compute_single_action(self, obs, explore: bool = True):
+        a, extras = self.compute_actions(obs[None], explore)
+        return a[0], {k: v[0] for k, v in extras.items()}
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        q = self._q(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(q.max(axis=-1))
+
+    def get_weights(self):
+        return {"params": jax.tree_util.tree_map(np.asarray, self.params),
+                "epsilon": self.epsilon}
+
+    def set_weights(self, weights):
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights["params"])
+        self.epsilon = weights["epsilon"]
+
+
+class ReplayBuffer:
+    """Uniform ring buffer over column arrays (reference:
+    ``rllib/utils/replay_buffers``)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._cols: Dict[str, np.ndarray] = {}
+        self._idx = 0
+        self._size = 0
+
+    def add_batch(self, batch: SampleBatch) -> None:
+        n = batch.count
+        for k in (OBS, ACTIONS, REWARDS, NEXT_OBS, TERMINATEDS):
+            v = batch[k]
+            if k not in self._cols:
+                self._cols[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                         v.dtype)
+            idx = (self._idx + np.arange(n)) % self.capacity
+            self._cols[k][idx] = v
+        self._idx = (self._idx + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, n: int, rng: np.random.Generator) -> SampleBatch:
+        idx = rng.integers(0, self._size, size=n)
+        return SampleBatch({k: v[idx] for k, v in self._cols.items()})
+
+    def __len__(self) -> int:
+        return self._size
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self._cfg.update({
+            "policy_class": DQNPolicy,
+            "lr": 5e-4, "buffer_size": 50_000, "learning_starts": 1000,
+            "train_batch_size": 32, "target_network_update_freq": 500,
+            "initial_epsilon": 1.0, "final_epsilon": 0.02,
+            "epsilon_timesteps": 10_000, "gamma": 0.99,
+            "rollout_fragment_length": 4, "double_q": True,
+            "num_sgd_per_step": 1,
+        })
+
+
+class DQN(Algorithm):
+    _default_config_cls = DQNConfig
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        policy = self.workers.local_worker.policy
+        self.buffer = ReplayBuffer(int(config["buffer_size"]))
+        self._optimizer = optax.adam(config["lr"])
+        self._opt_state = self._optimizer.init(policy.params)
+        self.target_params = policy.params
+        self._steps_since_target_sync = 0
+        self._sampled = 0
+        self._rng = np.random.default_rng(config.get("seed") or 0)
+        gamma = float(config["gamma"])
+        double_q = bool(config["double_q"])
+        n_layers = policy._num_layers
+        optimizer = self._optimizer
+
+        def loss_fn(params, target_params, mb):
+            q = models.q_net_apply(params, mb[OBS], n_layers)
+            q_taken = jnp.take_along_axis(
+                q, mb[ACTIONS][:, None].astype(jnp.int32), axis=1)[:, 0]
+            q_next_target = models.q_net_apply(target_params, mb[NEXT_OBS],
+                                               n_layers)
+            if double_q:
+                q_next_online = models.q_net_apply(params, mb[NEXT_OBS],
+                                                   n_layers)
+                best = jnp.argmax(q_next_online, axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_target, best[:, None], axis=1)[:, 0]
+            else:
+                q_next = q_next_target.max(axis=-1)
+            target = mb[REWARDS] + gamma * (1.0 - mb["dones"]) * \
+                jax.lax.stop_gradient(q_next)
+            td = q_taken - target
+            return jnp.square(td).mean(), jnp.abs(td).mean()
+
+        def update(params, target_params, opt_state, mb):
+            grads, td = jax.grad(loss_fn, has_aux=True)(
+                params, target_params, mb)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, td
+
+        self._update = jax.jit(update)
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._sampled / float(cfg["epsilon_timesteps"]))
+        return float(cfg["initial_epsilon"] + frac *
+                     (cfg["final_epsilon"] - cfg["initial_epsilon"]))
+
+    def training_step(self) -> Dict[str, Any]:
+        policy = self.workers.local_worker.policy
+        policy.epsilon = self._epsilon()
+        batch = synchronous_parallel_sample(self.workers)
+        self._sampled += batch.count
+        self.buffer.add_batch(batch)
+        info: Dict[str, Any] = {"epsilon": policy.epsilon,
+                                "buffer_size": len(self.buffer)}
+        if len(self.buffer) < int(self.config["learning_starts"]):
+            return info
+        for _ in range(int(self.config["num_sgd_per_step"])):
+            mb = self.buffer.sample(int(self.config["train_batch_size"]),
+                                    self._rng)
+            device_mb = {
+                OBS: jnp.asarray(mb[OBS]),
+                ACTIONS: jnp.asarray(mb[ACTIONS]),
+                REWARDS: jnp.asarray(mb[REWARDS]),
+                NEXT_OBS: jnp.asarray(mb[NEXT_OBS]),
+                "dones": jnp.asarray(mb[TERMINATEDS].astype(np.float32)),
+            }
+            policy.params, self._opt_state, td = self._update(
+                policy.params, self.target_params, self._opt_state,
+                device_mb)
+            self._steps_since_target_sync += 1
+            info["mean_td_error"] = float(td)
+        if self._steps_since_target_sync >= \
+                int(self.config["target_network_update_freq"]):
+            self.target_params = policy.params
+            self._steps_since_target_sync = 0
+        self.workers.sync_weights()
+        return info

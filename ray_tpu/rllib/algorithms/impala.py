@@ -1,0 +1,174 @@
+"""IMPALA: async actor-learner with V-trace off-policy correction.
+
+Reference: ``rllib/algorithms/impala/`` (SURVEY.md §3.5) — rollout actors
+continuously push batches to a learner queue; the learner applies V-trace
+(Espeholt et al. 2018) to correct for policy lag, then broadcasts weights.
+Rebuilt: the "queue" is the object store — each worker keeps exactly one
+in-flight ``sample_with_weights`` future; the learner drains ready futures
+with ``ray_tpu.wait`` and re-issues them carrying the freshest weights ref,
+so sampling and the jitted learner step overlap without a learner thread.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.sample_batch import (
+    ACTION_LOGP, ACTIONS, NEXT_OBS, OBS, REWARDS, SampleBatch, TERMINATEDS,
+    TRUNCATEDS)
+
+
+def vtrace(behavior_logp, target_logp, rewards, discounts, values,
+           bootstrap_value, clip_rho: float = 1.0, clip_c: float = 1.0):
+    """V-trace targets + policy-gradient advantages.
+
+    All inputs time-major ``[T, B]``; ``bootstrap_value`` is ``[B]``.
+    Returns ``(vs [T,B], pg_advantages [T,B])``.
+    """
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+    values_next = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_next - values)
+
+    def backward(acc, t):
+        delta, discount, c = t
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = values + vs_minus_v
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = clipped_rhos * (rewards + discounts * vs_next - values)
+    return vs, pg_adv
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self._cfg.update({
+            "lr": 5e-4, "num_workers": 2, "rollout_fragment_length": 50,
+            "vtrace_clip_rho_threshold": 1.0,
+            "vtrace_clip_pg_rho_threshold": 1.0,
+            "vf_loss_coeff": 0.5, "entropy_coeff": 0.01, "grad_clip": 40.0,
+            "num_batches_per_iteration": 10,
+        })
+
+
+class IMPALA(Algorithm):
+    _default_config_cls = IMPALAConfig
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        policy = self.workers.local_worker.policy
+        apply_fn = policy.apply_fn
+        dist = policy.dist_class
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(config["grad_clip"]),
+            optax.rmsprop(config["lr"], decay=0.99, eps=0.1))
+        self._opt_state = self._optimizer.init(policy.params)
+        gamma = float(config["gamma"])
+        clip_rho = float(config["vtrace_clip_rho_threshold"])
+        vf_coeff = float(config["vf_loss_coeff"])
+        ent_coeff = float(config["entropy_coeff"])
+        optimizer = self._optimizer
+
+        def loss_fn(params, batch):
+            # batch cols are [T, B, ...]; flatten for the net, reshape back.
+            T, B = batch[REWARDS].shape
+            obs = batch[OBS].reshape((T * B,) + batch[OBS].shape[2:])
+            inputs, values = apply_fn(params, obs)
+            actions = batch[ACTIONS].reshape((T * B,))
+            target_logp = dist.logp(inputs, actions).reshape((T, B))
+            entropy = dist.entropy(inputs).mean()
+            values = values.reshape((T, B))
+            last_obs = batch[NEXT_OBS][-1]
+            _, bootstrap = apply_fn(params, last_obs)
+            discounts = gamma * (1.0 - batch["dones"])
+            vs, pg_adv = vtrace(
+                batch[ACTION_LOGP], target_logp, batch[REWARDS],
+                discounts, values, bootstrap, clip_rho, clip_rho)
+            vs = jax.lax.stop_gradient(vs)
+            pg_adv = jax.lax.stop_gradient(pg_adv)
+            pi_loss = -(target_logp * pg_adv).mean()
+            vf_loss = 0.5 * jnp.square(vs - values).mean()
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, (pi_loss, vf_loss, entropy)
+
+        def update(params, opt_state, batch):
+            grads, aux = jax.grad(loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            pi_loss, vf_loss, entropy = aux
+            return params, opt_state, {
+                "policy_loss": pi_loss, "vf_loss": vf_loss,
+                "entropy": entropy}
+
+        self._update = jax.jit(update)
+        self._in_flight: Dict[Any, Any] = {}  # future -> worker
+        self._trained_steps = 0
+
+    def _to_time_major(self, batch: SampleBatch) -> Dict[str, jnp.ndarray]:
+        """Worker fragments arrive env-major ([env0 t0..T, env1 t0..T, ...]);
+        reshape to [T, B] for vtrace."""
+        T = int(self.config["rollout_fragment_length"])
+        B = batch.count // T
+        out = {}
+        for k in (OBS, ACTIONS, REWARDS, ACTION_LOGP, NEXT_OBS):
+            v = batch[k][:B * T]
+            out[k] = jnp.asarray(
+                v.reshape((B, T) + v.shape[1:]).swapaxes(0, 1))
+        dones = (batch[TERMINATEDS] | batch[TRUNCATEDS])[:B * T]
+        out["dones"] = jnp.asarray(
+            dones.reshape((B, T)).swapaxes(0, 1).astype(np.float32))
+        return out
+
+    def _learn_on(self, batch: SampleBatch) -> Dict[str, float]:
+        policy = self.workers.local_worker.policy
+        tm = self._to_time_major(batch)
+        policy.params, self._opt_state, info = self._update(
+            policy.params, self._opt_state, tm)
+        self._trained_steps += batch.count
+        return {k: float(v) for k, v in info.items()}
+
+    def training_step(self) -> Dict[str, Any]:
+        remotes = self.workers.remote_workers
+        n_batches = int(self.config["num_batches_per_iteration"])
+        info: Dict[str, float] = {}
+        if not remotes:  # degenerate sync mode for tests
+            for _ in range(n_batches):
+                info = self._learn_on(self.workers.local_worker.sample())
+            info["num_env_steps_trained"] = self._trained_steps
+            return info
+        # Prime one in-flight sample per worker.
+        weights_ref = ray_tpu.put(
+            self.workers.local_worker.get_weights())
+        for w in remotes:
+            if w not in [v for v in self._in_flight.values()]:
+                self._in_flight[w.sample_with_weights.remote(
+                    weights_ref)] = w
+        processed = 0
+        while processed < n_batches:
+            ready, _ = ray_tpu.wait(list(self._in_flight),
+                                    num_returns=1)
+            fut = ready[0]
+            worker = self._in_flight.pop(fut)
+            batch = ray_tpu.get(fut)
+            info = self._learn_on(batch)
+            processed += 1
+            # Re-issue immediately with the freshest weights.
+            weights_ref = ray_tpu.put(
+                self.workers.local_worker.get_weights())
+            self._in_flight[worker.sample_with_weights.remote(
+                weights_ref)] = worker
+        info["num_env_steps_trained"] = self._trained_steps
+        return info

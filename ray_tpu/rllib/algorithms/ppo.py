@@ -1,0 +1,145 @@
+"""PPO: synchronous on-policy sampling + clipped-surrogate SGD.
+
+Reference: ``rllib/algorithms/ppo/ppo.py`` (SURVEY.md §3.5) — sample across
+the WorkerSet, run SGD epochs over minibatches, broadcast weights.  Rebuilt
+TPU-first: the ENTIRE update (all epochs × all minibatches, with a fresh
+shuffle per epoch) is one jitted XLA program via nested ``lax.scan``, so the
+learner launches a single device computation per iteration instead of
+hundreds of small optimizer steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.evaluation import synchronous_parallel_sample
+from ray_tpu.rllib.sample_batch import (
+    ACTION_DIST_INPUTS, ACTION_LOGP, ACTIONS, ADVANTAGES, OBS, SampleBatch,
+    VALUE_TARGETS, VF_PREDS)
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PPO)
+        self._cfg.update({
+            "lr": 5e-5, "lambda": 0.95, "clip_param": 0.2,
+            "vf_clip_param": 10.0, "vf_loss_coeff": 1.0,
+            "entropy_coeff": 0.0, "kl_coeff": 0.2, "kl_target": 0.01,
+            "num_sgd_iter": 10, "sgd_minibatch_size": 128,
+            "train_batch_size": 4000, "grad_clip": 0.5,
+        })
+
+
+class PPO(Algorithm):
+    _default_config_cls = PPOConfig
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg = config
+        policy = self.workers.local_worker.policy
+        apply_fn = policy.apply_fn
+        dist = policy.dist_class
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg["grad_clip"]),
+            optax.adam(cfg["lr"]))
+        self._opt_state = self._optimizer.init(policy.params)
+        self._kl_coeff = float(cfg["kl_coeff"])
+        clip = cfg["clip_param"]
+        vf_clip = cfg["vf_clip_param"]
+        vf_coeff = cfg["vf_loss_coeff"]
+        ent_coeff = cfg["entropy_coeff"]
+        kl_target = cfg["kl_target"]
+        num_epochs = int(cfg["num_sgd_iter"])
+        mb_size = int(cfg["sgd_minibatch_size"])
+        optimizer = self._optimizer
+
+        def loss_fn(params, mb, kl_coeff):
+            inputs, values = apply_fn(params, mb[OBS])
+            logp = dist.logp(inputs, mb[ACTIONS])
+            ratio = jnp.exp(logp - mb[ACTION_LOGP])
+            adv = mb[ADVANTAGES]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            # Clipped value loss (reference vf_clip_param semantics).
+            vf_err = jnp.square(values - mb[VALUE_TARGETS])
+            v_clipped = mb[VF_PREDS] + jnp.clip(
+                values - mb[VF_PREDS], -vf_clip, vf_clip)
+            vf_err_clipped = jnp.square(v_clipped - mb[VALUE_TARGETS])
+            vf_loss = jnp.maximum(vf_err, vf_err_clipped).mean()
+            entropy = dist.entropy(inputs).mean()
+            kl = dist.kl(mb[ACTION_DIST_INPUTS], inputs).mean()
+            total = (-surr.mean() + vf_coeff * vf_loss
+                     - ent_coeff * entropy + kl_coeff * kl)
+            return total, (kl, entropy, vf_loss, -surr.mean())
+
+        def update(params, opt_state, batch, kl_coeff, key):
+            n = batch[OBS].shape[0]
+            num_mb = max(n // mb_size, 1)
+            usable = num_mb * mb_size
+
+            def epoch_step(carry, ekey):
+                params, opt_state = carry
+                perm = jax.random.permutation(ekey, n)[:usable]
+                shuffled = jax.tree_util.tree_map(
+                    lambda v: v[perm].reshape((num_mb, mb_size)
+                                              + v.shape[1:]), batch)
+
+                def mb_step(carry, mb):
+                    params, opt_state = carry
+                    grads, aux = jax.grad(loss_fn, has_aux=True)(
+                        params, mb, kl_coeff)
+                    updates, opt_state = optimizer.update(grads, opt_state,
+                                                          params)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), jnp.stack(aux)
+
+                carry, auxes = jax.lax.scan(mb_step, (params, opt_state),
+                                            shuffled)
+                return carry, auxes[-1]  # last-minibatch stats per epoch
+
+            (params, opt_state), stats = jax.lax.scan(
+                epoch_step, (params, opt_state), jax.random.split(
+                    key, num_epochs))
+            kl, entropy, vf_loss, pi_loss = stats[-1]
+            return params, opt_state, {
+                "kl": kl, "entropy": entropy, "vf_loss": vf_loss,
+                "policy_loss": pi_loss}
+
+        self._update = jax.jit(update)
+        self._key = jax.random.key(cfg.get("seed") or 0)
+        self._kl_target = kl_target
+
+    def training_step(self) -> Dict[str, Any]:
+        batch = synchronous_parallel_sample(self.workers)
+        policy = self.workers.local_worker.policy
+        device_batch = {k: jnp.asarray(batch[k]) for k in
+                        (OBS, ACTIONS, ACTION_LOGP, ACTION_DIST_INPUTS,
+                         ADVANTAGES, VALUE_TARGETS, VF_PREDS)}
+        self._key, sub = jax.random.split(self._key)
+        policy.params, self._opt_state, info = self._update(
+            policy.params, self._opt_state, device_batch,
+            self._kl_coeff, sub)
+        info = {k: float(v) for k, v in info.items()}
+        # Adaptive KL penalty (reference: ``update_kl``).
+        if info["kl"] > 2.0 * self._kl_target:
+            self._kl_coeff *= 1.5
+        elif info["kl"] < 0.5 * self._kl_target:
+            self._kl_coeff *= 0.5
+        info["kl_coeff"] = self._kl_coeff
+        info["num_env_steps_trained"] = batch.count
+        self.workers.sync_weights()
+        return info
+
+    def get_extra_state(self):
+        return {"kl_coeff": self._kl_coeff}
+
+    def set_extra_state(self, state):
+        if state:
+            self._kl_coeff = state["kl_coeff"]

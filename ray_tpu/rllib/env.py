@@ -1,0 +1,158 @@
+"""Environment layer: registry, RandomEnv, and synchronous vectorization.
+
+Reference: ``rllib/env/`` (SURVEY.md §2.5) — RLlib wraps gym envs and steps
+them in a vectorized inner loop inside each RolloutWorker.  Rebuilt against
+the gymnasium 1.x API (``reset() -> (obs, info)``, ``step() -> (obs, r,
+terminated, truncated, info)``); ``RandomEnv`` mirrors the reference's
+fake-env test pattern (``rllib/env/tests``, SURVEY.md §4) so worker/algorithm
+tests run without real env dynamics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+_ENV_REGISTRY: Dict[str, Callable[[dict], Any]] = {}
+
+
+def register_env(name: str, creator: Callable[[dict], Any]) -> None:
+    """Reference: ``ray.tune.registry.register_env``."""
+    _ENV_REGISTRY[name] = creator
+
+
+class _Box:
+    def __init__(self, low, high, shape, dtype=np.float32):
+        self.low, self.high = low, high
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def sample(self, rng=None):
+        rng = rng or np.random.default_rng()
+        lo = np.broadcast_to(np.asarray(self.low, self.dtype), self.shape)
+        hi = np.broadcast_to(np.asarray(self.high, self.dtype), self.shape)
+        return rng.uniform(lo, hi).astype(self.dtype)
+
+
+class _Discrete:
+    def __init__(self, n: int):
+        self.n = int(n)
+        self.shape = ()
+        self.dtype = np.int64
+
+    def sample(self, rng=None):
+        rng = rng or np.random.default_rng()
+        return int(rng.integers(self.n))
+
+
+def make_box(low, high, shape, dtype=np.float32):
+    try:
+        from gymnasium import spaces
+        return spaces.Box(low=low, high=high, shape=shape, dtype=dtype)
+    except ImportError:
+        return _Box(low, high, shape, dtype)
+
+
+def make_discrete(n: int):
+    try:
+        from gymnasium import spaces
+        return spaces.Discrete(n)
+    except ImportError:
+        return _Discrete(n)
+
+
+class RandomEnv:
+    """Uniform-random observations/rewards; episode length is configurable.
+    The reference's fake-env test workhorse."""
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.obs_dim = int(config.get("obs_dim", 4))
+        self.num_actions = int(config.get("num_actions", 2))
+        self.episode_len = int(config.get("episode_len", 20))
+        self.observation_space = make_box(-1.0, 1.0, (self.obs_dim,))
+        self.action_space = make_discrete(self.num_actions)
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        self._t += 1
+        terminated = False
+        truncated = self._t >= self.episode_len
+        return self._obs(), float(self._rng.uniform()), terminated, \
+            truncated, {}
+
+    def _obs(self):
+        return self._rng.uniform(-1, 1, (self.obs_dim,)).astype(np.float32)
+
+
+register_env("RandomEnv", lambda cfg: RandomEnv(cfg))
+
+
+def create_env(env: Any, env_config: Optional[dict] = None):
+    """Resolve an env spec: registered name, gymnasium id, class, or
+    callable."""
+    env_config = env_config or {}
+    if isinstance(env, str):
+        if env in _ENV_REGISTRY:
+            return _ENV_REGISTRY[env](env_config)
+        import gymnasium
+        return gymnasium.make(env, **env_config)
+    if isinstance(env, type):
+        return env(env_config)
+    if callable(env):
+        return env(env_config)
+    raise ValueError(f"cannot create env from {env!r}")
+
+
+class VectorEnv:
+    """N sub-envs stepped synchronously with auto-reset.
+
+    Reference behavior: ``rllib/env/vector_env.py`` — on termination or
+    truncation the sub-env resets immediately and the *reset* obs is
+    returned, while done flags mark the boundary for the sampler.
+    """
+
+    def __init__(self, env_creator: Callable[[], Any], num_envs: int,
+                 seed: Optional[int] = None):
+        self.envs = [env_creator() for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.observation_space = self.envs[0].observation_space
+        self.action_space = self.envs[0].action_space
+        self._seed = seed
+
+    def reset_all(self) -> np.ndarray:
+        obs = []
+        for i, e in enumerate(self.envs):
+            seed = None if self._seed is None else self._seed + i
+            o, _ = e.reset(seed=seed)
+            obs.append(o)
+        return np.stack(obs)
+
+    def step(self, actions: np.ndarray):
+        """Returns (obs, final_obs, rewards, terminateds, truncateds).
+
+        ``obs`` feeds the next policy step (post-auto-reset at done slots);
+        ``final_obs`` is the true successor observation (pre-reset), needed
+        to bootstrap truncated episodes correctly.
+        """
+        obs, finals, rews, terms, truncs = [], [], [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, term, trunc, _ = e.step(a)
+            finals.append(o)
+            if term or trunc:
+                o, _ = e.reset()
+            obs.append(o)
+            rews.append(r)
+            terms.append(term)
+            truncs.append(trunc)
+        return (np.stack(obs), np.stack(finals),
+                np.asarray(rews, np.float32),
+                np.asarray(terms), np.asarray(truncs))

@@ -1,0 +1,189 @@
+"""RolloutWorker + WorkerSet: the sampling side of every algorithm.
+
+Reference: ``rllib/evaluation/rollout_worker.py`` + ``WorkerSet``
+(SURVEY.md §2.5, §3.5) — each worker holds env(s) + a policy copy, steps the
+vectorized env in its hot loop, and emits SampleBatches; the set is 1 local
+worker + N remote actors.  Rebuilt: the policy inference inside the loop is
+a single jitted call over the whole vector of envs.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import env as env_lib
+from ray_tpu.rllib.policy import Policy, compute_gae
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, EPS_ID, OBS, NEXT_OBS, REWARDS, SampleBatch, TERMINATEDS,
+    TRUNCATEDS, VF_PREDS, concat_samples)
+
+
+class RolloutWorker:
+    """Holds ``num_envs_per_worker`` envs + a policy; ``sample()`` returns a
+    postprocessed SampleBatch of ``rollout_fragment_length *
+    num_envs_per_worker`` timesteps."""
+
+    def __init__(self, config: Dict[str, Any], worker_index: int = 0):
+        self.config = dict(config)
+        self.worker_index = worker_index
+        num_envs = int(config.get("num_envs_per_worker", 1))
+        seed = config.get("seed")
+        if seed is not None:
+            seed = int(seed) + 1000 * worker_index
+            np.random.seed(seed)
+        creator = lambda: env_lib.create_env(  # noqa: E731
+            config["env"], config.get("env_config"))
+        self.vector_env = env_lib.VectorEnv(creator, num_envs, seed=seed)
+        pol_config = dict(config)
+        pol_config["seed"] = (seed or 0) + 17
+        policy_cls = config.get("policy_class") or Policy
+        self.policy = policy_cls(self.vector_env.observation_space,
+                                 self.vector_env.action_space, pol_config)
+        self.fragment_length = int(config.get("rollout_fragment_length", 200))
+        self.gamma = float(config.get("gamma", 0.99))
+        self.lam = float(config.get("lambda", 0.95))
+        self._obs = self.vector_env.reset_all()
+        self._eps_ids = np.arange(num_envs, dtype=np.int64) \
+            + 1_000_000 * worker_index
+        self._next_eps_id = num_envs
+        self._ep_rewards = np.zeros(num_envs, np.float64)
+        self._ep_lens = np.zeros(num_envs, np.int64)
+        self._completed: collections.deque = collections.deque(maxlen=100)
+        self._total_steps = 0
+
+    def sample(self) -> SampleBatch:
+        num_envs = self.vector_env.num_envs
+        T = self.fragment_length
+        cols: Dict[str, list] = collections.defaultdict(list)
+        for _ in range(T):
+            actions, extras = self.policy.compute_actions(self._obs)
+            next_obs, final_obs, rewards, terms, truncs = \
+                self.vector_env.step(actions)
+            cols[OBS].append(self._obs)
+            cols[ACTIONS].append(actions)
+            cols[REWARDS].append(rewards)
+            cols[NEXT_OBS].append(final_obs)
+            cols[TERMINATEDS].append(terms)
+            cols[TRUNCATEDS].append(truncs)
+            cols[EPS_ID].append(self._eps_ids.copy())
+            for k, v in extras.items():
+                cols[k].append(v)
+            self._ep_rewards += rewards
+            self._ep_lens += 1
+            done = terms | truncs
+            for i in np.flatnonzero(done):
+                self._completed.append(
+                    (float(self._ep_rewards[i]), int(self._ep_lens[i])))
+                self._ep_rewards[i] = 0.0
+                self._ep_lens[i] = 0
+                self._eps_ids[i] = (1_000_000 * self.worker_index
+                                    + self._next_eps_id)
+                self._next_eps_id += 1
+            self._obs = next_obs
+            self._total_steps += num_envs
+
+        # [T, num_envs, ...] → per-env rows, then postprocess per episode.
+        stacked = {k: np.stack(v) for k, v in cols.items()}
+        per_env = []
+        for i in range(num_envs):
+            env_batch = SampleBatch({k: v[:, i] for k, v in stacked.items()})
+            for ep in env_batch.split_by_episode():
+                # Terminated → compute_gae bootstraps 0; truncated or
+                # fragment-cut → bootstrap with V(true final obs).
+                last_value = float(self.policy.value(ep[NEXT_OBS][-1:])[0])
+                per_env.append(compute_gae(ep, last_value, self.gamma,
+                                           self.lam))
+        return concat_samples(per_env)
+
+    def sample_with_weights(self, weights: Optional[dict]) -> SampleBatch:
+        """One round trip: set weights then sample (IMPALA-style pipeline)."""
+        if weights is not None:
+            self.policy.set_weights(weights)
+        return self.sample()
+
+    def get_weights(self) -> dict:
+        return self.policy.get_weights()
+
+    def set_weights(self, weights: dict) -> None:
+        self.policy.set_weights(weights)
+
+    def get_metrics(self) -> Dict[str, Any]:
+        eps = list(self._completed)
+        self._completed.clear()
+        return {
+            "episode_rewards": [r for r, _ in eps],
+            "episode_lens": [l for _, l in eps],
+            "num_env_steps": self._total_steps,
+        }
+
+    def get_spaces(self):
+        return (self.vector_env.observation_space,
+                self.vector_env.action_space)
+
+
+class WorkerSet:
+    """1 local worker (learner-side policy + spaces) + N remote actors."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        self.local_worker = RolloutWorker(config, worker_index=0)
+        num_workers = int(config.get("num_workers", 0))
+        remote_cls = ray_tpu.remote(RolloutWorker).options(
+            num_cpus=config.get("num_cpus_per_worker", 1))
+        self.remote_workers: List = [
+            remote_cls.remote(config, worker_index=i + 1)
+            for i in range(num_workers)]
+
+    def sync_weights(self) -> None:
+        """Broadcast local weights to all remotes via one object-store put."""
+        if not self.remote_workers:
+            return
+        ref = ray_tpu.put(self.local_worker.get_weights())
+        ray_tpu.get([w.set_weights.remote(ref)
+                     for w in self.remote_workers])
+
+    def stop(self) -> None:
+        for w in self.remote_workers:
+            ray_tpu.kill(w)
+        self.remote_workers = []
+
+
+def synchronous_parallel_sample(worker_set: WorkerSet) -> SampleBatch:
+    """Reference: ``rllib/execution/rollout_ops.py`` — one sample() round
+    across the set (remote if any remotes, else local)."""
+    if worker_set.remote_workers:
+        batches = ray_tpu.get(
+            [w.sample.remote() for w in worker_set.remote_workers])
+    else:
+        batches = [worker_set.local_worker.sample()]
+    return concat_samples(batches)
+
+
+def collect_metrics(worker_set: WorkerSet) -> Dict[str, Any]:
+    if worker_set.remote_workers:
+        metrics = ray_tpu.get([w.get_metrics.remote()
+                               for w in worker_set.remote_workers])
+    else:
+        metrics = [worker_set.local_worker.get_metrics()]
+    rewards: List[float] = []
+    lens: List[int] = []
+    steps = 0
+    for m in metrics:
+        rewards += m["episode_rewards"]
+        lens += m["episode_lens"]
+        steps += m["num_env_steps"]
+    return {
+        "episode_reward_mean": float(np.mean(rewards)) if rewards else
+        float("nan"),
+        "episode_reward_max": float(np.max(rewards)) if rewards else
+        float("nan"),
+        "episode_reward_min": float(np.min(rewards)) if rewards else
+        float("nan"),
+        "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+        "episodes_this_iter": len(rewards),
+        "num_env_steps_sampled": steps,
+    }

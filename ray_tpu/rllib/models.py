@@ -1,0 +1,165 @@
+"""Policy/value networks and action distributions — pure JAX.
+
+Reference: ``rllib/models/`` catalog + ``ModelV2`` (SURVEY.md §2.5).  The
+reference builds torch/tf modules; here networks are (init, apply) function
+pairs over pytrees so the whole learner step jits into one XLA program —
+the MXU sees a handful of batched matmuls per update, nothing else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    obs_dim: int
+    num_outputs: int          # logits dim (discrete: n; gaussian: 2*act_dim)
+    hiddens: Tuple[int, ...] = (256, 256)
+    free_log_std: bool = False
+
+
+def _init_linear(key, fan_in, fan_out, scale=np.sqrt(2)):
+    """Orthogonal init — the standard PPO-stability choice."""
+    w = jax.random.orthogonal(key, max(fan_in, fan_out))[:fan_in, :fan_out]
+    return {"w": (w * scale).astype(jnp.float32),
+            "b": jnp.zeros((fan_out,), jnp.float32)}
+
+
+def init_actor_critic(key: jax.Array, cfg: ModelConfig) -> Params:
+    """Separate policy and value towers (reference default: two MLPs)."""
+    sizes = (cfg.obs_dim, *cfg.hiddens)
+    keys = jax.random.split(key, 2 * len(cfg.hiddens) + 2)
+    params: Params = {}
+    for tower in ("pi", "vf"):
+        off = 0 if tower == "pi" else len(cfg.hiddens) + 1
+        for i, (fi, fo) in enumerate(zip(sizes[:-1], sizes[1:])):
+            params[f"{tower}_{i}"] = _init_linear(keys[off + i], fi, fo)
+    params["pi_out"] = _init_linear(keys[len(cfg.hiddens)],
+                                    sizes[-1], cfg.num_outputs, scale=0.01)
+    params["vf_out"] = _init_linear(keys[-1], sizes[-1], 1, scale=1.0)
+    return params
+
+
+def actor_critic_apply(params: Params, obs: jax.Array,
+                       num_hidden: int) -> Tuple[jax.Array, jax.Array]:
+    """Returns (dist_inputs [B, num_outputs], values [B])."""
+    x = obs
+    for i in range(num_hidden):
+        p = params[f"pi_{i}"]
+        x = jnp.tanh(x @ p["w"] + p["b"])
+    logits = x @ params["pi_out"]["w"] + params["pi_out"]["b"]
+    v = obs
+    for i in range(num_hidden):
+        p = params[f"vf_{i}"]
+        v = jnp.tanh(v @ p["w"] + p["b"])
+    values = (v @ params["vf_out"]["w"] + params["vf_out"]["b"])[:, 0]
+    return logits, values
+
+
+def init_q_net(key: jax.Array, cfg: ModelConfig) -> Params:
+    sizes = (cfg.obs_dim, *cfg.hiddens, cfg.num_outputs)
+    keys = jax.random.split(key, len(sizes) - 1)
+    return {f"q_{i}": _init_linear(k, fi, fo)
+            for i, (k, fi, fo) in enumerate(zip(keys, sizes[:-1], sizes[1:]))}
+
+
+def q_net_apply(params: Params, obs: jax.Array, num_layers: int) -> jax.Array:
+    x = obs
+    for i in range(num_layers):
+        p = params[f"q_{i}"]
+        x = x @ p["w"] + p["b"]
+        if i < num_layers - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+# ---------------------------------------------------------------- dists
+
+class Categorical:
+    """Discrete action distribution over logits."""
+
+    @staticmethod
+    def sample(logits: jax.Array, key: jax.Array) -> jax.Array:
+        return jax.random.categorical(key, logits)
+
+    @staticmethod
+    def logp(logits: jax.Array, actions: jax.Array) -> jax.Array:
+        logp_all = jax.nn.log_softmax(logits)
+        return jnp.take_along_axis(
+            logp_all, actions[:, None].astype(jnp.int32), axis=1)[:, 0]
+
+    @staticmethod
+    def entropy(logits: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    @staticmethod
+    def kl(logits_p: jax.Array, logits_q: jax.Array) -> jax.Array:
+        lp, lq = jax.nn.log_softmax(logits_p), jax.nn.log_softmax(logits_q)
+        return jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1)
+
+    @staticmethod
+    def deterministic(logits: jax.Array) -> jax.Array:
+        return jnp.argmax(logits, axis=-1)
+
+
+class DiagGaussian:
+    """Continuous actions; dist_inputs = concat(mean, log_std)."""
+
+    @staticmethod
+    def _split(inputs):
+        mean, log_std = jnp.split(inputs, 2, axis=-1)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+    @staticmethod
+    def sample(inputs: jax.Array, key: jax.Array) -> jax.Array:
+        mean, log_std = DiagGaussian._split(inputs)
+        return mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+
+    @staticmethod
+    def logp(inputs: jax.Array, actions: jax.Array) -> jax.Array:
+        mean, log_std = DiagGaussian._split(inputs)
+        z = (actions - mean) / jnp.exp(log_std)
+        return jnp.sum(-0.5 * z**2 - log_std
+                       - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
+    @staticmethod
+    def entropy(inputs: jax.Array) -> jax.Array:
+        _, log_std = DiagGaussian._split(inputs)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    @staticmethod
+    def kl(inputs_p: jax.Array, inputs_q: jax.Array) -> jax.Array:
+        mp, lp = DiagGaussian._split(inputs_p)
+        mq, lq = DiagGaussian._split(inputs_q)
+        return jnp.sum(lq - lp + (jnp.exp(2 * lp) + (mp - mq) ** 2)
+                       / (2 * jnp.exp(2 * lq)) - 0.5, axis=-1)
+
+    @staticmethod
+    def deterministic(inputs: jax.Array) -> jax.Array:
+        mean, _ = DiagGaussian._split(inputs)
+        return mean
+
+
+def get_dist_class(action_space):
+    if hasattr(action_space, "n"):
+        return Categorical
+    return DiagGaussian
+
+
+def num_dist_inputs(action_space) -> int:
+    if hasattr(action_space, "n"):
+        return int(action_space.n)
+    return 2 * int(np.prod(action_space.shape))
+
+
+def flat_obs_dim(observation_space) -> int:
+    return int(np.prod(observation_space.shape))

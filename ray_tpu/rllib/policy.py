@@ -1,0 +1,117 @@
+"""Policy: network + action distribution + (algorithm-supplied) loss.
+
+Reference: ``rllib/policy/policy.py`` / ``torch_policy.py`` (SURVEY.md §2.5)
+— ``compute_actions`` drives sampling, ``learn_on_batch`` drives training,
+weights move between learner and rollout workers as flat numpy dicts.
+Rebuilt so ``compute_actions`` is one jitted XLA call per env-step batch and
+all learner math lives in algorithm-owned jitted update fns.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib import models
+from ray_tpu.rllib.sample_batch import (
+    ACTION_DIST_INPUTS, ACTION_LOGP, REWARDS, SampleBatch, TERMINATEDS,
+    TRUNCATEDS, VF_PREDS, ADVANTAGES, VALUE_TARGETS)
+
+
+class Policy:
+    """Actor-critic policy over a flat observation space."""
+
+    def __init__(self, observation_space, action_space,
+                 config: Optional[dict] = None):
+        config = config or {}
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config
+        self.dist_class = models.get_dist_class(action_space)
+        hiddens = tuple(config.get("fcnet_hiddens", (256, 256)))
+        self.model_config = models.ModelConfig(
+            obs_dim=models.flat_obs_dim(observation_space),
+            num_outputs=models.num_dist_inputs(action_space),
+            hiddens=hiddens)
+        seed = config.get("seed", 0)
+        self.params = models.init_actor_critic(
+            jax.random.key(seed), self.model_config)
+        self._key = jax.random.key(seed + 1)
+        n_hidden = len(hiddens)
+        dist = self.dist_class
+
+        @jax.jit
+        def _act(params, obs, key):
+            inputs, values = models.actor_critic_apply(params, obs, n_hidden)
+            actions = dist.sample(inputs, key)
+            logp = dist.logp(inputs, actions)
+            return actions, logp, inputs, values
+
+        @jax.jit
+        def _act_det(params, obs):
+            inputs, values = models.actor_critic_apply(params, obs, n_hidden)
+            return dist.deterministic(inputs), inputs, values
+
+        self._act, self._act_det = _act, _act_det
+
+    def apply_fn(self, params, obs):
+        """(dist_inputs, values) — used by algorithm loss fns."""
+        return models.actor_critic_apply(
+            params, obs, len(self.model_config.hiddens))
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True
+                        ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        obs = jnp.asarray(obs, jnp.float32)
+        if explore:
+            self._key, sub = jax.random.split(self._key)
+            actions, logp, inputs, values = self._act(self.params, obs, sub)
+            extras = {ACTION_LOGP: np.asarray(logp),
+                      ACTION_DIST_INPUTS: np.asarray(inputs),
+                      VF_PREDS: np.asarray(values)}
+        else:
+            actions, inputs, values = self._act_det(self.params, obs)
+            extras = {ACTION_DIST_INPUTS: np.asarray(inputs),
+                      VF_PREDS: np.asarray(values)}
+        return np.asarray(actions), extras
+
+    def compute_single_action(self, obs: np.ndarray, explore: bool = True):
+        a, extras = self.compute_actions(obs[None], explore)
+        return a[0], {k: v[0] for k, v in extras.items()}
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        _, _, values = self._act_det(self.params, jnp.asarray(obs,
+                                                             jnp.float32))
+        return np.asarray(values)
+
+    def get_weights(self) -> Dict[str, Any]:
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+
+def compute_gae(batch: SampleBatch, last_value: float, gamma: float,
+                lam: float) -> SampleBatch:
+    """GAE(λ) advantages + value targets for one episode fragment.
+
+    Reference: ``rllib/evaluation/postprocessing.py::compute_advantages``.
+    Runs in numpy on the rollout worker (tiny, latency-bound — not MXU work).
+    ``last_value`` bootstraps truncated fragments; 0 for terminated episodes.
+    """
+    rewards = batch[REWARDS]
+    vf = batch[VF_PREDS]
+    terminated = bool(batch[TERMINATEDS][-1]) if len(batch) else False
+    bootstrap = 0.0 if terminated else float(last_value)
+    vf_next = np.append(vf[1:], bootstrap).astype(np.float32)
+    deltas = rewards + gamma * vf_next - vf
+    adv = np.zeros_like(rewards)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        acc = deltas[t] + gamma * lam * acc
+        adv[t] = acc
+    batch[ADVANTAGES] = adv.astype(np.float32)
+    batch[VALUE_TARGETS] = (adv + vf).astype(np.float32)
+    return batch

@@ -7,8 +7,7 @@ report plumbing — mirroring the reference where Train runs on Tune).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
-
+from ray_tpu.train import get_checkpoint, report  # noqa: F401
 from ray_tpu.train._checkpoint import Checkpoint  # noqa: F401
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
@@ -23,15 +22,3 @@ from ray_tpu.tune.trial import Trial  # noqa: F401
 from ray_tpu.tune.tuner import (  # noqa: F401
     ResultGrid, TuneConfig, Tuner, run,
 )
-
-
-def report(metrics: Dict[str, Any],
-           checkpoint: Optional[Checkpoint] = None) -> None:
-    """Reference: ``ray.tune.report`` / ``session.report`` inside a trial."""
-    from ray_tpu.train._internal.session import get_session
-    get_session().report(metrics, checkpoint)
-
-
-def get_checkpoint() -> Optional[Checkpoint]:
-    from ray_tpu.train._internal.session import get_session
-    return get_session().get_checkpoint()

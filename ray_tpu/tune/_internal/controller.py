@@ -14,12 +14,13 @@ Train report transport; early-stop is the session's cooperative stop flag
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import pickle
+import shutil
 import time
-import uuid
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu import exceptions as exc
@@ -35,7 +36,7 @@ _POLL = 0.02
 @ray_tpu.remote
 def _trial_task(run_id: str, fn_blob: bytes, config: Dict[str, Any],
                 storage_dir: str, restore_path: Optional[str],
-                start_iteration: int = 0) -> None:
+                start_iteration: int = 0, ckpt_freq: int = 0) -> None:
     """The trial wrapper (runs in a worker process)."""
     import inspect
 
@@ -55,7 +56,7 @@ def _trial_task(run_id: str, fn_blob: bytes, config: Dict[str, Any],
     try:
         obj = cloudpickle.loads(fn_blob)
         if inspect.isclass(obj) and issubclass(obj, Trainable):
-            obj(config)._train_loop()
+            obj(config)._train_loop(ckpt_freq)
         else:
             result = obj(config)
             if isinstance(result, dict):
@@ -72,7 +73,7 @@ class TuneController:
                  metric: Optional[str] = None, mode: str = "max",
                  stop: Optional[Dict[str, Any]] = None,
                  max_concurrent: int = 4, storage_root: str = "",
-                 experiment_name: str = ""):
+                 experiment_name: str = "", checkpoint_config: Any = None):
         import cloudpickle
         self.fn_blob = cloudpickle.dumps(trainable)
         self.trials = trials
@@ -84,7 +85,12 @@ class TuneController:
         self.max_concurrent = max_concurrent
         self.storage_root = storage_root
         self.experiment_name = experiment_name
+        self.checkpoint_config = checkpoint_config
+        self._last_state_save = 0.0
         os.makedirs(self.exp_dir, exist_ok=True)
+        # Persist immediately: an experiment interrupted before any trial
+        # completes must still be restorable.
+        self._save_experiment_state()
 
     @property
     def exp_dir(self) -> str:
@@ -129,6 +135,7 @@ class TuneController:
             metrics["trial_id"] = trial.id
             if payload.get("checkpoint_path"):
                 trial.latest_checkpoint_path = payload["checkpoint_path"]
+                self._apply_checkpoint_retention(trial)
             trial.metrics_history.append(metrics)
             decision = self.scheduler.on_trial_result(self, trial, metrics)
             if decision == TrialScheduler.STOP or \
@@ -139,14 +146,53 @@ class TuneController:
     # ---------------------------------------------------------------- loop
     def _launch(self, trial: Trial) -> None:
         storage = os.path.join(self.exp_dir, trial.id)
-        # clones continue the iteration numbering (no duplicate
-        # training_iteration rows; stop criteria stay run-global)
+        # Clones/restores continue the iteration numbering (stop criteria
+        # stay run-global).  When resuming from a checkpoint older than the
+        # last report (checkpoint_frequency > 1), restart numbering at the
+        # checkpoint's iteration so the gap is re-trained rather than
+        # silently skipped.
         start_it = (max(trial.seen_iters | trial.all_seen_iters)
                     if (trial.seen_iters or trial.all_seen_iters) else 0)
+        ckpt_it = _checkpoint_iteration(trial.restore_path)
+        if ckpt_it is not None and ckpt_it < start_it:
+            start_it = ckpt_it
+            trial.metrics_history = [
+                m for m in trial.metrics_history
+                if m.get("training_iteration", 0) <= ckpt_it]
+        ckpt_freq = getattr(self.checkpoint_config, "checkpoint_frequency",
+                            0) or 0
         trial.ref = _trial_task.remote(trial.run_id, self.fn_blob,
                                        trial.config, storage,
-                                       trial.restore_path, start_it)
+                                       trial.restore_path, start_it,
+                                       ckpt_freq)
         trial.status = "RUNNING"
+
+    def _apply_checkpoint_retention(self, trial: Trial) -> None:
+        """Keep only the newest ``num_to_keep`` checkpoint dirs of a trial
+        (reference: ``CheckpointConfig.num_to_keep``)."""
+        keep = getattr(self.checkpoint_config, "num_to_keep", None)
+        if not keep or not trial.latest_checkpoint_path:
+            return
+        # Never delete a dir some trial still needs: its latest, a pending
+        # PBT clone's donor checkpoint, or a restore point.
+        pinned = set()
+        for t in self.trials:
+            pinned.add(t.latest_checkpoint_path)
+            pinned.add(t.restore_path)
+            if t.pending_clone is not None:
+                pinned.add(t.pending_clone.get("ckpt"))
+        trial_dir = os.path.dirname(trial.latest_checkpoint_path)
+        try:
+            ckpts = sorted(
+                d for d in os.listdir(trial_dir)
+                if d.startswith("checkpoint_")
+                and os.path.isdir(os.path.join(trial_dir, d)))
+        except OSError:
+            return
+        for d in ckpts[:-keep]:
+            path = os.path.join(trial_dir, d)
+            if path not in pinned:
+                shutil.rmtree(path, ignore_errors=True)
 
     def _hit_stop_criteria(self, metrics: Dict[str, Any]) -> bool:
         # reference semantics: stop once attribute >= bound
@@ -188,25 +234,63 @@ class TuneController:
                 if trial.pending_clone is not None:
                     trial.relaunch_as_clone()
                 self._save_experiment_state()
+            if time.monotonic() - self._last_state_save > 2.0:
+                self._save_experiment_state()
             time.sleep(_POLL)
         self._save_experiment_state()
 
     # ------------------------------------------------------------- persist
     def _save_experiment_state(self) -> None:
-        state = {
-            "experiment_name": self.experiment_name,
-            "metric": self.metric,
-            "mode": self.mode,
-            "trials": [{
+        import cloudpickle
+        self._last_state_save = time.monotonic()
+
+        def b64(obj):
+            try:
+                return base64.b64encode(cloudpickle.dumps(obj)).decode()
+            except Exception:  # noqa: BLE001 - unpicklable user object
+                return None
+
+        state_path = os.path.join(self.exp_dir, "experiment_state.json")
+        # Merge with any prior state file: a restored run's controller only
+        # holds the re-run trials, but previously TERMINATED trials must
+        # stay discoverable.
+        prior_trials = {}
+        if os.path.exists(state_path):
+            try:
+                with open(state_path) as f:
+                    prior_trials = {t["id"]: t
+                                    for t in json.load(f).get("trials", [])}
+            except (OSError, ValueError):
+                prior_trials = {}
+        for t in self.trials:
+            prior_trials[t.id] = {
                 "id": t.id, "config": _jsonable(t.config),
                 "status": t.status,
                 "metrics_history": _jsonable(t.metrics_history),
                 "latest_checkpoint_path": t.latest_checkpoint_path,
-            } for t in self.trials],
+                "rungs_hit": sorted(t.rungs_hit),
+            }
+        state = {
+            "experiment_name": self.experiment_name,
+            "metric": self.metric,
+            "mode": self.mode,
+            "stop": _jsonable(self.stop),
+            "scheduler_b64": b64(self.scheduler),
+            "checkpoint_config_b64": b64(self.checkpoint_config),
+            "trials": list(prior_trials.values()),
         }
-        with open(os.path.join(self.exp_dir, "experiment_state.json"),
-                  "w") as f:
+        with open(state_path, "w") as f:
             json.dump(state, f, indent=1)
+
+
+def _checkpoint_iteration(path: Optional[str]) -> Optional[int]:
+    """Parse the iteration out of a ``checkpoint_a{N}_{IIIIII}`` dir name."""
+    if not path:
+        return None
+    try:
+        return int(os.path.basename(path.rstrip("/")).rsplit("_", 1)[1])
+    except (IndexError, ValueError):
+        return None
 
 
 def _jsonable(x: Any) -> Any:

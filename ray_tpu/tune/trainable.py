@@ -40,7 +40,10 @@ class Trainable:
         pass
 
     # -- driver used by the trial wrapper
-    def _train_loop(self) -> None:
+    def _train_loop(self, ckpt_freq: int = 0) -> None:
+        """``ckpt_freq``: save every N iterations
+        (``CheckpointConfig.checkpoint_frequency``); 0/1 → every iteration
+        (kept as the default so schedulers can always clone/restore)."""
         import shutil
         import tempfile
 
@@ -51,10 +54,14 @@ class Trainable:
             with restore.as_directory() as d:
                 self.load_checkpoint(d)
             self.iteration = max(self.iteration, sess.iteration)
+        ckpt_freq = max(int(ckpt_freq), 1)
         try:
             while True:
                 self.iteration += 1
                 metrics = dict(self.step())
+                if self.iteration % ckpt_freq != 0:
+                    sess.report(metrics)
+                    continue
                 tmp = tempfile.mkdtemp(prefix="rtpu_trainable_ckpt_")
                 try:
                     self.save_checkpoint(tmp)

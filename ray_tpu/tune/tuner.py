@@ -8,11 +8,12 @@ interrupted experiment from its state file.
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import uuid
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from ray_tpu.air.config import RunConfig
 from ray_tpu.train._checkpoint import Checkpoint
@@ -63,7 +64,8 @@ class Tuner:
             stop=self.run_config.stop or {},
             max_concurrent=tc.max_concurrent_trials,
             storage_root=self.run_config.resolved_storage_path(),
-            experiment_name=exp_name)
+            experiment_name=exp_name,
+            checkpoint_config=self.run_config.checkpoint_config)
         controller.run()
         return ResultGrid([_trial_to_result(t) for t in trials],
                           default_metric=tc.metric, default_mode=tc.mode)
@@ -120,14 +122,36 @@ class _RestoredTuner:
                 if t.get("latest_checkpoint_path") and \
                         os.path.isdir(t["latest_checkpoint_path"]):
                     tr.restore_path = t["latest_checkpoint_path"]
+                # Resume iteration numbering where the interrupted run left
+                # off so run-global stop criteria keep their meaning.
+                for m in t.get("metrics_history") or []:
+                    it = m.get("training_iteration")
+                    if it is not None:
+                        tr.all_seen_iters.add(int(it))
+                        tr.metrics_history.append(m)
+                # Rungs already passed (ASHA/median bookkeeping) must not be
+                # re-recorded by the resumed run.
+                tr.rungs_hit = set(t.get("rungs_hit") or [])
                 rerun.append(tr)
         if rerun:
+            def unb64(key):
+                blob = self._state.get(key)
+                if not blob:
+                    return None
+                import cloudpickle
+                try:
+                    return cloudpickle.loads(base64.b64decode(blob))
+                except Exception:  # noqa: BLE001 - version drift
+                    return None
             controller = TuneController(
                 self._trainable, rerun,
+                scheduler=unb64("scheduler_b64"),
                 metric=self._state.get("metric"),
                 mode=self._state.get("mode") or "max",
+                stop=self._state.get("stop") or {},
                 storage_root=self._storage_root,
-                experiment_name=self._state["experiment_name"])
+                experiment_name=self._state["experiment_name"],
+                checkpoint_config=unb64("checkpoint_config_b64"))
             controller.run()
             done.extend(_trial_to_result(t) for t in rerun)
         return ResultGrid(done, default_metric=self._state.get("metric"),

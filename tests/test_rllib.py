@@ -1,0 +1,192 @@
+"""RLlib suite.  Reference test strategy (SURVEY.md §4): per-algorithm short
+train() runs asserting reward improvement on CartPole; fake RandomEnv for
+worker mechanics; unit tests for vtrace/GAE math against numpy loops."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (
+    DQNConfig, IMPALAConfig, PPOConfig, Policy, RandomEnv, RolloutWorker,
+    SampleBatch, compute_gae, vtrace)
+from ray_tpu.rllib.sample_batch import (
+    ADVANTAGES, EPS_ID, OBS, REWARDS, TERMINATEDS, TRUNCATEDS, VALUE_TARGETS,
+    VF_PREDS, concat_samples)
+
+
+# ------------------------------------------------------------ SampleBatch
+
+def test_sample_batch_basics():
+    b = SampleBatch({OBS: np.zeros((10, 4)), REWARDS: np.arange(10.0)})
+    assert b.count == 10 and len(b) == 10
+    assert b.slice(2, 5).count == 3
+    mbs = list(b.minibatches(4))
+    assert [m.count for m in mbs] == [4, 4]
+    c = concat_samples([b, b])
+    assert c.count == 20
+    s = b.shuffle(np.random.default_rng(0))
+    assert set(s[REWARDS]) == set(b[REWARDS])
+
+
+def test_split_by_episode():
+    b = SampleBatch({EPS_ID: np.array([1, 1, 2, 2, 2, 3]),
+                     REWARDS: np.ones(6, np.float32)})
+    eps = b.split_by_episode()
+    assert [e.count for e in eps] == [2, 3, 1]
+
+
+# ------------------------------------------------------------ GAE / vtrace
+
+def test_gae_matches_naive():
+    rng = np.random.default_rng(0)
+    T, gamma, lam = 9, 0.95, 0.9
+    batch = SampleBatch({
+        REWARDS: rng.normal(size=T).astype(np.float32),
+        VF_PREDS: rng.normal(size=T).astype(np.float32),
+        TERMINATEDS: np.zeros(T, bool), TRUNCATEDS: np.zeros(T, bool)})
+    last_value = 0.7
+    out = compute_gae(batch.copy(), last_value, gamma, lam)
+    # naive O(T^2)
+    vf_next = np.append(batch[VF_PREDS][1:], last_value)
+    deltas = batch[REWARDS] + gamma * vf_next - batch[VF_PREDS]
+    expect = np.array([
+        sum((gamma * lam) ** (k - t) * deltas[k] for k in range(t, T))
+        for t in range(T)])
+    np.testing.assert_allclose(out[ADVANTAGES], expect, rtol=1e-5)
+    np.testing.assert_allclose(out[VALUE_TARGETS],
+                               expect + batch[VF_PREDS], rtol=1e-5)
+    # terminated: bootstrap ignored
+    batch2 = batch.copy()
+    batch2[TERMINATEDS][-1] = True
+    out2 = compute_gae(batch2, 123.0, gamma, lam)
+    vf_next2 = np.append(batch[VF_PREDS][1:], 0.0)
+    d2 = batch[REWARDS] + gamma * vf_next2 - batch[VF_PREDS]
+    acc, exp2 = 0.0, np.zeros(T)
+    for t in range(T - 1, -1, -1):
+        acc = d2[t] + gamma * lam * acc
+        exp2[t] = acc
+    np.testing.assert_allclose(out2[ADVANTAGES], exp2, rtol=1e-5)
+
+
+def test_vtrace_matches_naive():
+    rng = np.random.default_rng(1)
+    T, B, gamma = 7, 3, 0.9
+    behavior_logp = rng.normal(size=(T, B)).astype(np.float32)
+    target_logp = rng.normal(size=(T, B)).astype(np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    dones = rng.uniform(size=(T, B)) < 0.2
+    discounts = (gamma * (1 - dones)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+
+    vs, pg_adv = vtrace(behavior_logp, target_logp, rewards, discounts,
+                        values, bootstrap)
+    vs, pg_adv = np.asarray(vs), np.asarray(pg_adv)
+
+    # naive backward recursion (IMPALA paper eq. 1)
+    rhos = np.minimum(1.0, np.exp(target_logp - behavior_logp))
+    cs = np.minimum(1.0, np.exp(target_logp - behavior_logp))
+    values_next = np.concatenate([values[1:], bootstrap[None]], axis=0)
+    deltas = rhos * (rewards + discounts * values_next - values)
+    vs_expect = np.zeros((T + 1, B))
+    vs_expect[T] = bootstrap
+    acc = np.zeros(B)
+    for t in range(T - 1, -1, -1):
+        acc = deltas[t] + discounts[t] * cs[t] * acc
+        vs_expect[t] = values[t] + acc
+    np.testing.assert_allclose(vs, vs_expect[:T], rtol=1e-4, atol=1e-5)
+    pg_expect = rhos * (rewards + discounts * vs_expect[1:] - values)
+    np.testing.assert_allclose(pg_adv, pg_expect, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------ worker
+
+def test_rollout_worker_random_env():
+    w = RolloutWorker({
+        "env": "RandomEnv", "env_config": {"episode_len": 10},
+        "num_envs_per_worker": 3, "rollout_fragment_length": 25,
+        "seed": 0})
+    batch = w.sample()
+    assert batch.count == 75
+    assert batch[OBS].shape == (75, 4)
+    assert ADVANTAGES in batch and VALUE_TARGETS in batch
+    m = w.get_metrics()
+    # 3 envs * 25 steps / 10-step episodes → at least 3 completed episodes
+    assert len(m["episode_rewards"]) >= 3
+    assert m["num_env_steps"] == 75
+
+
+def test_policy_weights_roundtrip():
+    w = RolloutWorker({"env": "RandomEnv", "rollout_fragment_length": 5})
+    weights = w.get_weights()
+    w2 = RolloutWorker({"env": "RandomEnv", "rollout_fragment_length": 5,
+                        "seed": 5})
+    w2.set_weights(weights)
+    obs = np.zeros((2, 4), np.float32)
+    a1 = w.policy.compute_actions(obs, explore=False)[0]
+    a2 = w2.policy.compute_actions(obs, explore=False)[0]
+    np.testing.assert_array_equal(a1, a2)
+
+
+# ------------------------------------------------------------ algorithms
+
+def test_ppo_cartpole_learns(ray_start_regular):
+    algo = PPOConfig().environment("CartPole-v1").rollouts(
+        num_workers=0, num_envs_per_worker=4,
+        rollout_fragment_length=256).training(
+        train_batch_size=1024, sgd_minibatch_size=128, num_sgd_iter=6,
+        lr=3e-4, entropy_coeff=0.01, fcnet_hiddens=(64, 64)).debugging(
+        seed=0).build()
+    first, last = None, None
+    for _ in range(12):
+        result = algo.train()
+        if not np.isnan(result["episode_reward_mean"]):
+            if first is None:
+                first = result["episode_reward_mean"]
+            last = result["episode_reward_mean"]
+    assert last is not None and first is not None
+    assert last > max(60.0, first), (first, last)
+    algo.stop()
+
+
+def test_ppo_remote_workers_and_checkpoint(ray_start_regular, tmp_path):
+    algo = PPOConfig().environment("CartPole-v1").rollouts(
+        num_workers=2, rollout_fragment_length=64).training(
+        train_batch_size=128, sgd_minibatch_size=64,
+        num_sgd_iter=2).debugging(seed=0).build()
+    r = algo.train()
+    assert r["training_iteration"] == 1
+    assert r["timesteps_total"] >= 128
+    ckpt = algo.save(str(tmp_path / "ck"))
+    w_before = algo.get_weights()
+    algo.train()
+    algo.restore(ckpt)
+    w_after = algo.get_weights()
+    for k in w_before:
+        np.testing.assert_array_equal(w_before[k]["w"], w_after[k]["w"])
+    assert algo.iteration == 1
+    algo.stop()
+
+
+def test_impala_smoke(ray_start_regular):
+    algo = IMPALAConfig().environment("CartPole-v1").rollouts(
+        num_workers=2, rollout_fragment_length=32,
+        num_envs_per_worker=2).training(
+        num_batches_per_iteration=4, lr=3e-4).debugging(seed=0).build()
+    for _ in range(3):
+        r = algo.train()
+    assert r["info"]["num_env_steps_trained"] >= 4 * 64
+    assert np.isfinite(r["info"]["policy_loss"])
+    algo.stop()
+
+
+def test_dqn_smoke():
+    algo = DQNConfig().environment("CartPole-v1").rollouts(
+        num_workers=0, rollout_fragment_length=32).training(
+        learning_starts=64, train_batch_size=32,
+        num_sgd_per_step=4).debugging(seed=0).build()
+    for _ in range(5):
+        r = algo.train()
+    assert "mean_td_error" in r["info"]
+    assert r["info"]["buffer_size"] >= 160
+    algo.stop()
