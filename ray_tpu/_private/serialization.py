@@ -75,7 +75,10 @@ class _Pickler(cloudpickle.Pickler):
         if ser is not None:
             serializer, deserializer = ser
             return (deserializer, (serializer(obj),))
-        return NotImplemented
+        # Delegate to cloudpickle's reducer_override — that is where its
+        # by-value class/function pickling lives; returning NotImplemented
+        # here would skip it and local classes would fail to pickle.
+        return super().reducer_override(obj)
 
 
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer], List[Any]]:

@@ -105,3 +105,13 @@ class PlacementGroupSchedulingError(RayTpuError):
 
 class RaySystemError(RayTpuError):
     """Internal control-plane failure."""
+
+
+class RayServeError(RayTpuError):
+    """Serve-level failure (no replicas available, bad deployment, ...).
+
+    Reference: ``ray.serve.exceptions.RayServeException``."""
+
+
+# Reference-compatible alias.
+RayServeException = RayServeError

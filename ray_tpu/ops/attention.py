@@ -1,0 +1,127 @@
+"""Attention primitives: streaming-softmax (flash-style) building blocks.
+
+The reference framework (Ray) contains no kernels at all (SURVEY.md §5.7);
+these are greenfield TPU-first components.  This module holds the
+single-device pieces:
+
+- ``flash_update``: the online-softmax block update shared by blockwise,
+  ring (``ray_tpu.ops.ring_attention``) and Ulysses attention.
+- ``blockwise_attention``: memory-efficient causal attention via
+  ``lax.scan`` over KV blocks — O(T·block) activation memory instead of
+  O(T²), differentiable by autodiff, XLA keeps the block matmuls on the MXU.
+
+Accumulators are float32 regardless of input dtype (bf16-safe softmax).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = jnp.finfo(jnp.float32).min
+
+
+def flash_update(o: jax.Array, m: jax.Array, l: jax.Array,
+                 q: jax.Array, k: jax.Array, v: jax.Array,
+                 mask: Optional[jax.Array],
+                 scale: float) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One online-softmax accumulation step.
+
+    Shapes: q (B,Tq,H,D); k,v (B,Tk,H,D); o (B,H,Tq,D) f32;
+    m,l (B,H,Tq) f32; mask broadcastable to (B,H,Tq,Tk) bool (True=keep).
+
+    Rows with no valid key yet keep ``m == NEG_INF``; callers must ensure
+    the FIRST block every row sees has at least one valid key (causal ring
+    starts with the diagonal block) so ``m`` is finite before fully-masked
+    blocks contribute exp(NEG_INF - m) == 0.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    p = jnp.exp(logits - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    o = o * corr[..., None] + pv
+    return o, m_new, l
+
+
+def flash_finalize(o: jax.Array, l: jax.Array, dtype) -> jax.Array:
+    """(B,H,T,D) f32 accumulators → (B,T,H,D) normalized output."""
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(dtype)
+
+
+def causal_mask(q_pos: jax.Array, k_pos: jax.Array) -> jax.Array:
+    """(Tq,), (Tk,) global positions → (Tq, Tk) bool keep-mask."""
+    return q_pos[:, None] >= k_pos[None, :]
+
+
+@partial(jax.jit, static_argnames=("causal", "block_size"))
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        *, causal: bool = True,
+                        block_size: int = 512) -> jax.Array:
+    """Memory-efficient attention. (B,T,H,D)×3 → (B,T,H,D).
+
+    Scans KV in blocks with online softmax; with an outer ``jax.checkpoint``
+    this is the long-sequence single-device path (activation memory
+    O(B·H·T·D), never O(T²)).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bs = min(block_size, Tk)
+    if Tk % bs:
+        raise ValueError(f"kv length {Tk} not divisible by block {bs}")
+    scale = 1.0 / math.sqrt(D)
+    nblocks = Tk // bs
+    kb = k.reshape(B, nblocks, bs, H, D).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(B, nblocks, bs, H, D).transpose(1, 0, 2, 3, 4)
+    q_pos = jnp.arange(Tq)
+
+    o0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq), NEG_INF)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+
+    def body(carry, xs):
+        o, m, l = carry
+        i, kblk, vblk = xs
+        if causal:
+            k_pos = i * bs + jnp.arange(bs)
+            mask = causal_mask(q_pos, k_pos)[None, None]
+        else:
+            mask = None
+        o, m, l = flash_update(o, m, l, q, kblk, vblk, mask, scale)
+        return (o, m, l), None
+
+    # Forward block order satisfies flash_update's masking contract for
+    # causal attention: block 0 contains k=0, a valid key for every row.
+    idx = jnp.arange(nblocks)
+    (o, _, l), _ = lax.scan(body, (o0, m0, l0), (idx, kb, vb))
+    return flash_finalize(o, l, q.dtype)
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    *, causal: bool = True,
+                    q_offset: int | jax.Array = 0) -> jax.Array:
+    """Plain O(T²) attention (B,T,H,D); the XLA-fused short-sequence path.
+
+    ``q_offset`` shifts query positions for causal masking when q is a
+    chunk of a longer sequence (used by decode / chunked prefill).
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        mask = causal_mask(q_pos, jnp.arange(k.shape[1]))
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,32 @@
+"""ray_tpu.serve — online model serving on TPU actors.
+
+Reference: ``python/ray/serve/`` (SURVEY.md §2.5, §3.6): controller actor
+(deployment FSM + autoscaler), HTTP proxy, power-of-two-choices routing,
+replica actors with bounded ongoing requests, deployment handles for model
+composition, ``@serve.batch`` for request batching.
+
+TPU-first design points:
+- replicas warm (build + compile) their model in ``__init__`` and are only
+  routed to once ready — XLA cold-compile never happens on the request path;
+- ``@serve.batch`` turns request streams into MXU-sized batches;
+- the autoscaler's downscale delay is sticky by default because replica
+  startup can include minutes of compilation (SURVEY.md §7.3).
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    delete, get_app_handle, get_deployment_handle, get_http_address, run,
+    shutdown, start, status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions  # noqa: F401
+from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.http_util import Request, Response  # noqa: F401
+
+__all__ = [
+    "deployment", "run", "start", "shutdown", "status", "delete",
+    "get_app_handle", "get_deployment_handle", "get_http_address",
+    "batch", "AutoscalingConfig", "HTTPOptions", "Application",
+    "Deployment", "DeploymentHandle", "DeploymentResponse",
+    "Request", "Response",
+]

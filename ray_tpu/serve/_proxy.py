@@ -1,0 +1,141 @@
+"""HTTP proxy actor: socket in, deployment handle out.
+
+Reference: ``python/ray/serve/_private/proxy.py`` (SURVEY.md §3.6) — the
+proxy owns the HTTP listener, resolves the route prefix to an app's ingress
+deployment, and forwards the request through a ``DeploymentHandle`` (whose
+router does power-of-two-choices replica selection).  The reference runs
+uvicorn/Starlette; here a stdlib ``ThreadingHTTPServer`` serves the same
+role with zero dependencies — each connection thread blocks on the handle
+result, giving natural per-connection backpressure.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ray_tpu._private import rtlog
+from ray_tpu.serve.handle import DeploymentHandle, get_controller
+from ray_tpu.serve.http_util import Request, coerce_response
+
+import ray_tpu
+
+logger = rtlog.get("serve.proxy")
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: float = 120.0):
+        self._controller = get_controller()
+        self._routes: Dict[str, str] = {}
+        self._routes_ts = 0.0
+        self._routes_lock = threading.Lock()
+        self._timeout = request_timeout_s
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: A003
+                logger.debug("http: " + fmt % args)
+
+            def _dispatch(self):
+                self.serve_response_started = False
+                try:
+                    proxy._handle(self)
+                except BrokenPipeError:
+                    self.close_connection = True
+                except Exception as e:  # noqa: BLE001
+                    if self.serve_response_started:
+                        # Headers already on the wire: a second response
+                        # would corrupt HTTP/1.1 framing — drop the conn.
+                        self.close_connection = True
+                        return
+                    try:
+                        body = f"internal error: {e}".encode()
+                        self.send_response(500)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    except OSError:
+                        self.close_connection = True
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address[:2]
+        threading.Thread(target=self._server.serve_forever,
+                         name="serve-http", daemon=True).start()
+        ray_tpu.get(self._controller.set_http_address.remote(
+            self.host, self.port))
+        logger.info("proxy listening on %s:%d", self.host, self.port)
+
+    def address(self) -> tuple:
+        return (self.host, self.port)
+
+    # ---------------------------------------------------------------- routing
+    def _get_routes(self) -> Dict[str, str]:
+        with self._routes_lock:
+            if time.monotonic() - self._routes_ts > 1.0:
+                self._routes = ray_tpu.get(
+                    self._controller.get_routes.remote())
+                self._routes_ts = time.monotonic()
+            return self._routes
+
+    def _match(self, path: str) -> Optional[tuple]:
+        routes = self._get_routes()
+        best = None
+        for prefix, dep_key in routes.items():
+            if path == prefix or path.startswith(
+                    prefix if prefix.endswith("/") else prefix + "/") \
+                    or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, dep_key)
+        return best
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?")[0]
+        if path == "/-/healthz":
+            self._respond(req, 200, b"success", "text/plain")
+            return
+        if path == "/-/routes":
+            body = json.dumps(self._get_routes()).encode()
+            self._respond(req, 200, body, "application/json")
+            return
+        match = self._match(path)
+        if match is None:
+            self._respond(req, 404, b"no route matched", "text/plain")
+            return
+        prefix, dep_key = match
+        length = int(req.headers.get("Content-Length") or 0)
+        body = req.rfile.read(length) if length else b""
+        request = Request.from_parts(req.command, req.path,
+                                     dict(req.headers), body, prefix)
+        handle = DeploymentHandle(dep_key)
+        try:
+            result = handle.remote(request).result(timeout_s=self._timeout)
+        except ray_tpu.exceptions.GetTimeoutError:
+            self._respond(req, 408, b"request timed out", "text/plain")
+            return
+        except Exception as e:  # noqa: BLE001 - user code raised
+            self._respond(req, 500, str(e).encode(), "text/plain")
+            return
+        resp = coerce_response(result)
+        self._respond(req, resp.status_code, resp.body, resp.content_type)
+
+    @staticmethod
+    def _respond(req, status: int, body: bytes, content_type: str) -> None:
+        req.serve_response_started = True
+        req.send_response(status)
+        req.send_header("Content-Type", content_type)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def shutdown(self) -> bool:
+        self._server.shutdown()
+        return True

@@ -1,0 +1,88 @@
+"""Replica actor: hosts one copy of a deployment's user class.
+
+Reference: ``python/ray/serve/_private/replica.py`` (SURVEY.md §3.6) — the
+replica wraps the user callable, runs requests with bounded concurrency
+(the actor's ``max_concurrency`` = the deployment's
+``max_ongoing_requests``; excess calls queue at the actor mailbox), and
+owns an asyncio loop so async user methods and ``@serve.batch`` work.
+
+TPU note: model construction (and therefore XLA compilation) happens in
+``__init__`` — the controller only marks a replica ready once ``__init__``
+returned, so traffic never hits a cold, uncompiled replica (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+from typing import Any, Dict, Tuple
+
+
+class HandleMarker:
+    """Placeholder in init args for a bound sub-deployment (composition)."""
+
+    def __init__(self, dep_key: str):
+        self.dep_key = dep_key
+
+    def __repr__(self):
+        return f"HandleMarker({self.dep_key})"
+
+
+def _resolve_markers(obj: Any) -> Any:
+    from ray_tpu.serve.handle import DeploymentHandle
+    if isinstance(obj, HandleMarker):
+        return DeploymentHandle(obj.dep_key)
+    if isinstance(obj, list):
+        return [_resolve_markers(o) for o in obj]
+    if isinstance(obj, tuple):
+        return tuple(_resolve_markers(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _resolve_markers(v) for k, v in obj.items()}
+    return obj
+
+
+class Replica:
+    def __init__(self, dep_key: str, replica_tag: str, user_cls: type,
+                 init_args: Tuple, init_kwargs: Dict):
+        self._dep_key = dep_key
+        self._replica_tag = replica_tag
+        self._loop = asyncio.new_event_loop()
+        threading.Thread(target=self._loop.run_forever,
+                         name="replica-asyncio", daemon=True).start()
+        init_args = _resolve_markers(tuple(init_args))
+        init_kwargs = _resolve_markers(dict(init_kwargs))
+        self._instance = user_cls(*init_args, **init_kwargs)
+
+    def handle_request(self, method: str, args: Tuple, kwargs: Dict):
+        import ray_tpu
+        from ray_tpu._private.object_ref import ObjectRef
+        # Chained DeploymentResponses arrive as ObjectRefs nested inside the
+        # args tuple (the worker only auto-resolves TOP-level args); resolve
+        # them here so composed deployments see values, not refs.
+        args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
+                     for a in args)
+        kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
+                  for k, v in kwargs.items()}
+        m = getattr(self._instance, method)
+        if inspect.iscoroutinefunction(m):
+            fut = asyncio.run_coroutine_threadsafe(
+                m(*args, **kwargs), self._loop)
+            return fut.result()
+        return m(*args, **kwargs)
+
+    def check_health(self) -> bool:
+        chk = getattr(self._instance, "check_health", None)
+        if chk is not None:
+            chk()
+        return True
+
+    def prepare_shutdown(self) -> bool:
+        """Graceful drain hook: user ``__del__``-style cleanup before kill."""
+        hook = getattr(self._instance, "shutdown", None)
+        if callable(hook):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - best-effort drain
+                pass
+        return True

@@ -1,0 +1,145 @@
+"""Serve public API: start / run / status / shutdown / handles.
+
+Reference: ``python/ray/serve/api.py`` (SURVEY.md §3.6).  ``serve.run``
+deploys a bound application graph onto the running ray_tpu cluster; the
+controller and HTTP proxy are detached named actors, so applications
+outlive the deploying driver until ``serve.shutdown()``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.serve._proxy import ProxyActor
+from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import Application
+from ray_tpu.serve.handle import (CONTROLLER_NAME, DeploymentHandle, Router,
+                                  get_controller)
+
+PROXY_NAME = "SERVE_PROXY"
+
+
+def start(http_options: Optional[HTTPOptions] = None, *,
+          proxy: bool = True):
+    """Idempotently start the Serve system actors; returns the controller."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    requested = http_options
+    http_options = http_options or HTTPOptions(port=0)
+    controller = ray_tpu.remote(ServeController).options(
+        name=CONTROLLER_NAME, lifetime="detached", num_cpus=0,
+        max_concurrency=8, get_if_exists=True).remote()
+    ray_tpu.get(controller.__ray_ready__.remote())
+    if proxy:
+        p = ray_tpu.remote(ProxyActor).options(
+            name=PROXY_NAME, lifetime="detached", num_cpus=0,
+            max_concurrency=32, get_if_exists=True,
+        ).remote(http_options.host, http_options.port,
+                 http_options.request_timeout_s)
+        ray_tpu.get(p.__ray_ready__.remote())
+        if requested is not None:
+            actual = ray_tpu.get(controller.get_http_address.remote())
+            if actual is not None and requested.port not in (0, actual[1]):
+                from ray_tpu._private import rtlog
+                rtlog.get("serve").warning(
+                    "Serve proxy already running on %s:%d; requested "
+                    "http_options (port=%d) ignored — call serve.shutdown() "
+                    "first to change HTTP options", actual[0], actual[1],
+                    requested.port)
+    return controller
+
+
+def run(target: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        http_options: Optional[HTTPOptions] = None,
+        _wait_timeout_s: float = 120.0) -> DeploymentHandle:
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects a bound Application "
+                        "(use MyDeployment.bind(...))")
+    controller = start(http_options=http_options)
+    nodes: dict = {}
+    target._collect(nodes)
+    payload = []
+    for dep_name, node in nodes.items():
+        args, kwargs = node._marked_args(name)
+        payload.append(dict(
+            name=dep_name, user_cls=node._deployment.user_class,
+            init_args=args, init_kwargs=kwargs,
+            config=node._deployment.to_config()))
+    ingress = target._deployment.name
+    ray_tpu.get(controller.deploy_application.remote(
+        name, route_prefix, payload, ingress))
+    _wait_ready(controller, [f"{name}#{d}" for d in nodes], _wait_timeout_s)
+    handle = DeploymentHandle(f"{name}#{ingress}")
+    if blocking:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def _wait_ready(controller, keys, timeout_s: float) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status = ray_tpu.get(controller.status.remote())
+        pending = [k for k in keys
+                   if status.get(k, {}).get("ready", 0) <
+                   status.get(k, {}).get("target", 1)]
+        bad = [k for k in keys if k not in status]
+        if not pending and not bad:
+            return
+        time.sleep(0.1)
+    raise ray_tpu.exceptions.RayServeError(
+        f"application not ready within {timeout_s}s: {status}")
+
+
+def status() -> dict:
+    return ray_tpu.get(get_controller().status.remote())
+
+
+def get_http_address() -> Optional[tuple]:
+    return ray_tpu.get(get_controller().get_http_address.remote())
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    key = ray_tpu.get(get_controller().get_app_ingress.remote(name))
+    if key is None:
+        raise ray_tpu.exceptions.RayServeError(f"no application {name!r}")
+    return DeploymentHandle(key)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(f"{app_name}#{deployment_name}")
+
+
+def delete(name: str) -> None:
+    ray_tpu.get(get_controller().delete_application.remote(name))
+
+
+def shutdown() -> None:
+    """Tear down all applications and the Serve system actors."""
+    Router.reset_all()
+    try:
+        controller = get_controller()
+    except Exception:  # noqa: BLE001 - serve never started
+        return
+    try:
+        ray_tpu.get(controller.shutdown_all.remote(), timeout=10)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+        ray_tpu.get(proxy.shutdown.remote(), timeout=5)
+        ray_tpu.kill(proxy)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001
+        pass

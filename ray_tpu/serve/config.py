@@ -1,0 +1,66 @@
+"""Serve configuration types.
+
+Reference: ``python/ray/serve/config.py`` (SURVEY.md §2.5, §3.6) —
+``AutoscalingConfig`` (min/max replicas, target ongoing requests per
+replica, up/downscale delays), HTTP options, deployment options.
+
+TPU note (SURVEY.md §7.3 "Serve cold starts on TPU"): replica startup may
+include minutes of XLA compilation, so the autoscaler defaults are
+deliberately sticky (long downscale delay) and replicas warm their model in
+``__init__`` so a replica is only marked ready once it can serve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Replica autoscaling policy for one deployment.
+
+    ``target_ongoing_requests`` is the per-replica load the autoscaler
+    steers toward: desired = ceil(total_ongoing / target).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    initial_replicas: Optional[int] = None
+    upscale_delay_s: float = 30.0
+    downscale_delay_s: float = 600.0
+    metrics_interval_s: float = 1.0
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
+            raise ValueError("need 0 <= min_replicas <= max_replicas (>=1)")
+        if self.target_ongoing_requests <= 0:
+            raise ValueError("target_ongoing_requests must be > 0")
+
+
+@dataclasses.dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000
+    request_timeout_s: float = 120.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    """Resolved per-deployment options stored by the controller."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Optional[dict] = None
+    graceful_shutdown_wait_s: float = 2.0
+    health_check_period_s: float = 5.0
+
+    def initial_target(self) -> int:
+        ac = self.autoscaling_config
+        if ac is None:
+            return self.num_replicas
+        if ac.initial_replicas is not None:
+            return ac.initial_replicas
+        return max(ac.min_replicas, 1 if ac.min_replicas == 0 else ac.min_replicas)

@@ -1,0 +1,331 @@
+"""ServeController: deployment state machine + replica autoscaler.
+
+Reference: ``python/ray/serve/_private/controller.py`` (SURVEY.md §3.6):
+a detached named actor that owns the desired/actual replica sets, runs a
+control loop that (a) reconciles replica counts, (b) marks replicas ready
+once their ``__init__`` finished, (c) health-checks live replicas,
+(d) gracefully drains downscaled replicas, and (e) runs the autoscaling
+policy over handle-reported ongoing-request metrics.
+
+The control loop runs on a thread inside the controller actor; all external
+interaction is via actor calls (``max_concurrency > 1`` so stats reports
+never queue behind a slow deploy).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu._private import rtlog
+from ray_tpu.serve._replica import Replica
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+logger = rtlog.get("serve.controller")
+
+_STATS_TTL_S = 10.0
+
+
+class _ReplicaState:
+    def __init__(self, tag: str, actor_name: str, handle, ready_ref):
+        self.tag = tag
+        self.actor_name = actor_name
+        self.handle = handle
+        self.ready_ref = ready_ref          # None once ready
+        self.health_ref = None
+        self.started_at = time.monotonic()
+
+
+class _DeploymentState:
+    def __init__(self, key: str, payload: dict):
+        self.key = key
+        self.payload = payload              # user_cls, init_args/kwargs
+        self.config: DeploymentConfig = payload["config"]
+        self.target = self.config.initial_target()
+        self.replicas: Dict[str, _ReplicaState] = {}
+        self.ready: Dict[str, _ReplicaState] = {}
+        self.draining: List[tuple] = []     # (kill_at, _ReplicaState)
+        self.version = 0
+        self.up_since: Optional[float] = None
+        self.down_since: Optional[float] = None
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._routes: Dict[str, str] = {}        # route_prefix -> ingress key
+        self._apps: Dict[str, dict] = {}         # app -> {ingress, deployments}
+        self._stats: Dict[tuple, tuple] = {}     # (router, dep) -> (ts, n)
+        self._http_address: Optional[tuple] = None
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._control_loop, name="serve-control",
+                         daemon=True).start()
+
+    # ----------------------------------------------------------------- deploy
+    def deploy_application(self, app_name: str, route_prefix: str,
+                           deployments: List[dict], ingress: str) -> bool:
+        """deployments: [{name, user_cls, init_args, init_kwargs, config}]."""
+        with self._lock:
+            keys = []
+            for d in deployments:
+                key = f"{app_name}#{d['name']}"
+                keys.append(key)
+                existing = self._deployments.get(key)
+                if existing is None:
+                    self._deployments[key] = _DeploymentState(key, d)
+                else:
+                    # Redeploy: replace code/config, restart replicas.
+                    existing.payload = d
+                    existing.config = d["config"]
+                    existing.target = d["config"].initial_target()
+                    for rs in list(existing.replicas.values()):
+                        self._retire(existing, rs, now=time.monotonic())
+                    existing.version += 1
+            # Drop deployments removed from the app.
+            old = self._apps.get(app_name, {}).get("deployments", [])
+            for stale in set(old) - set(keys):
+                self._delete_deployment(stale)
+            self._apps[app_name] = {"ingress": f"{app_name}#{ingress}",
+                                    "deployments": keys}
+            if route_prefix is not None:
+                self._routes[route_prefix] = f"{app_name}#{ingress}"
+        return True
+
+    def delete_application(self, app_name: str) -> bool:
+        with self._lock:
+            app = self._apps.pop(app_name, None)
+            if app is None:
+                return False
+            for key in app["deployments"]:
+                self._delete_deployment(key)
+            self._routes = {p: k for p, k in self._routes.items()
+                            if k != app["ingress"]}
+        return True
+
+    def _delete_deployment(self, key: str) -> None:
+        st = self._deployments.pop(key, None)
+        if st is None:
+            return
+        now = time.monotonic()
+        for rs in list(st.replicas.values()):
+            self._retire(st, rs, now, grace=0.0)
+        self._drain_tick(st, now=now + 1e9, orphan=True)
+
+    # ------------------------------------------------------------------ reads
+    def get_deployment_targets(self, dep_key: str) -> Optional[dict]:
+        with self._lock:
+            st = self._deployments.get(dep_key)
+            if st is None:
+                return None
+            return {"version": st.version,
+                    "replicas": {t: r.actor_name for t, r in st.ready.items()},
+                    "max_ongoing": st.config.max_ongoing_requests}
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
+
+    def get_app_ingress(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            app = self._apps.get(app_name)
+            return app["ingress"] if app else None
+
+    def status(self) -> dict:
+        with self._lock:
+            return {k: {"target": st.target,
+                        "ready": len(st.ready),
+                        "starting": len(st.replicas) - len(st.ready),
+                        "draining": len(st.draining)}
+                    for k, st in self._deployments.items()}
+
+    def set_http_address(self, host: str, port: int) -> bool:
+        with self._lock:
+            self._http_address = (host, port)
+        return True
+
+    def get_http_address(self) -> Optional[tuple]:
+        with self._lock:
+            return self._http_address
+
+    # ------------------------------------------------------------------ stats
+    def report_handle_stats(self, router_id: str, dep_key: str,
+                            ongoing: int) -> None:
+        with self._lock:
+            self._stats[(router_id, dep_key)] = (time.monotonic(), ongoing)
+
+    def _total_ongoing(self, dep_key: str, now: float) -> int:
+        total = 0
+        for (rid, key), (ts, n) in list(self._stats.items()):
+            if key != dep_key:
+                continue
+            if now - ts > _STATS_TTL_S:
+                del self._stats[(rid, key)]
+                continue
+            total += n
+        return total
+
+    # ----------------------------------------------------------- control loop
+    def _control_loop(self) -> None:
+        while not self._stop.wait(0.2):
+            try:
+                with self._lock:
+                    states = list(self._deployments.values())
+                now = time.monotonic()
+                for st in states:
+                    with self._lock:
+                        self._autoscale_tick(st, now)
+                        self._reconcile_tick(st, now)
+                    self._readiness_tick(st)
+                    self._health_tick(st, now)
+                    self._drain_tick(st, now)
+            except Exception:  # noqa: BLE001
+                if not ray_tpu.is_initialized():
+                    return
+                logger.exception("serve control loop error")
+
+    def _autoscale_tick(self, st: _DeploymentState, now: float) -> None:
+        ac: Optional[AutoscalingConfig] = st.config.autoscaling_config
+        if ac is None:
+            st.target = st.config.num_replicas
+            return
+        ongoing = self._total_ongoing(st.key, now)
+        desired = math.ceil(ongoing / ac.target_ongoing_requests)
+        desired = max(ac.min_replicas, min(ac.max_replicas, desired))
+        if desired > st.target:
+            st.down_since = None
+            st.up_since = st.up_since or now
+            if now - st.up_since >= ac.upscale_delay_s:
+                logger.info("autoscale %s: %d -> %d (ongoing=%d)",
+                            st.key, st.target, desired, ongoing)
+                st.target = desired
+                st.up_since = None
+        elif desired < st.target:
+            st.up_since = None
+            st.down_since = st.down_since or now
+            if now - st.down_since >= ac.downscale_delay_s:
+                logger.info("autoscale %s: %d -> %d (ongoing=%d)",
+                            st.key, st.target, desired, ongoing)
+                st.target = desired
+                st.down_since = None
+        else:
+            st.up_since = st.down_since = None
+
+    def _reconcile_tick(self, st: _DeploymentState, now: float) -> None:
+        while len(st.replicas) < st.target:
+            self._start_replica(st)
+        while len(st.replicas) > st.target:
+            # Prefer draining not-yet-ready replicas, then newest ready.
+            tag = next((t for t in st.replicas if t not in st.ready),
+                       next(reversed(st.ready)))
+            self._retire(st, st.replicas[tag], now)
+
+    def _start_replica(self, st: _DeploymentState) -> None:
+        tag = uuid.uuid4().hex[:8]
+        actor_name = f"SERVE_REPLICA::{st.key}#{tag}"
+        opts = dict(st.config.ray_actor_options or {})
+        opts.setdefault("num_cpus", 1)
+        p = st.payload
+        handle = ray_tpu.remote(Replica).options(
+            name=actor_name, lifetime="detached",
+            max_concurrency=st.config.max_ongoing_requests, **opts,
+        ).remote(st.key, tag, p["user_cls"], p["init_args"], p["init_kwargs"])
+        ready_ref = handle.__ray_ready__.remote()
+        st.replicas[tag] = _ReplicaState(tag, actor_name, handle, ready_ref)
+        logger.info("starting replica %s", actor_name)
+
+    def _retire(self, st: _DeploymentState, rs: _ReplicaState, now: float,
+                grace: Optional[float] = None) -> None:
+        st.replicas.pop(rs.tag, None)
+        if st.ready.pop(rs.tag, None) is not None:
+            st.version += 1
+        if grace is None:
+            grace = st.config.graceful_shutdown_wait_s
+        try:
+            rs.handle.prepare_shutdown.remote()
+        except Exception:  # noqa: BLE001
+            pass
+        st.draining.append((now + grace, rs))
+
+    def _readiness_tick(self, st: _DeploymentState) -> None:
+        pending = [(t, r) for t, r in list(st.replicas.items())
+                   if r.ready_ref is not None]
+        for tag, rs in pending:
+            ready, _ = ray_tpu.wait([rs.ready_ref], num_returns=1, timeout=0)
+            if not ready:
+                continue
+            with self._lock:
+                try:
+                    ray_tpu.get(rs.ready_ref)
+                except Exception:  # noqa: BLE001 - replica died on startup
+                    logger.warning("replica %s failed to start", rs.actor_name)
+                    st.replicas.pop(tag, None)
+                    continue
+                rs.ready_ref = None
+                if tag in st.replicas:
+                    st.ready[tag] = rs
+                    st.version += 1
+
+    def _health_tick(self, st: _DeploymentState, now: float) -> None:
+        period = st.config.health_check_period_s
+        for tag, rs in list(st.ready.items()):
+            if rs.health_ref is None:
+                if now - rs.started_at >= period:
+                    rs.started_at = now
+                    rs.health_ref = rs.handle.check_health.remote()
+                continue
+            done, _ = ray_tpu.wait([rs.health_ref], num_returns=1, timeout=0)
+            if not done:
+                if now - rs.started_at > 4 * period:
+                    self._replica_died(st, tag, "health check timed out")
+                continue
+            try:
+                ray_tpu.get(rs.health_ref)
+                rs.health_ref = None
+            except Exception:  # noqa: BLE001
+                self._replica_died(st, tag, "health check failed")
+
+    def _replica_died(self, st: _DeploymentState, tag: str, why: str) -> None:
+        logger.warning("replica %s#%s removed: %s", st.key, tag, why)
+        with self._lock:
+            rs = st.replicas.pop(tag, None)
+            if st.ready.pop(tag, None) is not None:
+                st.version += 1
+        if rs is not None:
+            try:
+                ray_tpu.kill(rs.handle)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _drain_tick(self, st: _DeploymentState, now: float,
+                    orphan: bool = False) -> None:
+        with self._lock:
+            due = [rs for kill_at, rs in st.draining if now >= kill_at]
+            if not orphan:
+                st.draining = [(k, r) for k, r in st.draining if now < k]
+        for rs in due:
+            try:
+                ray_tpu.kill(rs.handle)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # --------------------------------------------------------------- shutdown
+    def shutdown_all(self) -> bool:
+        self._stop.set()
+        with self._lock:
+            for st in self._deployments.values():
+                draining = [rs for _, rs in st.draining]
+                st.draining = []
+                for rs in list(st.replicas.values()) + draining:
+                    try:
+                        ray_tpu.kill(rs.handle)
+                    except Exception:  # noqa: BLE001
+                        pass
+            self._deployments.clear()
+            self._apps.clear()
+            self._routes.clear()
+        return True

@@ -1,0 +1,230 @@
+"""DeploymentHandle + Router: request assignment to replicas.
+
+Reference: ``python/ray/serve/handle.py`` + the power-of-two-choices
+``ReplicaScheduler`` (SURVEY.md §3.6).  The router keeps a local
+ongoing-request count per replica, picks the less-loaded of two random
+replicas, and periodically (a) reaps completed requests, (b) refreshes the
+replica set from the controller, and (c) pushes per-deployment ongoing
+counts to the controller — the autoscaler's input signal (as in Ray 2.x,
+where handles report metrics rather than replicas).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu._private import rtlog
+
+logger = rtlog.get("serve.router")
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+_REPORT_INTERVAL_S = float(os.environ.get("RTPU_SERVE_REPORT_S", "0.5"))
+
+
+def get_controller():
+    return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+class DeploymentResponse:
+    """Future for one assigned request (reference: ``DeploymentResponse``)."""
+
+    def __init__(self, ref, router: "Router", replica_tag: str):
+        self._ref = ref
+        self._router = router
+        self._replica_tag = replica_tag
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class Router:
+    _instances: Dict[str, "Router"] = {}
+    _instances_lock = threading.Lock()
+
+    @classmethod
+    def for_deployment(cls, dep_key: str) -> "Router":
+        with cls._instances_lock:
+            r = cls._instances.get(dep_key)
+            if r is None:
+                r = cls._instances[dep_key] = Router(dep_key)
+            return r
+
+    @classmethod
+    def reset_all(cls) -> None:
+        with cls._instances_lock:
+            for r in cls._instances.values():
+                r._stop.set()
+            cls._instances.clear()
+
+    def __init__(self, dep_key: str):
+        self.dep_key = dep_key
+        self.router_id = uuid.uuid4().hex[:12]
+        self._controller = None
+        self._replicas: Dict[str, Any] = {}      # tag -> ActorHandle
+        self._counts: Dict[str, int] = {}        # tag -> my ongoing
+        self._outstanding: Dict[str, str] = {}   # ref id -> tag
+        self._out_refs: Dict[str, Any] = {}      # ref id -> ObjectRef
+        self._pending = 0        # waiting in assign() — autoscale signal too
+        self._max_ongoing = 0    # 0 = unknown/unbounded
+        self._version = -1
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._last_refresh = 0.0
+        threading.Thread(target=self._background_loop,
+                         name=f"serve-router-{dep_key}", daemon=True).start()
+
+    # ---------------------------------------------------------------- routing
+    def assign(self, method: str, args: tuple, kwargs: dict,
+               timeout_s: float = 60.0) -> DeploymentResponse:
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
+                      else v) for k, v in kwargs.items()}
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            self._pending += 1
+        try:
+            while True:
+                with self._lock:
+                    tags = list(self._replicas)
+                    if tags:
+                        tag = self._pick(tags)
+                        # Enforce max_ongoing_requests at the router: hold
+                        # the request here (counted in _pending → autoscale
+                        # signal) instead of queueing it at a full replica.
+                        if not self._max_ongoing or \
+                                self._counts.get(tag, 0) < self._max_ongoing:
+                            handle = self._replicas[tag]
+                            self._counts[tag] = self._counts.get(tag, 0) + 1
+                            break
+                if time.monotonic() > deadline:
+                    raise ray_tpu.exceptions.RayServeError(
+                        f"no replica of {self.dep_key!r} became available "
+                        f"within {timeout_s}s")
+                self._refresh(force=True)
+                self._reap()
+                time.sleep(0.05)
+        finally:
+            with self._lock:
+                self._pending -= 1
+        ref = handle.handle_request.remote(method, args, kwargs)
+        with self._lock:
+            self._outstanding[str(ref.id)] = tag
+            self._out_refs[str(ref.id)] = ref
+        return DeploymentResponse(ref, self, tag)
+
+    def _pick(self, tags: List[str]) -> str:
+        if len(tags) == 1:
+            return tags[0]
+        a, b = random.sample(tags, 2)
+        ca, cb = self._counts.get(a, 0), self._counts.get(b, 0)
+        return a if ca <= cb else b
+
+    # ------------------------------------------------------------- background
+    def _background_loop(self) -> None:
+        while not self._stop.wait(_REPORT_INTERVAL_S):
+            try:
+                self._reap()
+                self._refresh()
+                self._report()
+            except Exception:  # noqa: BLE001 - cluster may be shutting down
+                if ray_tpu.is_initialized():
+                    logger.exception("router background loop error")
+                else:
+                    return
+
+    def _reap(self) -> None:
+        with self._lock:
+            refs = list(self._out_refs.values())
+        if not refs:
+            return
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+        if not ready:
+            return
+        with self._lock:
+            for r in ready:
+                tag = self._outstanding.pop(str(r.id), None)
+                self._out_refs.pop(str(r.id), None)
+                if tag is not None and tag in self._counts:
+                    self._counts[tag] = max(0, self._counts[tag] - 1)
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        min_gap = 0.2 if force else 2 * _REPORT_INTERVAL_S
+        if now - self._last_refresh < min_gap:
+            return
+        self._last_refresh = now
+        if self._controller is None:
+            self._controller = get_controller()
+        info = ray_tpu.get(
+            self._controller.get_deployment_targets.remote(self.dep_key))
+        if info is None:
+            return
+        with self._lock:
+            self._max_ongoing = info.get("max_ongoing") or 0
+            if info["version"] == self._version and not force:
+                return
+            self._version = info["version"]
+            new = {}
+            for tag, actor_name in info["replicas"].items():
+                if tag in self._replicas:
+                    new[tag] = self._replicas[tag]
+                else:
+                    try:
+                        new[tag] = ray_tpu.get_actor(actor_name)
+                    except Exception:  # noqa: BLE001 - not registered yet
+                        continue
+            self._replicas = new
+            self._counts = {t: self._counts.get(t, 0) for t in new}
+
+    def _report(self) -> None:
+        if self._controller is None:
+            return
+        with self._lock:
+            # Waiting-to-be-assigned requests count toward load, otherwise
+            # scale-from-zero (min_replicas=0) could never trigger.
+            total = len(self._outstanding) + self._pending
+        self._controller.report_handle_stats.remote(
+            self.router_id, self.dep_key, total)
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args: Any, **kwargs: Any) -> DeploymentResponse:
+        return self._handle._router().assign(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    """Callable reference to a deployment; picklable across processes."""
+
+    def __init__(self, dep_key: str):
+        self._dep_key = dep_key
+
+    def _router(self) -> Router:
+        return Router.for_deployment(self._dep_key)
+
+    def remote(self, *args: Any, **kwargs: Any) -> DeploymentResponse:
+        return self._router().assign("__call__", args, kwargs)
+
+    def __getattr__(self, name: str) -> _MethodCaller:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._dep_key,))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._dep_key!r})"

@@ -1,0 +1,69 @@
+"""HTTP request/response types handed to Serve ingress deployments.
+
+Reference: Ray Serve hands Starlette ``Request`` objects to ingress
+replicas (``python/ray/serve/_private/http_util.py``).  This framework has
+no ASGI dependency; the proxy parses HTTP itself and passes this small
+picklable ``Request`` to the ingress replica over the actor plane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json as _json
+from typing import Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+
+@dataclasses.dataclass
+class Request:
+    method: str
+    path: str                       # path with the route prefix stripped
+    raw_path: str                   # full path as received
+    query_params: Dict[str, str]
+    headers: Dict[str, str]
+    body: bytes = b""
+
+    def json(self):
+        return _json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode("utf-8", errors="replace")
+
+    @classmethod
+    def from_parts(cls, method: str, target: str, headers: Dict[str, str],
+                   body: bytes, route_prefix: str) -> "Request":
+        parts = urlsplit(target)
+        path = parts.path
+        stripped = path[len(route_prefix):] if (
+            route_prefix != "/" and path.startswith(route_prefix)) else path
+        if not stripped.startswith("/"):
+            stripped = "/" + stripped
+        return cls(method=method.upper(), path=stripped, raw_path=path,
+                   query_params=dict(parse_qsl(parts.query)),
+                   headers={k.lower(): v for k, v in headers.items()},
+                   body=body)
+
+
+@dataclasses.dataclass
+class Response:
+    """Explicit response; any other return value is coerced (see coerce)."""
+
+    body: object = b""
+    status_code: int = 200
+    content_type: Optional[str] = None
+
+    def encode(self) -> "Response":
+        if isinstance(self.body, bytes):
+            ct = self.content_type or "application/octet-stream"
+            return Response(self.body, self.status_code, ct)
+        if isinstance(self.body, str):
+            ct = self.content_type or "text/plain; charset=utf-8"
+            return Response(self.body.encode(), self.status_code, ct)
+        return Response(_json.dumps(self.body).encode(), self.status_code,
+                        self.content_type or "application/json")
+
+
+def coerce_response(value: object) -> Response:
+    if isinstance(value, Response):
+        return value.encode()
+    return Response(value).encode()

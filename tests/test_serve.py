@@ -1,0 +1,203 @@
+"""Serve test suite.
+
+Reference strategy: ``python/ray/serve/tests/`` (SURVEY.md §4) — HTTP
+against a local cluster, handle composition, autoscaling behavior with
+synthetic load, batching.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _http(method, url, body=None, timeout=30):
+    req = urllib.request.Request(url, data=body, method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _base_url():
+    host, port = serve.get_http_address()
+    return f"http://{host}:{port}"
+
+
+def test_http_ingress_and_handle(serve_cluster):
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            if isinstance(request, serve.Request):
+                return {"path": request.path, "q": request.query_params,
+                        "body": request.text()}
+            return {"direct": request}
+
+        def add(self, a, b):
+            return a + b
+
+    handle = serve.run(Echo.bind(), route_prefix="/echo")
+    # Handle path (no HTTP).
+    assert handle.remote("hi").result()["direct"] == "hi"
+    assert handle.add.remote(2, 3).result() == 5
+    # HTTP path.
+    status, body = _http("POST", _base_url() + "/echo/sub?x=1", b"payload")
+    assert status == 200
+    out = json.loads(body)
+    assert out["path"] == "/sub" and out["q"] == {"x": "1"}
+    assert out["body"] == "payload"
+    # Built-in endpoints.
+    status, body = _http("GET", _base_url() + "/-/routes")
+    assert status == 200 and json.loads(body) == {"/echo": "default#Echo"}
+
+
+def test_404_and_errors(serve_cluster):
+    @serve.deployment
+    class Boom:
+        def __call__(self, request):
+            raise ValueError("kaboom")
+
+    serve.run(Boom.bind(), route_prefix="/boom")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _http("GET", _base_url() + "/nope")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _http("GET", _base_url() + "/boom")
+    assert e.value.code == 500
+    assert "kaboom" in e.value.read().decode()
+
+
+def test_function_deployment_and_composition(serve_cluster):
+    @serve.deployment
+    def doubler(x):
+        return 2 * x
+
+    @serve.deployment
+    class Gateway:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __call__(self, request):
+            x = int(request.query_params.get("x", "0")) \
+                if isinstance(request, serve.Request) else int(request)
+            return self.inner.remote(x).result()
+
+    handle = serve.run(Gateway.bind(doubler.bind()), route_prefix="/")
+    assert handle.remote(21).result() == 42
+    status, body = _http("GET", _base_url() + "/?x=5")
+    assert status == 200 and json.loads(body) == 10
+
+
+def test_multiple_replicas_spread_load(serve_cluster):
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class Who:
+        def __init__(self):
+            import os
+            self.pid = os.getpid()
+
+        def __call__(self, request):
+            time.sleep(0.05)
+            return self.pid
+
+    handle = serve.run(Who.bind(), route_prefix="/who")
+    resps = [handle.remote(None) for _ in range(16)]
+    pids = {r.result() for r in resps}
+    assert len(pids) == 2
+
+
+def test_serve_batch(serve_cluster):
+    @serve.deployment
+    class Batcher:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        async def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batcher.bind(), route_prefix=None)
+    resps = [handle.remote(i) for i in range(8)]
+    assert sorted(r.result() for r in resps) == [i * 10 for i in range(8)]
+    assert max(handle.sizes.remote().result()) > 1
+
+
+def test_autoscaling_up_and_down(serve_cluster):
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1,
+            upscale_delay_s=0.2, downscale_delay_s=0.5),
+    )
+    class Slow:
+        def __call__(self, request):
+            time.sleep(0.3)
+            return "ok"
+
+    handle = serve.run(Slow.bind(), route_prefix=None)
+    key = "default#Slow"
+    assert serve.status()[key]["target"] == 1
+
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            try:
+                handle.remote(None).result(timeout_s=30)
+            except Exception:
+                return
+
+    threads = [threading.Thread(target=pound, daemon=True) for _ in range(6)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if serve.status()[key]["target"] >= 2:
+            break
+        time.sleep(0.2)
+    assert serve.status()[key]["target"] >= 2, serve.status()
+    stop.set()
+    for t in threads:
+        t.join()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if serve.status()[key]["target"] == 1:
+            break
+        time.sleep(0.2)
+    assert serve.status()[key]["target"] == 1, serve.status()
+
+
+def test_redeploy_and_delete(serve_cluster):
+    @serve.deployment
+    class V:
+        def __init__(self, version):
+            self.v = version
+
+        def __call__(self, request):
+            return self.v
+
+    handle = serve.run(V.bind(1), route_prefix="/v")
+    assert handle.remote(None).result() == 1
+    handle = serve.run(V.bind(2), route_prefix="/v")
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if handle.remote(None).result() == 2:
+            break
+        time.sleep(0.2)
+    assert handle.remote(None).result() == 2
+    serve.delete("default")
+    assert serve.status() == {}
