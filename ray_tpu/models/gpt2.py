@@ -43,7 +43,7 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16          # activation dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
-    attn_impl: str = "dense"           # "dense" | "ring" | "ulysses"
+    attn_impl: str = "dense"   # dense | flash | blockwise | ring | ulysses
     context_axis: Optional[str] = None  # mesh axis for SP/CP ("context")
 
     @property
@@ -153,6 +153,12 @@ def dense_causal_attention(q, k, v, cfg: GPT2Config) -> jax.Array:
 def _resolve_attn(cfg: GPT2Config) -> AttnImpl:
     if cfg.attn_impl == "dense":
         return dense_causal_attention
+    if cfg.attn_impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention_for_model
+        return flash_attention_for_model
+    if cfg.attn_impl == "blockwise":
+        from ray_tpu.ops.attention import blockwise_attention
+        return lambda q, k, v, cfg: blockwise_attention(q, k, v, causal=True)
     if cfg.attn_impl == "ring":
         from ray_tpu.ops.ring_attention import ring_attention_for_model
         return partial(ring_attention_for_model, axis_name=cfg.context_axis)
@@ -190,15 +196,10 @@ def forward(params: Params, tokens: jax.Array,
     B, T = tokens.shape
     attn = _resolve_attn(cfg)
     x = params["wte"].astype(cfg.dtype)[tokens]
-    if cfg.context_axis is not None:
-        # Sequence is sharded: each shard holds a contiguous T-chunk whose
-        # global offset is shard_index * T (ring/Ulysses kernels handle the
-        # cross-shard attention; positions must be global).
-        idx = lax.axis_index(cfg.context_axis)
-        pos = idx * T + jnp.arange(T)
-    else:
-        pos = jnp.arange(T)
-    x = x + params["wpe"].astype(cfg.dtype)[pos]
+    # Arrays here are GLOBAL (GSPMD view) even when the sequence dim is
+    # sharded over the context axis — only the attention impl drops into
+    # shard_map (where chunk offsets come from lax.axis_index).
+    x = x + params["wpe"].astype(cfg.dtype)[jnp.arange(T)]
 
     block = partial(_block, cfg=cfg, attn=attn)
     if cfg.remat:

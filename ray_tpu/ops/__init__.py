@@ -1,0 +1,23 @@
+"""ray_tpu.ops — TPU kernels and long-context attention (SURVEY.md §5.7).
+
+The reference framework ships no kernels; these are greenfield TPU-first
+components: flash/blockwise attention, a Pallas flash kernel, and the two
+context-parallel schedules (ring via ppermute, Ulysses via all-to-all).
+"""
+
+from ray_tpu.ops.attention import (  # noqa: F401
+    blockwise_attention, dense_attention,
+)
+from ray_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from ray_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_sharded,
+)
+from ray_tpu.ops.ulysses import (  # noqa: F401
+    ulysses_attention, ulysses_attention_sharded,
+)
+
+__all__ = [
+    "dense_attention", "blockwise_attention", "flash_attention",
+    "ring_attention", "ring_attention_sharded",
+    "ulysses_attention", "ulysses_attention_sharded",
+]

@@ -1,0 +1,130 @@
+"""Pallas flash attention (TPU kernel) with a recompute backward.
+
+Greenfield TPU component (SURVEY.md §5.7): tiled online-softmax attention
+that never materializes the T×T score matrix in HBM.  Each grid step owns
+one (batch·head, q-block) tile in VMEM and streams K/V blocks through the
+MXU with running (m, l, acc) accumulators — the classic flash schedule,
+expressed the Pallas way (grid + BlockSpecs; see
+/opt/skills/guides/pallas_guide.md).
+
+Differentiation: the forward runs the Pallas kernel; the backward
+recomputes attention with the pure-JAX blockwise implementation
+(``ray_tpu.ops.attention.blockwise_attention``) and differentiates that —
+numerically identical softmax, O(T·block) memory, no hand-written bwd
+kernel to maintain.  On non-TPU backends the kernel runs in interpret mode
+(CI exercises the same code path).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ray_tpu.ops.attention import NEG_INF, blockwise_attention
+
+DEFAULT_BLOCK = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  seq_len: int, causal: bool, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
+    D = q.shape[-1]
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    # Causal: block row qi only attends K blocks 0..qi (block_q == block_k).
+    nblocks = seq_len // block_k
+    upper = jnp.minimum(qi + 1, nblocks) if causal else nblocks
+    acc, _, l = lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal: bool, block_size: int,
+                   interpret: Optional[bool]) -> jax.Array:
+    B, T, H, D = q.shape
+    bs = min(block_size, T)
+    if T % bs:
+        raise ValueError(f"seq len {T} not divisible by block {bs}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / math.sqrt(D)
+    # (B,T,H,D) -> (B*H, T, D): one grid row per (batch, head).
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kernel = functools.partial(_flash_kernel, block_q=bs, block_k=bs,
+                               seq_len=T, causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, T // bs),
+        in_specs=[
+            pl.BlockSpec((1, bs, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bs, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_size: int = DEFAULT_BLOCK,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """(B,T,H,D)×3 → (B,T,H,D) tiled attention; differentiable."""
+    return _flash_forward(q, k, v, causal=causal, block_size=block_size,
+                          interpret=interpret)
+
+
+def _fwd(q, k, v, causal, block_size, interpret):
+    out = _flash_forward(q, k, v, causal=causal, block_size=block_size,
+                         interpret=interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_size, interpret, res, g):
+    q, k, v = res
+    # Recompute-and-differentiate through the blockwise flash (remat-style):
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, block_size=block_size), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention_for_model(q, k, v, cfg=None, **_):
+    """Model hook (``attn_impl='flash'``)."""
+    return flash_attention(q, k, v, True)

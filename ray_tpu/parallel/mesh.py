@@ -77,6 +77,32 @@ class MeshConfig:
             or ("data",)
 
 
+# --------------------------------------------------------------------------
+# Ambient mesh: lets model code reach the program mesh at TRACE time (e.g.
+# ops/ring_attention wrapping shard_map inside a pjit region).  Set by
+# ray_tpu.parallel.spmd around step tracing; plain contextvar — no jax
+# global state involved.
+# --------------------------------------------------------------------------
+import contextlib
+import contextvars
+
+_AMBIENT_MESH: contextvars.ContextVar[Optional[Mesh]] = \
+    contextvars.ContextVar("ray_tpu_ambient_mesh", default=None)
+
+
+def get_ambient_mesh() -> Optional[Mesh]:
+    return _AMBIENT_MESH.get()
+
+
+@contextlib.contextmanager
+def ambient_mesh(mesh: Mesh):
+    token = _AMBIENT_MESH.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _AMBIENT_MESH.reset(token)
+
+
 def build_mesh(config: MeshConfig,
                devices: Optional[Sequence[Any]] = None) -> Mesh:
     """Assemble a ``jax.sharding.Mesh`` with the canonical axis names.

@@ -131,7 +131,10 @@ def build_train_program(
     init_fn = jax.jit(_init, out_shardings=state_sh)
 
     def _step(state: TrainState, batch: Any):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        # Runs at trace time: model code (e.g. ring attention) can pick up
+        # the program mesh via mesh_lib.get_ambient_mesh() to nest shard_map.
+        with mesh_lib.ambient_mesh(mesh):
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)

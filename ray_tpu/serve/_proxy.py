@@ -32,7 +32,7 @@ class ProxyActor:
         self._controller = get_controller()
         self._routes: Dict[str, str] = {}
         self._routes_ts = 0.0
-        self._routes_lock = threading.Lock()
+        self._refresh_lock = threading.Lock()
         self._timeout = request_timeout_s
         proxy = self
 
@@ -79,12 +79,20 @@ class ProxyActor:
 
     # ---------------------------------------------------------------- routing
     def _get_routes(self) -> Dict[str, str]:
-        with self._routes_lock:
-            if time.monotonic() - self._routes_ts > 1.0:
+        # Serve the cached dict; at most ONE thread refreshes a stale cache
+        # (non-blocking acquire) so a slow controller never stalls the
+        # whole HTTP data plane behind a lock held across an RPC.
+        if time.monotonic() - self._routes_ts > 1.0 and \
+                self._refresh_lock.acquire(blocking=False):
+            try:
                 self._routes = ray_tpu.get(
-                    self._controller.get_routes.remote())
+                    self._controller.get_routes.remote(), timeout=10)
                 self._routes_ts = time.monotonic()
-            return self._routes
+            except Exception:  # noqa: BLE001 - keep serving the stale map
+                pass
+            finally:
+                self._refresh_lock.release()
+        return self._routes
 
     def _match(self, path: str) -> Optional[tuple]:
         routes = self._get_routes()
@@ -117,9 +125,18 @@ class ProxyActor:
                                      dict(req.headers), body, prefix)
         handle = DeploymentHandle(dep_key)
         try:
-            result = handle.remote(request).result(timeout_s=self._timeout)
+            # The configured request timeout bounds BOTH phases: waiting
+            # for a replica (assign) and waiting for the result.
+            start = time.monotonic()
+            resp_f = handle._router().assign(
+                "__call__", (request,), {}, timeout_s=self._timeout)
+            remaining = max(0.1, self._timeout - (time.monotonic() - start))
+            result = resp_f.result(timeout_s=remaining)
         except ray_tpu.exceptions.GetTimeoutError:
             self._respond(req, 408, b"request timed out", "text/plain")
+            return
+        except ray_tpu.exceptions.RayServeError as e:
+            self._respond(req, 503, str(e).encode(), "text/plain")
             return
         except Exception as e:  # noqa: BLE001 - user code raised
             self._respond(req, 500, str(e).encode(), "text/plain")

@@ -57,13 +57,24 @@ class Replica:
     def handle_request(self, method: str, args: Tuple, kwargs: Dict):
         import ray_tpu
         from ray_tpu._private.object_ref import ObjectRef
-        # Chained DeploymentResponses arrive as ObjectRefs nested inside the
-        # args tuple (the worker only auto-resolves TOP-level args); resolve
-        # them here so composed deployments see values, not refs.
-        args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
-                     for a in args)
-        kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
-                  for k, v in kwargs.items()}
+
+        # Chained DeploymentResponses arrive as ObjectRefs inside the args
+        # tuple — possibly nested in containers (the worker only
+        # auto-resolves TOP-level task args); resolve them all here so
+        # composed deployments see values, not refs.
+        def resolve(o):
+            if isinstance(o, ObjectRef):
+                return ray_tpu.get(o)
+            if isinstance(o, list):
+                return [resolve(x) for x in o]
+            if isinstance(o, tuple):
+                return tuple(resolve(x) for x in o)
+            if isinstance(o, dict):
+                return {k: resolve(v) for k, v in o.items()}
+            return o
+
+        args = tuple(resolve(a) for a in args)
+        kwargs = {k: resolve(v) for k, v in kwargs.items()}
         m = getattr(self._instance, method)
         if inspect.iscoroutinefunction(m):
             fut = asyncio.run_coroutine_threadsafe(

@@ -63,4 +63,4 @@ class DeploymentConfig:
             return self.num_replicas
         if ac.initial_replicas is not None:
             return ac.initial_replicas
-        return max(ac.min_replicas, 1 if ac.min_replicas == 0 else ac.min_replicas)
+        return max(1, ac.min_replicas)

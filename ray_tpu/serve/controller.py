@@ -90,10 +90,17 @@ class ServeController:
             old = self._apps.get(app_name, {}).get("deployments", [])
             for stale in set(old) - set(keys):
                 self._delete_deployment(stale)
-            self._apps[app_name] = {"ingress": f"{app_name}#{ingress}",
+            ingress_key = f"{app_name}#{ingress}"
+            old_ingress = self._apps.get(app_name, {}).get("ingress")
+            self._apps[app_name] = {"ingress": ingress_key,
                                     "deployments": keys}
+            # Drop stale prefixes from earlier deploys of this app before
+            # (re)registering — a route_prefix change must not leave the
+            # old URL serving.
+            self._routes = {p: k for p, k in self._routes.items()
+                            if k not in (ingress_key, old_ingress)}
             if route_prefix is not None:
-                self._routes[route_prefix] = f"{app_name}#{ingress}"
+                self._routes[route_prefix] = ingress_key
         return True
 
     def delete_application(self, app_name: str) -> bool:
@@ -220,8 +227,9 @@ class ServeController:
             self._start_replica(st)
         while len(st.replicas) > st.target:
             # Prefer draining not-yet-ready replicas, then newest ready.
-            tag = next((t for t in st.replicas if t not in st.ready),
-                       next(reversed(st.ready)))
+            tag = next((t for t in st.replicas if t not in st.ready), None)
+            if tag is None:
+                tag = next(reversed(st.ready))
             self._retire(st, st.replicas[tag], now)
 
     def _start_replica(self, st: _DeploymentState) -> None:

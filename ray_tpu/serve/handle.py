@@ -46,6 +46,18 @@ class DeploymentResponse:
         return self._ref
 
 
+def _strip_responses(obj: Any) -> Any:
+    if isinstance(obj, DeploymentResponse):
+        return obj._to_object_ref()
+    if isinstance(obj, list):
+        return [_strip_responses(o) for o in obj]
+    if isinstance(obj, tuple):
+        return tuple(_strip_responses(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _strip_responses(v) for k, v in obj.items()}
+    return obj
+
+
 class Router:
     _instances: Dict[str, "Router"] = {}
     _instances_lock = threading.Lock()
@@ -85,10 +97,11 @@ class Router:
     # ---------------------------------------------------------------- routing
     def assign(self, method: str, args: tuple, kwargs: dict,
                timeout_s: float = 60.0) -> DeploymentResponse:
-        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
-                     else a for a in args)
-        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
-                      else v) for k, v in kwargs.items()}
+        # DeploymentResponses anywhere in the args become ObjectRefs (they
+        # hold live threads/locks and must never be pickled); the replica
+        # resolves refs — nested ones included — back to values.
+        args = tuple(_strip_responses(a) for a in args)
+        kwargs = {k: _strip_responses(v) for k, v in kwargs.items()}
         deadline = time.monotonic() + timeout_s
         with self._lock:
             self._pending += 1
