@@ -63,6 +63,10 @@ _FLAG_DEFS = [
     _flag("object_spill_dir", "", "Directory for spilled objects ('' = <session>/spill)."),
     _flag("object_store_eviction", True, "LRU-evict sealed unreferenced objects to disk when full."),
     _flag("use_native_store", True, "Use the C++ shm store if the extension builds."),
+    _flag("slab_memory_mb", 512, "Capacity of the native slab store (small-object plane)."),
+    _flag("slab_object_max_bytes", 1024 * 1024,
+          "Objects <= this go through the C++ slab store; larger ones get "
+          "their own tmpfs segment (zero-copy mmap reads)."),
     # --- scheduler / workers -------------------------------------------------
     _flag("num_workers_per_node", 0, "Size of worker pool (0 = num_cpus)."),
     _flag("worker_register_timeout_s", 30.0, "Timeout for a spawned worker to register."),

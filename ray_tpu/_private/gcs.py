@@ -152,6 +152,14 @@ class GcsServer:
     def __init__(self, session: Session, head_resources: Dict[str, float]):
         self.session = session
         self.store = ShmObjectStore(spill_dir=str(session.spill_dir))
+        # Native C++ slab store: the small-object data plane (workers attach
+        # and read/write directly; the GCS owns lifecycle + refcount deletes).
+        self.slab = None
+        if GLOBAL_CONFIG.use_native_store:
+            from ray_tpu.native import SlabStore
+            self.slab = SlabStore.create(
+                session.slab_path(),
+                GLOBAL_CONFIG.slab_memory_mb * 1024 * 1024)
         self.lock = threading.RLock()
         self.cv = threading.Condition(self.lock)
 
@@ -287,6 +295,8 @@ class GcsServer:
                 self._decref(c)
             if meta.loc in ("shm", "spilled"):
                 self.store.delete_object(oid)
+            elif meta.loc == "slab" and self.slab is not None:
+                self.slab.delete(oid)
             del self.objects[oid]
 
     # ------------------------------------------------------------- scheduling
@@ -516,6 +526,8 @@ class GcsServer:
             return
         w.state = "dead"
         self.dead_clients.add(w.worker_id)
+        if self.slab is not None and not self._shutdown:
+            self.slab.reap_dead()  # free half-written slab objects it left
         node = self.nodes.get(w.node_id)
         if node is not None:
             node.workers.discard(w.worker_id)
@@ -909,6 +921,11 @@ class GcsServer:
                         self.store.restore(oid)
                         if not ShmObjectStore.exists_in_shm(oid):
                             missing_lost.append((oid, meta))
+                    elif verify_fs and meta.state == READY and \
+                            meta.loc == "slab":
+                        # same truth rule for the native slab plane
+                        if self.slab is None or not self.slab.exists(oid):
+                            missing_lost.append((oid, meta))
                 verify_fs = False
                 for oid, meta in missing_lost:
                     # purge stale store bookkeeping first: the segment is
@@ -1013,6 +1030,9 @@ class GcsServer:
                 meta = self.objects.pop(oid, None)
                 if meta is not None and meta.loc in ("shm", "spilled"):
                     self.store.delete_object(oid)
+                elif meta is not None and meta.loc == "slab" \
+                        and self.slab is not None:
+                    self.slab.delete(oid)
             self.cv.notify_all()
         return {}
 
@@ -1353,3 +1373,5 @@ class GcsServer:
         except OSError:
             pass
         self.store.shutdown()
+        if self.slab is not None:
+            self.slab.close()

@@ -56,6 +56,14 @@ class Session:
         # Unix socket paths are limited to ~107 bytes; keep names short.
         return str(self.socket_dir / name)
 
+    def slab_path(self) -> str:
+        """Path of the session's native slab store segment (C++ small-object
+        data plane; ray_tpu/native/src/slab_store.cc). Derived from the
+        session name so late-joining workers find it without a descriptor."""
+        import hashlib
+        tag = hashlib.md5(self.name.encode()).hexdigest()[:12]
+        return f"/dev/shm/rtpu_slab_{tag}"
+
     def write_descriptor(self, info: Dict[str, Any]) -> None:
         desc = dict(info)
         desc["session_name"] = self.name

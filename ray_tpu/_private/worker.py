@@ -122,6 +122,8 @@ class Worker:
         self._exec_thread_id: Optional[int] = None
         self._stop = threading.Event()
         self._profile_events: List[dict] = []
+        self._slab = None          # native slab store attachment (lazy)
+        self._slab_tried = False
         # registration happens on first channel creation
         info = self.pool.call("register_client", role=role,
                               client_id=self.worker_id, pid=os.getpid(),
@@ -149,12 +151,43 @@ class Worker:
                 except (OSError, ValueError):
                     pass
 
+    @property
+    def slab(self):
+        """Attachment to the session's native slab store (None if absent)."""
+        if not self._slab_tried:
+            self._slab_tried = True
+            if GLOBAL_CONFIG.use_native_store:
+                from ray_tpu.native import SlabStore
+                self._slab = SlabStore.attach(self.session.slab_path())
+        return self._slab
+
+    def _write_wire(self, oid: str, wire: bytes, overwrite: bool = False) -> str:
+        """Store wire bytes on the data plane; returns the loc recorded in the
+        object's metadata.  Small → native slab (one futex + memcpy, no
+        daemon traffic); large → own tmpfs segment (zero-copy mmap reads)."""
+        slab = self.slab
+        if slab is not None and len(wire) <= GLOBAL_CONFIG.slab_object_max_bytes:
+            if overwrite:
+                slab.delete(oid)  # reconstruction re-creates the id
+            if slab.put(oid, wire):
+                return "slab"
+            # slab full / out of slots → fall through to file-per-object
+        shm_write_wire(oid, wire, overwrite=overwrite)
+        return "shm"
+
     # ------------------------------------------------------------ put / get
     def put(self, value: Any, _owner_kind: str = KIND_PUT) -> ObjectRef:
         oid = ObjectID.make(self.worker_id, _owner_kind, self._put_seq())
         wire, refs = serialize_to_bytes(value)
         contained = [str(r.id) for r in refs]
-        if len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
+        slab = self.slab
+        tiny = len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes
+        if slab is not None and len(wire) <= GLOBAL_CONFIG.slab_object_max_bytes \
+                and slab.put(str(oid), wire):
+            self.rpc("put_object", object_id=str(oid), loc="slab",
+                     size=len(wire), contained=contained, node_id=self.node_id)
+        elif tiny:
+            # no slab, or slab full/out of slots: tiny objects ride the RPC
             self.rpc("put_object", object_id=str(oid), loc="inline", data=wire,
                      size=len(wire), contained=contained, node_id=self.node_id)
         else:
@@ -169,6 +202,14 @@ class Worker:
             raise err
         if meta["loc"] == "inline":
             return deserialize_from(memoryview(meta["data"]))
+        if meta["loc"] == "slab":
+            slab = self.slab
+            data = slab.get(oid) if slab is not None else None
+            if data is None:
+                # vanished between meta reply and read → same recovery path
+                # as a lost tmpfs segment
+                raise FileNotFoundError(oid)
+            return deserialize_from(memoryview(data))
         mapped = ShmObjectStore.map_readonly(oid)
         return deserialize_from(mapped.buf)
 
@@ -531,7 +572,8 @@ class Worker:
         for oid, v in zip(return_ids, values):
             res = self._serialize_result(v)
             if res["loc"] == "shm":
-                shm_write_wire(oid, res.pop("wire"), overwrite=True)
+                res["loc"] = self._write_wire(oid, res.pop("wire"),
+                                              overwrite=True)
             out.append(res)
         return out
 

@@ -1,0 +1,257 @@
+"""Native (C++) components, loaded via ctypes.
+
+The reference ships its runtime as C++ (raylet, plasma, core worker —
+SURVEY.md §2.1); here the native layer is built per-component and loaded
+through ``ctypes`` (no pybind11 in this environment).  Components:
+
+- ``slab_store.cc`` — shared-memory slab object store (plasma-equivalent
+  small-object data plane; see the .cc header comment for the design).
+
+Build strategy: compile on first import with ``g++ -O2 -shared -fPIC`` into
+``ray_tpu/native/_build/`` and cache by source mtime.  If no compiler is
+available the callers fall back to pure-Python paths; nothing in the
+framework *requires* the native layer, it is the fast path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from pathlib import Path
+from typing import Optional
+
+_SRC_DIR = Path(__file__).parent / "src"
+_BUILD_DIR = Path(__file__).parent / "_build"
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _compile(src: Path, out: Path) -> bool:
+    out.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(out.parent))
+    os.close(fd)
+    cmd = ["g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
+           "-o", tmp, str(src), "-lpthread"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        os.unlink(tmp)
+        return False
+    if proc.returncode != 0:
+        os.unlink(tmp)
+        import logging
+        logging.getLogger(__name__).warning(
+            "native build failed:\n%s", proc.stderr[-2000:])
+        return False
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return True
+
+
+def _ensure_built(name: str) -> Optional[Path]:
+    src = _SRC_DIR / f"{name}.cc"
+    out = _BUILD_DIR / f"lib{name}.so"
+    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+        return out
+    with _build_lock:
+        if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+            return out
+        return out if _compile(src, out) else None
+
+
+def load_slab_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the slab-store library; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+    if os.environ.get("RTPU_NO_NATIVE"):
+        _lib_tried = True
+        return None
+    path = _ensure_built("slab_store")
+    _lib_tried = True
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        # stale/incompatible cached build (e.g. sanitizer .so) → rebuild once
+        try:
+            path.unlink()
+        except OSError:
+            return None
+        path = _ensure_built("slab_store")
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            return None
+    lib.rtpu_store_open.restype = ctypes.c_void_p
+    lib.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_uint32, ctypes.c_int]
+    lib.rtpu_store_close.argtypes = [ctypes.c_void_p]
+    lib.rtpu_store_unlink.argtypes = [ctypes.c_char_p]
+    lib.rtpu_put.restype = ctypes.c_int64
+    lib.rtpu_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_char_p, ctypes.c_uint64]
+    lib.rtpu_get.restype = ctypes.c_int64
+    lib.rtpu_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_void_p, ctypes.c_uint64]
+    lib.rtpu_size.restype = ctypes.c_int64
+    lib.rtpu_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_exists.restype = ctypes.c_int
+    lib.rtpu_exists.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_delete.restype = ctypes.c_int
+    lib.rtpu_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_create.restype = ctypes.c_int64
+    lib.rtpu_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.rtpu_seal.restype = ctypes.c_int
+    lib.rtpu_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_lookup_pin.restype = ctypes.c_int64
+    lib.rtpu_lookup_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+    lib.rtpu_unpin.restype = ctypes.c_int
+    lib.rtpu_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtpu_base.restype = ctypes.c_void_p
+    lib.rtpu_base.argtypes = [ctypes.c_void_p]
+    lib.rtpu_store_stats.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+    lib.rtpu_lru_victims.restype = ctypes.c_int64
+    lib.rtpu_lru_victims.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                     ctypes.c_char_p, ctypes.c_uint64]
+    lib.rtpu_reap_dead.restype = ctypes.c_int64
+    lib.rtpu_reap_dead.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class SlabStore:
+    """Python handle on the shared-memory slab store.
+
+    One process creates (the GCS daemon); all others attach by path.  All
+    methods are safe to call from multiple threads (the shm mutex is the
+    only serialization point).
+    """
+
+    def __init__(self, path: str, handle: int, lib: ctypes.CDLL,
+                 owner: bool):
+        self.path = path
+        self._h = handle
+        self._lib = lib
+        self._owner = owner
+        self._closed = False
+        # Serializes close() against in-flight ops from other threads (the
+        # handle is freed by rtpu_store_close; calling into a freed handle
+        # is a use-after-free).  The shm mutex serializes cross-process.
+        self._oplock = threading.Lock()
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def create(cls, path: str, capacity_bytes: int,
+               max_objects: int = 65536) -> Optional["SlabStore"]:
+        lib = load_slab_lib()
+        if lib is None:
+            return None
+        h = lib.rtpu_store_open(path.encode(), capacity_bytes, max_objects, 1)
+        return cls(path, h, lib, owner=True) if h else None
+
+    @classmethod
+    def attach(cls, path: str) -> Optional["SlabStore"]:
+        lib = load_slab_lib()
+        if lib is None or not os.path.exists(path):
+            return None
+        h = lib.rtpu_store_open(path.encode(), 0, 0, 0)
+        return cls(path, h, lib, owner=False) if h else None
+
+    # -- object ops ----------------------------------------------------------
+    def put(self, object_id: str, data: bytes) -> bool:
+        """Copy data in under the shm lock. False if full/exists/no slot."""
+        with self._oplock:
+            if self._closed:
+                return False
+            return self._lib.rtpu_put(self._h, object_id.encode(), data,
+                                      len(data)) == 0
+
+    def get(self, object_id: str) -> Optional[bytes]:
+        with self._oplock:
+            if self._closed:
+                return None
+            # one lock acquisition for objects ≤64KB; -5 = buffer too small
+            cap = 65536
+            for _ in range(2):
+                buf = ctypes.create_string_buffer(cap)
+                n = self._lib.rtpu_get(self._h, object_id.encode(), buf, cap)
+                if n >= 0:
+                    return buf.raw[:n]
+                if n != -5:
+                    return None
+                cap = int(self._lib.rtpu_size(self._h, object_id.encode()))
+                if cap < 0:
+                    return None
+            return None
+
+    def exists(self, object_id: str) -> bool:
+        with self._oplock:
+            if self._closed:
+                return False
+            return bool(self._lib.rtpu_exists(self._h, object_id.encode()))
+
+    def delete(self, object_id: str) -> bool:
+        with self._oplock:
+            if self._closed:
+                return False
+            return self._lib.rtpu_delete(self._h, object_id.encode()) == 0
+
+    def stats(self) -> dict:
+        keys = ("used", "heap_size", "num_objects", "max_objects",
+                "hits", "misses", "allocs", "fails")
+        with self._oplock:
+            if self._closed:
+                return dict.fromkeys(keys, 0)
+            arr = (ctypes.c_uint64 * 8)()
+            self._lib.rtpu_store_stats(self._h, arr)
+            return dict(zip(keys, (int(v) for v in arr)))
+
+    def reap_dead(self) -> int:
+        """Free unsealed objects whose creator process has died."""
+        with self._oplock:
+            if self._closed:
+                return 0
+            return max(0, int(self._lib.rtpu_reap_dead(self._h)))
+
+    def lru_victims(self, need_bytes: int, cap: int = 1 << 16) -> list:
+        with self._oplock:
+            if self._closed:
+                return []
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.rtpu_lru_victims(self._h, need_bytes, buf, cap)
+            if n <= 0:
+                return []
+            ids = buf.raw.split(b"\x00")
+            return [i.decode() for i in ids[:n]]
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        with self._oplock:
+            if self._closed:
+                return
+            self._closed = True
+            self._lib.rtpu_store_close(self._h)
+        if self._owner:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

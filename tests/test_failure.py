@@ -59,7 +59,9 @@ def test_lineage_reconstruction(ray_start_regular):
 
     @ray_tpu.remote(max_retries=2)
     def produce():
-        return np.arange(100_000, dtype=np.int64)
+        # > slab_object_max_bytes so the return takes the file-per-object
+        # plane (the slab plane has its own loss test below)
+        return np.arange(300_000, dtype=np.int64)
 
     ref = produce.remote()
     first = ray_tpu.get(ref)
@@ -68,6 +70,26 @@ def test_lineage_reconstruction(ray_start_regular):
     # RTPU_SHM_DIR overrides are honored)
     from ray_tpu._private.shm_store import _seg_path
     os.unlink(str(_seg_path(str(ref.id))))
+    again = ray_tpu.get(ref, timeout=60)
+    assert again[42] == 42
+
+
+def test_lineage_reconstruction_slab(ray_start_regular):
+    """Losing a slab-plane (native store) object also triggers re-execution."""
+    import numpy as np
+
+    @ray_tpu.remote(max_retries=2)
+    def produce():
+        return np.arange(50_000, dtype=np.int64)  # ~400KB → slab plane
+
+    ref = produce.remote()
+    assert ray_tpu.get(ref)[42] == 42
+    from ray_tpu._private.worker import global_worker
+    slab = global_worker().slab
+    if slab is None:
+        import pytest
+        pytest.skip("native slab store unavailable")
+    assert slab.delete(str(ref.id))
     again = ray_tpu.get(ref, timeout=60)
     assert again[42] == 42
 
