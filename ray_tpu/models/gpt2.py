@@ -45,6 +45,8 @@ class GPT2Config:
     remat: bool = True
     attn_impl: str = "dense"   # dense | flash | blockwise | ring | ulysses
     context_axis: Optional[str] = None  # mesh axis for SP/CP ("context")
+    pipeline_axis: Optional[str] = None  # mesh axis for PP ("pipeline")
+    num_microbatches: int = 0  # 0 = auto (4x stages, divisor of batch)
 
     @property
     def head_dim(self) -> int:
@@ -208,7 +210,35 @@ def forward(params: Params, tokens: jax.Array,
     def scan_body(carry, lp):
         return block(carry, lp), None
 
-    x, _ = lax.scan(scan_body, x, params["blocks"])
+    pp_mesh = None
+    if cfg.pipeline_axis is not None:
+        from ray_tpu.parallel import mesh as mesh_lib
+        pp_mesh = mesh_lib.get_ambient_mesh()
+        if pp_mesh is None:
+            # Loud, not silent: tracing with PP configured but no ambient
+            # mesh would bake a non-pipelined program into the jit cache.
+            raise RuntimeError(
+                "cfg.pipeline_axis is set but no ambient mesh is installed; "
+                "trace inside ray_tpu.parallel.mesh.ambient_mesh(mesh) "
+                "(spmd.build_train_program does this)")
+    if pp_mesh is not None and pp_mesh.shape[cfg.pipeline_axis] > 1:
+        # Pipeline-parallel block stack: stages ride ppermute over the
+        # pipeline mesh axis; within a stage, the usual scan over its layer
+        # slice.  Remat stays per-block (scan_body), not per-stage.
+        from ray_tpu.parallel import pipeline as pp_lib
+        S = pp_mesh.shape[cfg.pipeline_axis]
+        staged = pp_lib.stack_stages(params["blocks"], S)
+        M = cfg.num_microbatches or pp_lib.pick_num_microbatches(B, S)
+
+        def stage_fn(sp, xm):
+            y, _ = lax.scan(scan_body, xm, sp)
+            return y
+
+        x = pp_lib.merge_microbatches(pp_lib.pipeline_apply(
+            stage_fn, staged, pp_lib.split_microbatches(x, M),
+            mesh=pp_mesh, axis=cfg.pipeline_axis, remat=False))
+    else:
+        x, _ = lax.scan(scan_body, x, params["blocks"])
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(cfg.dtype))
     return logits.astype(jnp.float32)

@@ -53,14 +53,12 @@ def _compile(src: Path, out: Path) -> bool:
 
 
 def _ensure_built(name: str) -> Optional[Path]:
+    """Caller must hold _build_lock."""
     src = _SRC_DIR / f"{name}.cc"
     out = _BUILD_DIR / f"lib{name}.so"
     if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
         return out
-    with _build_lock:
-        if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
-            return out
-        return out if _compile(src, out) else None
+    return out if _compile(src, out) else None
 
 
 def load_slab_lib() -> Optional[ctypes.CDLL]:
@@ -69,14 +67,21 @@ def load_slab_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     with _build_lock:
+        # everything below happens under the lock so a concurrent caller
+        # never observes _lib_tried before _lib is assigned
         if _lib is not None or _lib_tried:
             return _lib
+        return _load_slab_lib_locked()
+
+
+def _load_slab_lib_locked() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
     if os.environ.get("RTPU_NO_NATIVE"):
         _lib_tried = True
         return None
     path = _ensure_built("slab_store")
-    _lib_tried = True
     if path is None:
+        _lib_tried = True
         return None
     try:
         lib = ctypes.CDLL(str(path))
@@ -85,13 +90,16 @@ def load_slab_lib() -> Optional[ctypes.CDLL]:
         try:
             path.unlink()
         except OSError:
-            return None
-        path = _ensure_built("slab_store")
-        if path is None:
-            return None
-        try:
-            lib = ctypes.CDLL(str(path))
-        except OSError:
+            path = None
+        path = _ensure_built("slab_store") if path is not None else None
+        lib = None
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(str(path))
+            except OSError:
+                lib = None
+        if lib is None:
+            _lib_tried = True
             return None
     lib.rtpu_store_open.restype = ctypes.c_void_p
     lib.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
@@ -129,6 +137,7 @@ def load_slab_lib() -> Optional[ctypes.CDLL]:
     lib.rtpu_reap_dead.restype = ctypes.c_int64
     lib.rtpu_reap_dead.argtypes = [ctypes.c_void_p]
     _lib = lib
+    _lib_tried = True
     return _lib
 
 
@@ -170,32 +179,49 @@ class SlabStore:
         h = lib.rtpu_store_open(path.encode(), 0, 0, 0)
         return cls(path, h, lib, owner=False) if h else None
 
+    # Payloads above this copy OUTSIDE the shm mutex (create→memmove→seal on
+    # write, lookup_pin→string_at→unpin on read) so a 1MB memcpy doesn't
+    # convoy every other process behind the single cross-process lock.
+    _COPY_UNDER_LOCK_MAX = 65536
+
     # -- object ops ----------------------------------------------------------
     def put(self, object_id: str, data: bytes) -> bool:
-        """Copy data in under the shm lock. False if full/exists/no slot."""
+        """Store bytes. False if full/exists/out of slots."""
+        enc = object_id.encode()
         with self._oplock:
             if self._closed:
                 return False
-            return self._lib.rtpu_put(self._h, object_id.encode(), data,
-                                      len(data)) == 0
+            if len(data) <= self._COPY_UNDER_LOCK_MAX:
+                return self._lib.rtpu_put(self._h, enc, data, len(data)) == 0
+            off = self._lib.rtpu_create(self._h, enc, len(data))
+            if off < 0:
+                return False
+            base = self._lib.rtpu_base(self._h)
+            ctypes.memmove(base + off, data, len(data))
+            return self._lib.rtpu_seal(self._h, enc) == 0
 
     def get(self, object_id: str) -> Optional[bytes]:
+        enc = object_id.encode()
         with self._oplock:
             if self._closed:
                 return None
-            # one lock acquisition for objects ≤64KB; -5 = buffer too small
-            cap = 65536
-            for _ in range(2):
-                buf = ctypes.create_string_buffer(cap)
-                n = self._lib.rtpu_get(self._h, object_id.encode(), buf, cap)
-                if n >= 0:
-                    return buf.raw[:n]
-                if n != -5:
-                    return None
-                cap = int(self._lib.rtpu_size(self._h, object_id.encode()))
-                if cap < 0:
-                    return None
-            return None
+            cap = self._COPY_UNDER_LOCK_MAX
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.rtpu_get(self._h, enc, buf, cap)
+            if n >= 0:
+                return buf.raw[:n]
+            if n != -5:  # miss
+                return None
+            # large object: pin, copy outside the shm mutex, unpin
+            size = ctypes.c_uint64()
+            off = self._lib.rtpu_lookup_pin(self._h, enc, ctypes.byref(size))
+            if off < 0:
+                return None
+            try:
+                base = self._lib.rtpu_base(self._h)
+                return ctypes.string_at(base + off, size.value)
+            finally:
+                self._lib.rtpu_unpin(self._h, enc)
 
     def exists(self, object_id: str) -> bool:
         with self._oplock:
