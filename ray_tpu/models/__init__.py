@@ -1,0 +1,46 @@
+"""Model zoo: flagship models for the benchmark baselines (BASELINE.md).
+
+The reference framework ships no models (it benchmarks torch models inside
+worker actors); here they are first-class so trainers, serving, and benches
+share one implementation.
+
+========== =========================== ============================
+module     flagship                    baseline
+========== =========================== ============================
+gpt2       GPT-2 124M…1.5B             #5 tokens/s/chip (north star)
+resnet     ResNet-50 (GN+WS, NHWC)     #2 images/s/chip
+bert       BERT-base encoder           #4 Serve latency/QPS
+moe_transformer  top-k routed MoE      expert-parallel flagship
+========== =========================== ============================
+"""
+
+from ray_tpu.models import bert, gpt2, moe_transformer, resnet  # noqa: F401
+
+REGISTRY = {
+    "gpt2": gpt2,
+    "resnet": resnet,
+    "bert": bert,
+    "moe": moe_transformer,
+}
+
+
+def get_model(name: str):
+    """Look up a model module by family name, "family/preset", or an
+    unambiguous preset name (raises if several families define it)."""
+    if name in REGISTRY:
+        return REGISTRY[name]
+    if "/" in name:
+        family, _, preset = name.partition("/")
+        mod = REGISTRY.get(family)
+        if mod is None or preset not in getattr(mod, "PRESETS", {}):
+            raise KeyError(f"unknown model {name!r}")
+        return mod
+    hits = [(fam, mod) for fam, mod in REGISTRY.items()
+            if name in getattr(mod, "PRESETS", {})]
+    if len(hits) == 1:
+        return hits[0][1]
+    if hits:
+        raise KeyError(
+            f"preset {name!r} is ambiguous across families "
+            f"{sorted(f for f, _ in hits)}; use 'family/{name}'")
+    raise KeyError(f"unknown model {name!r}; families: {sorted(REGISTRY)}")

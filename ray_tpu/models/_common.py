@@ -1,0 +1,15 @@
+"""Shared helpers for the model zoo (single source for init/count logic)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def normal_init(key: jax.Array, shape, dtype, scale: float = 0.02):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def param_count(params: Any) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

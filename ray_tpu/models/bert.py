@@ -1,0 +1,215 @@
+"""BERT encoder family (BERT-base flagship) — baseline #4 (Serve latency/QPS).
+
+The reference serves BERT via HuggingFace-on-torch inside Serve replica
+actors (reference: ``python/ray/serve/`` examples).  TPU-first rebuild:
+
+- Same stacked-layers + ``lax.scan`` layout as GPT-2 (one block compile),
+  bidirectional attention (no causal mask), learned position embeddings,
+  segment embeddings, post-LN like the original BERT.
+- bf16 activations; f32 layer norms and softmax.
+- Heads: masked-LM (tied embeddings) and sequence classification (pooler),
+  selectable per call — a Serve deployment holds ONE param pytree and jits
+  per (head, batch-shape); padding-bucketed shapes keep recompiles bounded.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_positions: int = 512
+    type_vocab_size: int = 2
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    intermediate: int = 3072
+    num_labels: int = 2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+def bert_base() -> BertConfig:
+    return BertConfig()
+
+
+def bert_large() -> BertConfig:
+    return BertConfig(n_embd=1024, n_layer=24, n_head=16, intermediate=4096)
+
+
+def tiny(vocab: int = 128, seq: int = 64) -> BertConfig:
+    return BertConfig(vocab_size=vocab, max_positions=seq, n_embd=64,
+                      n_layer=2, n_head=4, intermediate=128)
+
+
+PRESETS = {"bert-base": bert_base, "bert-large": bert_large, "tiny": tiny}
+
+
+# ------------------------------------------------------------------- params
+from ray_tpu.models._common import normal_init as _dense_init, param_count  # noqa: E402
+
+
+def init_params(rng: jax.Array, cfg: BertConfig) -> Params:
+    pd = cfg.param_dtype
+    E, L, FF = cfg.n_embd, cfg.n_layer, cfg.intermediate
+    k = iter(jax.random.split(rng, 12 + 4 * L))
+
+    def stack(f):
+        return jnp.stack([f(next(k)) for _ in range(L)])
+
+    blocks = {
+        "attn_qkv": {"kernel": stack(lambda kk: _dense_init(kk, (E, 3, E), pd)),
+                     "bias": jnp.zeros((L, 3, E), pd)},
+        "attn_out": {"kernel": stack(lambda kk: _dense_init(kk, (E, E), pd)),
+                     "bias": jnp.zeros((L, E), pd)},
+        "ln_1": {"scale": jnp.ones((L, E), pd), "bias": jnp.zeros((L, E), pd)},
+        "mlp_in": {"kernel": stack(lambda kk: _dense_init(kk, (E, FF), pd)),
+                   "bias": jnp.zeros((L, FF), pd)},
+        "mlp_out": {"kernel": stack(lambda kk: _dense_init(kk, (FF, E), pd)),
+                    "bias": jnp.zeros((L, E), pd)},
+        "ln_2": {"scale": jnp.ones((L, E), pd), "bias": jnp.zeros((L, E), pd)},
+    }
+    return {
+        "wte": _dense_init(next(k), (cfg.vocab_size, E), pd),
+        "wpe": _dense_init(next(k), (cfg.max_positions, E), pd),
+        "wtype": _dense_init(next(k), (cfg.type_vocab_size, E), pd),
+        "ln_emb": {"scale": jnp.ones((E,), pd), "bias": jnp.zeros((E,), pd)},
+        "blocks": blocks,
+        "pooler": {"kernel": _dense_init(next(k), (E, E), pd),
+                   "bias": jnp.zeros((E,), pd)},
+        "cls": {"kernel": jnp.zeros((E, cfg.num_labels), pd),
+                "bias": jnp.zeros((cfg.num_labels,), pd)},
+        "mlm_ln": {"scale": jnp.ones((E,), pd), "bias": jnp.zeros((E,), pd)},
+        "mlm_dense": {"kernel": _dense_init(next(k), (E, E), pd),
+                      "bias": jnp.zeros((E,), pd)},
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), pd),
+    }
+
+
+# ------------------------------------------------------------------ forward
+def _layer_norm(x, scale, bias, eps=1e-12):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return (((x32 - mu) * lax.rsqrt(var + eps)) * scale + bias).astype(x.dtype)
+
+
+def _attention(q, k, v, mask, cfg: BertConfig):
+    # (B, T, H, D) bidirectional; mask (B, T) 1=real token
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    bias = jnp.where(mask[:, None, None, :] > 0, 0.0,
+                     jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32) + bias, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+
+
+def _block(x, mask, lp, cfg: BertConfig):
+    B, T, E = x.shape
+    H, D = cfg.n_head, cfg.head_dim
+    qkv = jnp.einsum("bte,eck->btck", x,
+                     lp["attn_qkv"]["kernel"].astype(cfg.dtype))
+    qkv = qkv + lp["attn_qkv"]["bias"].astype(cfg.dtype)
+    q, k, v = [qkv[:, :, i, :].reshape(B, T, H, D) for i in range(3)]
+    a = _attention(q, k, v, mask, cfg).reshape(B, T, E)
+    a = a @ lp["attn_out"]["kernel"].astype(cfg.dtype) \
+        + lp["attn_out"]["bias"].astype(cfg.dtype)
+    x = _layer_norm(x + a, lp["ln_1"]["scale"], lp["ln_1"]["bias"])  # post-LN
+    h = x @ lp["mlp_in"]["kernel"].astype(cfg.dtype) \
+        + lp["mlp_in"]["bias"].astype(cfg.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ lp["mlp_out"]["kernel"].astype(cfg.dtype) \
+        + lp["mlp_out"]["bias"].astype(cfg.dtype)
+    return _layer_norm(x + h, lp["ln_2"]["scale"], lp["ln_2"]["bias"])
+
+
+def encode(params: Params, tokens: jax.Array, cfg: BertConfig,
+           attention_mask: Optional[jax.Array] = None,
+           token_type_ids: Optional[jax.Array] = None) -> jax.Array:
+    """tokens (B, T) int32 → hidden states (B, T, E)."""
+    B, T = tokens.shape
+    mask = attention_mask if attention_mask is not None \
+        else jnp.ones((B, T), jnp.int32)
+    types = token_type_ids if token_type_ids is not None \
+        else jnp.zeros((B, T), jnp.int32)
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    x = x + params["wpe"].astype(cfg.dtype)[jnp.arange(T)]
+    x = x + params["wtype"].astype(cfg.dtype)[types]
+    x = _layer_norm(x, params["ln_emb"]["scale"], params["ln_emb"]["bias"])
+
+    block = partial(_block, cfg=cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def body(carry, lp):
+        return block(carry, mask, lp), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    return x
+
+
+def pooled(params: Params, tokens: jax.Array, cfg: BertConfig,
+           attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """[CLS] pooled representation (B, E), tanh-activated."""
+    h = encode(params, tokens, cfg, attention_mask)
+    cls = h[:, 0, :]
+    return jnp.tanh(cls @ params["pooler"]["kernel"].astype(cfg.dtype)
+                    + params["pooler"]["bias"].astype(cfg.dtype))
+
+
+def classify(params: Params, tokens: jax.Array, cfg: BertConfig,
+             attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Sequence classification logits (B, num_labels) f32 — the Serve path."""
+    p = pooled(params, tokens, cfg, attention_mask)
+    return (p.astype(jnp.float32)
+            @ params["cls"]["kernel"].astype(jnp.float32)
+            + params["cls"]["bias"].astype(jnp.float32))
+
+
+def mlm_logits(params: Params, tokens: jax.Array, cfg: BertConfig,
+               attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Masked-LM logits (B, T, vocab) with tied embeddings."""
+    h = encode(params, tokens, cfg, attention_mask)
+    h = h @ params["mlm_dense"]["kernel"].astype(cfg.dtype) \
+        + params["mlm_dense"]["bias"].astype(cfg.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = _layer_norm(h, params["mlm_ln"]["scale"], params["mlm_ln"]["bias"])
+    logits = jnp.einsum("bte,ve->btv", h, params["wte"].astype(cfg.dtype))
+    return logits.astype(jnp.float32) + params["mlm_bias"].astype(jnp.float32)
+
+
+def mlm_loss(params: Params, batch: Dict[str, jax.Array],
+             cfg: BertConfig) -> jax.Array:
+    """batch: tokens (B,T), targets (B,T), loss_mask (B,T) 1=masked position."""
+    logits = mlm_logits(params, batch["tokens"], cfg,
+                        batch.get("attention_mask"))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["targets"][..., None],
+                               axis=-1)[..., 0]
+    m = batch["loss_mask"].astype(jnp.float32)
+    return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def classification_loss(params: Params, batch: Dict[str, jax.Array],
+                        cfg: BertConfig) -> jax.Array:
+    logits = classify(params, batch["tokens"], cfg,
+                      batch.get("attention_mask"))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, batch["labels"][:, None],
+                                axis=-1).mean()

@@ -82,8 +82,7 @@ PRESETS = {"gpt2": gpt2_small, "gpt2-124m": gpt2_small,
 
 
 # ------------------------------------------------------------------- params
-def _dense_init(key, shape, dtype, scale=0.02):
-    return (jax.random.normal(key, shape) * scale).astype(dtype)
+from ray_tpu.models._common import normal_init as _dense_init, param_count  # noqa: E402
 
 
 def init_params(rng: jax.Array, cfg: GPT2Config) -> Params:
@@ -124,10 +123,6 @@ def init_params(rng: jax.Array, cfg: GPT2Config) -> Params:
         "blocks": blocks,
         "ln_f": {"scale": jnp.ones((E,), pd), "bias": jnp.zeros((E,), pd)},
     }
-
-
-def param_count(params: Params) -> int:
-    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
 
 
 # ------------------------------------------------------------------ forward

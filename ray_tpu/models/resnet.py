@@ -1,0 +1,208 @@
+"""ResNet family (ResNet-50 flagship) — baseline #2 (JaxTrainer ImageNet).
+
+The reference framework ships no models; its ResNet-50 benchmark is
+torchvision inside Train workers (reference: ``python/ray/train/``
+examples).  This is a TPU-first reimplementation, not a torch port:
+
+- NHWC layout end to end (TPU convolutions are NHWC-native; torch is NCHW).
+- GroupNorm + weight standardization instead of BatchNorm: BN's
+  cross-replica batch-stat sync is a distributed-training liability (an
+  extra all-reduce per layer and a source of DP-degree-dependent numerics);
+  GN+WS is the public Big-Transfer (BiT) recipe that matches BN accuracy
+  while keeping the train step a pure function of (params, batch) — which is
+  what lets the whole step live in one jit.
+- bf16 activations / f32 params; convs hit the MXU.
+- Pure pytree params, stacked per stage where shapes agree (scan-friendly).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)   # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    gn_groups: int = 32
+    remat: bool = False
+
+
+def resnet18() -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(2, 2, 2, 2))
+
+
+def resnet50() -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3))
+
+
+def resnet101() -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 23, 3))
+
+
+def tiny(num_classes: int = 10) -> ResNetConfig:
+    """CIFAR-scale config for tests."""
+    return ResNetConfig(stage_sizes=(1, 1), width=16, num_classes=num_classes,
+                        gn_groups=8)
+
+
+PRESETS = {"resnet18": resnet18, "resnet50": resnet50,
+           "resnet101": resnet101, "tiny": tiny}
+
+
+# ------------------------------------------------------------------- params
+def _conv_init(key, shape, dtype):
+    # shape = (kh, kw, cin, cout); He fan-out init (matches BiT)
+    fan_out = shape[0] * shape[1] * shape[3]
+    std = math.sqrt(2.0 / fan_out)
+    return (jax.random.normal(key, shape) * std).astype(dtype)
+
+
+def _norm_params(c, pd):
+    return {"scale": jnp.ones((c,), pd), "bias": jnp.zeros((c,), pd)}
+
+
+def _bottleneck_params(key, cin, cmid, pd, stride):
+    ks = jax.random.split(key, 4)
+    cout = cmid * 4
+    p = {
+        "conv1": _conv_init(ks[0], (1, 1, cin, cmid), pd),
+        "gn1": _norm_params(cmid, pd),
+        "conv2": _conv_init(ks[1], (3, 3, cmid, cmid), pd),
+        "gn2": _norm_params(cmid, pd),
+        "conv3": _conv_init(ks[2], (1, 1, cmid, cout), pd),
+        "gn3": _norm_params(cout, pd),
+    }
+    if stride != 1 or cin != cout:
+        p["proj"] = _conv_init(ks[3], (1, 1, cin, cout), pd)
+        p["gn_proj"] = _norm_params(cout, pd)
+    return p
+
+
+def init_params(rng: jax.Array, cfg: ResNetConfig) -> Params:
+    pd = cfg.param_dtype
+    keys = iter(jax.random.split(rng, 4 + sum(cfg.stage_sizes)))
+    params: Params = {
+        "stem": {"conv": _conv_init(next(keys), (7, 7, 3, cfg.width), pd),
+                 "gn": _norm_params(cfg.width, pd)},
+    }
+    cin = cfg.width
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        cmid = cfg.width * (2 ** si)
+        blocks = []
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            blocks.append(_bottleneck_params(next(keys), cin, cmid, pd, stride))
+            cin = cmid * 4
+        params[f"stage{si}"] = blocks
+    params["head"] = {
+        "kernel": jnp.zeros((cin, cfg.num_classes), pd),  # zero-init head
+        "bias": jnp.zeros((cfg.num_classes,), pd),
+    }
+    return params
+
+
+# ------------------------------------------------------------------ forward
+def _standardize(w):
+    # weight standardization over (kh, kw, cin)
+    w32 = w.astype(jnp.float32)
+    mu = w32.mean((0, 1, 2), keepdims=True)
+    var = w32.var((0, 1, 2), keepdims=True)
+    return ((w32 - mu) * lax.rsqrt(var + 1e-10)).astype(w.dtype)
+
+
+def _conv(x, w, stride=1, ws=True):
+    w = _standardize(w) if ws else w
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _group_norm(x, scale, bias, groups):
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    x32 = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+    mu = x32.mean((1, 2, 4), keepdims=True)
+    var = x32.var((1, 2, 4), keepdims=True)
+    y = ((x32 - mu) * lax.rsqrt(var + 1e-5)).reshape(B, H, W, C)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _bottleneck(x, bp, cfg: ResNetConfig, stride: int):
+    norm = partial(_group_norm, groups=cfg.gn_groups)
+    r = x
+    y = jax.nn.relu(norm(_conv(x, bp["conv1"]), **bp["gn1"]))
+    y = jax.nn.relu(norm(_conv(y, bp["conv2"], stride), **bp["gn2"]))
+    y = norm(_conv(y, bp["conv3"]), **bp["gn3"])
+    if "proj" in bp:
+        r = norm(_conv(x, bp["proj"], stride), **bp["gn_proj"])
+    return jax.nn.relu(r + y)
+
+
+def forward(params: Params, images: jax.Array, cfg: ResNetConfig) -> jax.Array:
+    """images (B, H, W, 3) float → logits (B, num_classes) f32."""
+    x = images.astype(cfg.dtype)
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x = jax.nn.relu(_group_norm(x, groups=cfg.gn_groups,
+                                **params["stem"]["gn"]))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            block = partial(_bottleneck, cfg=cfg, stride=stride)
+            if cfg.remat:
+                block = jax.checkpoint(block)
+            x = block(x, params[f"stage{si}"][bi])
+    x = x.mean((1, 2))  # global average pool
+    logits = x.astype(jnp.float32) @ params["head"]["kernel"].astype(jnp.float32) \
+        + params["head"]["bias"].astype(jnp.float32)
+    return logits
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: ResNetConfig, label_smoothing: float = 0.0) -> jax.Array:
+    """batch: {"images": (B,H,W,3), "labels": (B,) int32}."""
+    logits = forward(params, batch["images"], cfg)
+    labels = batch["labels"]
+    n = logits.shape[-1]
+    targets = jax.nn.one_hot(labels, n)
+    if label_smoothing > 0:
+        targets = targets * (1 - label_smoothing) + label_smoothing / n
+    logp = jax.nn.log_softmax(logits)
+    return -(targets * logp).sum(-1).mean()
+
+
+def accuracy(params: Params, batch: Dict[str, jax.Array],
+             cfg: ResNetConfig) -> jax.Array:
+    logits = forward(params, batch["images"], cfg)
+    return (logits.argmax(-1) == batch["labels"]).mean()
+
+
+# Sharding rules: convs fsdp-sharded on cout (ZeRO-3 style), head dense
+# sharded like an MLP output; everything else replicated (ResNet is small —
+# DP/FSDP dominate, TP does not pay off).
+from jax.sharding import PartitionSpec as _P  # noqa: E402
+
+RESNET_RULES = [
+    (r".*stem/conv$",   _P(None, None, None, "fsdp")),
+    (r".*conv[123]$",   _P(None, None, None, "fsdp")),
+    (r".*proj$",        _P(None, None, None, "fsdp")),
+    (r".*head/kernel$", _P("fsdp", "tensor")),
+    (r".*", _P(None)),
+]
+
+
+from ray_tpu.models._common import param_count  # noqa: E402,F401

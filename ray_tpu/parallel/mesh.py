@@ -174,6 +174,10 @@ def _tree_paths(tree: Any, prefix: str = "") -> Any:
     if isinstance(tree, dict):
         return {k: _tree_paths(v, f"{prefix}/{k}" if prefix else str(k))
                 for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        seq = [_tree_paths(v, f"{prefix}/{i}" if prefix else str(i))
+               for i, v in enumerate(tree)]
+        return type(tree)(seq)
     return prefix
 
 
