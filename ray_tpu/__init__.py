@@ -86,6 +86,24 @@ def init(address: Optional[str] = None, *,
                 head_res["TPU"] = float(tpus)
             _head = GcsServer(session, head_res)
             session.write_descriptor({"gcs": _head.rpc_path})
+        elif address == "auto":
+            # attach to the latest session on this machine (reference:
+            # ray.init(address="auto"))
+            session = Session.latest()
+            desc_pid = session.read_descriptor().get("head_pid") \
+                or session.read_descriptor().get("pid")
+            alive = False
+            if desc_pid:
+                try:
+                    os.kill(desc_pid, 0)
+                    alive = True
+                except (ProcessLookupError, PermissionError):
+                    pass
+            if not alive:
+                raise ConnectionError(
+                    f"no running ray_tpu cluster (latest session "
+                    f"{session.path} has no live head process)")
+            rtlog.setup("driver", session.log_dir)
         else:
             # attach to an existing session (same machine)
             root, name = os.path.split(address)

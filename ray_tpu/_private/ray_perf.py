@@ -1,0 +1,165 @@
+"""Core microbenchmark suite (baseline #7, SURVEY.md §4/§6).
+
+Reference: ``python/ray/_private/ray_perf.py`` — the ``ray microbenchmark``
+CLI: single-node tasks/s, actor calls/s, put/get throughput.  This is the
+de-facto perf regression gate; run it after core changes.
+
+Usage: ``python -m ray_tpu.scripts.cli microbenchmark [--quick]``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+def _timeit(name: str, fn: Callable[[], int], *, repeat: int = 3,
+            results: Optional[List[dict]] = None) -> dict:
+    """fn() runs a batch and returns ops count; report best ops/s."""
+    best = 0.0
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        n = fn()
+        dt = time.perf_counter() - t0
+        best = max(best, n / dt)
+    rec = {"name": name, "ops_per_s": best}
+    print(f"{name:<44s} {best:>12,.1f} /s")
+    if results is not None:
+        results.append(rec)
+    return rec
+
+
+def _bandwidth(name: str, fn: Callable[[], int], *, repeat: int = 3,
+               results: Optional[List[dict]] = None) -> dict:
+    """fn() moves bytes and returns byte count; report best GB/s."""
+    best = 0.0
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        nbytes = fn()
+        dt = time.perf_counter() - t0
+        best = max(best, nbytes / dt / 1e9)
+    rec = {"name": name, "gb_per_s": best}
+    print(f"{name:<44s} {best:>12.3f} GB/s")
+    if results is not None:
+        results.append(rec)
+    return rec
+
+
+def main(quick: bool = False) -> List[dict]:
+    scale = 0.2 if quick else 1.0
+    results: List[dict] = []
+    owns_cluster = not ray_tpu.is_initialized()
+    if owns_cluster:
+        ray_tpu.init()
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    @ray_tpu.remote
+    def echo(x):
+        return x
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            return None
+
+        def batch(self, n):
+            return n
+
+    # -- task throughput (async submit, drain at end) ------------------------
+    n_tasks = int(2000 * scale)
+
+    def task_throughput():
+        ray_tpu.get([nop.remote() for _ in range(n_tasks)])
+        return n_tasks
+
+    _timeit("tasks: submit+get throughput", task_throughput, results=results)
+
+    # -- task round-trip latency (serial) ------------------------------------
+    n_serial = int(200 * scale)
+
+    def task_rtt():
+        for _ in range(n_serial):
+            ray_tpu.get(nop.remote())
+        return n_serial
+
+    _timeit("tasks: serial round-trips", task_rtt, results=results)
+
+    # -- actor calls ---------------------------------------------------------
+    sink = Sink.remote()
+    ray_tpu.get(sink.ping.remote())  # warm
+    n_actor = int(2000 * scale)
+
+    def actor_async():
+        ray_tpu.get([sink.ping.remote() for _ in range(n_actor)])
+        return n_actor
+
+    _timeit("actor: async calls", actor_async, results=results)
+
+    n_actor_serial = int(500 * scale)
+
+    def actor_rtt():
+        for _ in range(n_actor_serial):
+            ray_tpu.get(sink.ping.remote())
+        return n_actor_serial
+
+    _timeit("actor: serial round-trips", actor_rtt, results=results)
+    # release the actor's CPU before the task benches below — on a 1-CPU
+    # node a live actor would otherwise starve them forever
+    ray_tpu.kill(sink)
+
+    # -- object plane --------------------------------------------------------
+    small = np.random.bytes(8 * 1024)           # slab plane
+    n_small = int(1000 * scale)
+
+    def put_small():
+        refs = [ray_tpu.put(small) for _ in range(n_small)]
+        del refs
+        return n_small
+
+    _timeit("put: 8KB objects (slab plane)", put_small, results=results)
+
+    big = np.random.randint(0, 255, size=50 * 1024 * 1024 // 8,
+                            dtype=np.int64)     # 50MB, file plane
+    n_big = 4
+
+    def put_big():
+        refs = [ray_tpu.put(big) for _ in range(n_big)]
+        del refs
+        return n_big * big.nbytes
+
+    _bandwidth("put: 50MB numpy (shm plane)", put_big, results=results)
+
+    ref = ray_tpu.put(big)
+
+    def get_big():
+        for _ in range(n_big):
+            ray_tpu.get(ref)
+        return n_big * big.nbytes
+
+    _bandwidth("get: 50MB numpy (zero-copy reads)", get_big, results=results)
+
+    # -- args passing --------------------------------------------------------
+    payload = np.random.bytes(int(100 * 1024))
+    n_args = int(300 * scale)
+
+    def pass_args():
+        ray_tpu.get([echo.remote(payload) for _ in range(n_args)])
+        return n_args
+
+    _timeit("tasks: 100KB arg passing", pass_args, results=results)
+
+    if owns_cluster:
+        ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+    main(quick="--quick" in sys.argv)

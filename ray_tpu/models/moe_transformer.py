@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ray_tpu.models import gpt2 as gpt2_lib
-from ray_tpu.models._common import param_count  # noqa: F401
+from ray_tpu.models._common import normal_init, param_count  # noqa: F401
 from ray_tpu.ops import moe as moe_lib
 
 Params = Dict[str, Any]
@@ -74,7 +74,7 @@ def init_params(rng: jax.Array, cfg: MoEConfig) -> Params:
         return jnp.stack([f(next(k)) for _ in range(L)])
 
     def dense(kk, shape, scale=0.02):
-        return (jax.random.normal(kk, shape) * scale).astype(pd)
+        return normal_init(kk, shape, pd, scale)
 
     blocks = {
         "ln_1": {"scale": jnp.ones((L, E), pd), "bias": jnp.zeros((L, E), pd)},

@@ -177,6 +177,8 @@ def _tree_paths(tree: Any, prefix: str = "") -> Any:
     if isinstance(tree, (list, tuple)):
         seq = [_tree_paths(v, f"{prefix}/{i}" if prefix else str(i))
                for i, v in enumerate(tree)]
+        if hasattr(tree, "_fields"):  # namedtuple: positional constructor
+            return type(tree)(*seq)
         return type(tree)(seq)
     return prefix
 

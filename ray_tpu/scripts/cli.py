@@ -1,0 +1,201 @@
+"""``ray_tpu`` command-line interface.
+
+Reference: ``python/ray/scripts/scripts.py`` (SURVEY.md §2.3) — ``ray
+start/stop/status/timeline/memory/microbenchmark`` and the state-API
+``ray list ...`` commands.  Invoke as ``python -m ray_tpu.scripts.cli`` or
+``python -m ray_tpu`` (see ``ray_tpu/__main__.py``).
+
+``start`` boots a head session whose control plane outlives the command
+(daemon-style via fork) so other drivers can ``ray_tpu.init(address=...)``
+against it; ``stop`` terminates it via the session descriptor pid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+
+def _connect(address: Optional[str]) -> None:
+    import ray_tpu
+    ray_tpu.init(address=address or "auto")
+
+
+# ------------------------------------------------------------------ commands
+def cmd_start(args) -> int:
+    import ray_tpu
+    if args.block:
+        ray_tpu.init(num_cpus=args.num_cpus or None)
+        desc = ray_tpu._worker_mod.global_worker().session.path  # noqa: SLF001
+        print(f"head started (session {desc}); Ctrl-C to stop")
+        try:
+            signal.pause()
+        except KeyboardInterrupt:
+            pass
+        ray_tpu.shutdown()
+        return 0
+    pid = os.fork()
+    if pid == 0:  # child: become the head daemon
+        os.setsid()
+        # detach from the parent's pipes or a capturing caller never sees
+        # EOF; daemon logs go to the session dir once init() runs
+        devnull = os.open(os.devnull, os.O_RDWR)
+        for fd in (0, 1, 2):
+            os.dup2(devnull, fd)
+        ray_tpu.init(num_cpus=args.num_cpus or None)
+        w = ray_tpu._worker_mod.global_worker()  # noqa: SLF001
+        desc = w.session.read_descriptor()
+        desc.update({"role": "head", "head_pid": os.getpid()})
+        w.session.write_descriptor(desc)
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+        while True:
+            time.sleep(3600)
+    # parent: wait for the session descriptor to appear
+    from ray_tpu._private.session import Session
+    for _ in range(100):
+        try:
+            s = Session.latest()
+            if s.read_descriptor().get("head_pid") == pid:
+                print(f"head started: pid={pid} session={s.path}\n"
+                      f"connect with ray_tpu.init(address='auto')")
+                return 0
+        except (FileNotFoundError, json.JSONDecodeError, KeyError):
+            pass
+        time.sleep(0.2)
+    print("head failed to start", file=sys.stderr)
+    return 1
+
+
+def cmd_stop(args) -> int:
+    from ray_tpu._private.session import Session
+    try:
+        desc = Session.latest().read_descriptor()
+    except FileNotFoundError:
+        print("no running session found")
+        return 1
+    pid = desc.get("head_pid") or desc.get("pid")
+    if not pid:
+        print("session has no recorded head pid")
+        return 1
+    try:
+        os.kill(pid, signal.SIGTERM)
+        print(f"sent SIGTERM to head pid={pid}")
+        return 0
+    except ProcessLookupError:
+        print(f"head pid={pid} already gone")
+        return 0
+
+
+def cmd_status(args) -> int:
+    _connect(args.address)
+    from ray_tpu.util import state
+    s = state.cluster_summary()
+    print(json.dumps(s, indent=2, default=str))
+    return 0
+
+
+def cmd_list(args) -> int:
+    _connect(args.address)
+    from ray_tpu.util import state
+    fns = {"nodes": state.list_nodes, "actors": state.list_actors,
+           "tasks": state.list_tasks, "objects": state.list_objects,
+           "workers": state.list_workers,
+           "placement-groups": state.list_placement_groups}
+    rows = fns[args.kind]()
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_memory(args) -> int:
+    _connect(args.address)
+    from ray_tpu.util import state
+    rows = state.object_memory(group_by=args.group_by)
+    print(f"{'group':<12} {'count':>8} {'bytes':>14} {'refs':>6}")
+    for r in rows:
+        print(f"{r[args.group_by]:<12} {r['count']:>8} {r['bytes']:>14,} "
+              f"{r['pinned_refs']:>6}")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    _connect(args.address)
+    import ray_tpu
+    out = args.output or f"timeline_{int(time.time())}.json"
+    events = ray_tpu.timeline(filename=out)
+    print(f"wrote {len(events)} events to {out} (chrome://tracing format)")
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu._private import ray_perf
+    ray_perf.main(quick=args.quick)
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    _connect(args.address)
+    from ray_tpu.util import metrics
+    print(metrics.prometheus_text(metrics.collect_cluster()))
+    return 0
+
+
+def cmd_version(args) -> int:
+    import ray_tpu
+    print(getattr(ray_tpu, "__version__", "0.1.0-dev"))
+    return 0
+
+
+# --------------------------------------------------------------------- main
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu",
+        description="TPU-native distributed framework CLI")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start a head node")
+    sp.add_argument("--num-cpus", type=int, default=0)
+    sp.add_argument("--block", action="store_true",
+                    help="stay in the foreground")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the latest head node")
+    sp.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("timeline", cmd_timeline),
+                     ("memory", cmd_memory), ("metrics", cmd_metrics)):
+        sp = sub.add_parser(name)
+        sp.add_argument("--address", default=None)
+        if name == "timeline":
+            sp.add_argument("-o", "--output", default=None)
+        if name == "memory":
+            sp.add_argument("--group-by", default="loc",
+                            choices=("loc", "state"))
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("list", help="list cluster entities")
+    sp.add_argument("kind", choices=("nodes", "actors", "tasks", "objects",
+                                     "workers", "placement-groups"))
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("microbenchmark", help="run the core perf suite")
+    sp.add_argument("--quick", action="store_true")
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("version")
+    sp.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

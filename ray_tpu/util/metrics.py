@@ -1,0 +1,195 @@
+"""Application-level metrics: Counter / Gauge / Histogram with tags.
+
+Reference: ``ray.util.metrics`` (``python/ray/util/metrics.py``; SURVEY.md
+§5.5) — user code registers metrics that flow to each node's metrics agent
+and out a Prometheus endpoint.  Here the registry lives in-process and
+publishes snapshots into the GCS KV (``__metrics__/<worker>``) so the driver
+— or the dashboard-lite HTTP endpoint — can aggregate cluster-wide without a
+sidecar agent; ``prometheus_text()`` renders the standard exposition format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "Metric"] = {}
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   50.0, 100.0)
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    """Base: named metric with default tags and per-tagset series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+        with _REGISTRY_LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None and existing.kind != self.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {existing.kind}")
+            _REGISTRY[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _resolve_tags(self, tags: Optional[Dict[str, str]]):
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return _tag_key(merged)
+
+    # -- snapshot / exposition ----------------------------------------------
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [{"tags": dict(k), "value": self._render(v)}
+                    for k, v in self._series.items()]
+
+    def _render(self, v):
+        return v
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only go up")
+        k = self._resolve_tags(tags)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._series[self._resolve_tags(tags)] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = DEFAULT_BUCKETS,
+                 tag_keys: Sequence[str] = ()):
+        self.boundaries = tuple(sorted(boundaries))
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._resolve_tags(tags)
+        with self._lock:
+            series = self._series.get(k)
+            if series is None:
+                series = {"counts": [0] * (len(self.boundaries) + 1),
+                          "sum": 0.0, "count": 0}
+                self._series[k] = series
+            idx = bisect.bisect_left(self.boundaries, value)
+            series["counts"][idx] += 1
+            series["sum"] += value
+            series["count"] += 1
+
+    def _render(self, v):
+        return {"buckets": dict(zip([str(b) for b in self.boundaries]
+                                    + ["+Inf"], v["counts"])),
+                "sum": v["sum"], "count": v["count"]}
+
+
+# ---------------------------------------------------------------- exposition
+def registry_snapshot() -> Dict[str, dict]:
+    with _REGISTRY_LOCK:
+        metrics = list(_REGISTRY.values())
+    return {m.name: {"kind": m.kind, "description": m.description,
+                     "series": m.snapshot()} for m in metrics}
+
+
+def _fmt_tags(tags: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in sorted(tags.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text(snapshot: Optional[Dict[str, dict]] = None) -> str:
+    """Render a snapshot in the Prometheus exposition format."""
+    snap = snapshot if snapshot is not None else registry_snapshot()
+    out: List[str] = []
+    for name, m in sorted(snap.items()):
+        if m["description"]:
+            out.append(f"# HELP {name} {m['description']}")
+        out.append(f"# TYPE {name} {m['kind']}")
+        for s in m["series"]:
+            tags, v = s["tags"], s["value"]
+            if m["kind"] == "histogram":
+                acc = 0
+                for b, c in v["buckets"].items():
+                    acc += c
+                    out.append(f"{name}_bucket"
+                               f"{_fmt_tags(tags, f'le=\"{b}\"')} {acc}")
+                out.append(f"{name}_sum{_fmt_tags(tags)} {v['sum']}")
+                out.append(f"{name}_count{_fmt_tags(tags)} {v['count']}")
+            else:
+                out.append(f"{name}{_fmt_tags(tags)} {v}")
+    return "\n".join(out) + "\n"
+
+
+# ------------------------------------------------------------- cluster push
+def publish(worker=None) -> None:
+    """Publish this process's metrics snapshot to the GCS KV."""
+    import json
+
+    from ray_tpu._private import worker as worker_mod
+    w = worker or worker_mod.try_global_worker()
+    if w is None:
+        return
+    w.rpc("kv_put", key=f"__metrics__/{w.worker_id}",
+          value=json.dumps({"ts": time.time(),
+                            "snapshot": registry_snapshot()}).encode())
+
+
+def collect_cluster() -> Dict[str, dict]:
+    """Merge every process's published snapshot (driver-side)."""
+    import json
+
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod.global_worker()
+    keys = w.rpc("kv_keys", prefix="__metrics__/")["keys"]
+    merged: Dict[str, dict] = {}
+    for key in keys:
+        raw = w.rpc("kv_get", key=key).get("value")
+        if not raw:
+            continue
+        snap = json.loads(raw)["snapshot"]
+        for name, m in snap.items():
+            dst = merged.setdefault(name, {"kind": m["kind"],
+                                           "description": m["description"],
+                                           "series": []})
+            dst["series"].extend(m["series"])
+    return merged
+
+
+def _reset_for_tests() -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()

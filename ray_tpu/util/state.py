@@ -1,0 +1,93 @@
+"""Cluster state API: ``list_*`` / ``summarize_*`` / ``object_memory``.
+
+Reference: ``python/ray/util/state/`` (SURVEY.md §2.3) — ``ray list tasks``,
+``ray list actors``, ``ray summary``, ``ray memory``.  The data comes from
+the GCS's live tables over the normal control-plane RPC; no side channel.
+"""
+
+from __future__ import annotations
+
+from collections import Counter as _Counter
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as _worker_mod
+
+
+def _rpc(kind: str, **kw) -> dict:
+    return _worker_mod.global_worker().rpc(kind, **kw)
+
+
+# ------------------------------------------------------------------ list_*
+def list_nodes() -> List[dict]:
+    return _rpc("list_nodes")["nodes"]
+
+
+def list_actors(state: Optional[str] = None) -> List[dict]:
+    actors = _rpc("list_actors")["actors"]
+    return [a for a in actors if state is None or a["state"] == state]
+
+
+def list_tasks(state: Optional[str] = None) -> List[dict]:
+    tasks = _rpc("list_tasks")["tasks"]
+    return [t for t in tasks if state is None or t["state"] == state]
+
+
+def list_objects() -> List[dict]:
+    return _rpc("list_objects")["objects"]
+
+
+def list_workers() -> List[dict]:
+    return _rpc("list_workers")["workers"]
+
+
+def list_placement_groups() -> List[dict]:
+    pgs = _rpc("pg_table")["pgs"]
+    return [{"pg_id": pid, **info} for pid, info in pgs.items()]
+
+
+# --------------------------------------------------------------- summaries
+def summarize_tasks() -> Dict[str, int]:
+    return dict(_Counter(t["state"] for t in list_tasks()))
+
+
+def summarize_actors() -> Dict[str, int]:
+    return dict(_Counter(a["state"] for a in list_actors()))
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = list_objects()
+    by_loc = _Counter(o["loc"] for o in objs if o["loc"])
+    return {
+        "count": len(objs),
+        "total_bytes": sum(o["size"] or 0 for o in objs),
+        "by_loc": dict(by_loc),
+        "store": _rpc("store_stats")["stats"],
+    }
+
+
+def cluster_summary() -> Dict[str, Any]:
+    """One-call rollup used by `ray_tpu status`."""
+    res = _rpc("cluster_resources")
+    return {
+        "nodes": len([n for n in list_nodes() if n["alive"]]),
+        "resources_total": res["total"],
+        "resources_available": res["available"],
+        "tasks": summarize_tasks(),
+        "actors": summarize_actors(),
+        "objects": summarize_objects(),
+    }
+
+
+# ----------------------------------------------------------- object memory
+def object_memory(group_by: str = "loc") -> List[dict]:
+    """The `ray memory` equivalent: who holds object bytes, grouped."""
+    objs = list_objects()
+    groups: Dict[str, dict] = {}
+    for o in objs:
+        key = str(o.get(group_by))
+        g = groups.setdefault(key, {group_by: key, "count": 0, "bytes": 0,
+                                    "pinned_refs": 0})
+        g["count"] += 1
+        g["bytes"] += o["size"] or 0
+        g["pinned_refs"] += o["refcount"]
+    return sorted(groups.values(), key=lambda g: -g["bytes"])

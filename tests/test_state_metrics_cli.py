@@ -1,0 +1,162 @@
+"""State API, metrics, timeline, microbenchmark, CLI tests
+(SURVEY.md §2.3 state API, §5.1 tracing, §5.5 metrics, §4 microbenchmark)."""
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_lib
+from ray_tpu.util import state
+
+
+# ---------------------------------------------------------------- state API
+
+def test_list_and_summaries(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    ref = ray_tpu.put(np.arange(100))
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    actors = state.list_actors(state="ALIVE")
+    assert len(actors) == 1 and actors[0]["class_name"] == "A"
+    objs = state.list_objects()
+    assert any(o["object_id"] == str(ref.id) for o in objs)
+    workers = state.list_workers()
+    assert len(workers) >= 1
+
+    summ = state.cluster_summary()
+    assert summ["nodes"] == 1
+    assert summ["objects"]["count"] >= 1
+    assert "CPU" in summ["resources_total"]
+
+    mem = state.object_memory()
+    assert sum(g["count"] for g in mem) >= 1
+
+
+def test_object_memory_groups(ray_start_regular):
+    small = ray_tpu.put(b"x" * 1000)          # slab
+    big = ray_tpu.put(np.zeros(500_000))      # shm file plane (4MB)
+    rows = state.object_memory(group_by="loc")
+    locs = {r["loc"] for r in rows}
+    assert "shm" in locs
+    assert ("slab" in locs) or ("inline" in locs)
+    del small, big
+
+
+# ------------------------------------------------------------------ metrics
+
+def test_metrics_counter_gauge_histogram():
+    metrics_lib._reset_for_tests()
+    c = metrics_lib.Counter("req_total", "requests", ("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    g = metrics_lib.Gauge("queue_len")
+    g.set(7)
+    h = metrics_lib.Histogram("latency_s", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    snap = metrics_lib.registry_snapshot()
+    assert snap["req_total"]["kind"] == "counter"
+    series = {tuple(sorted(s["tags"].items())): s["value"]
+              for s in snap["req_total"]["series"]}
+    assert series[(("route", "/a"),)] == 3.0
+    assert snap["latency_s"]["series"][0]["value"]["count"] == 3
+
+    text = metrics_lib.prometheus_text()
+    assert 'req_total{route="/a"} 3.0' in text
+    assert "# TYPE latency_s histogram" in text
+    assert 'latency_s_bucket{le="+Inf"} 3' in text
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        metrics_lib.Gauge("req_total")  # kind clash
+
+
+def test_metrics_cluster_publish(ray_start_regular):
+    metrics_lib._reset_for_tests()
+    metrics_lib.Gauge("driver_gauge").set(1.0)
+    metrics_lib.publish()
+
+    @ray_tpu.remote
+    def worker_side():
+        from ray_tpu.util import metrics as m
+        m._reset_for_tests()
+        m.Counter("worker_counter").inc(5)
+        m.publish()
+        return True
+
+    assert ray_tpu.get(worker_side.remote())
+    merged = metrics_lib.collect_cluster()
+    assert "driver_gauge" in merged and "worker_counter" in merged
+
+
+# ----------------------------------------------------------------- timeline
+
+def test_timeline_chrome_trace(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    out = tmp_path / "trace.json"
+    deadline = time.time() + 10
+    while True:
+        # profile events are shipped asynchronously from workers; poll
+        events = ray_tpu.timeline(filename=str(out))
+        if len(events) >= 3 or time.time() > deadline:
+            break
+        time.sleep(0.2)
+    assert len(events) >= 3
+    trace = json.loads(out.read_text())
+    # chrome://tracing format: list of events with ph/ts/pid/name
+    assert isinstance(trace, list) and trace
+    assert {"name", "ph", "ts", "pid"} <= set(trace[0])
+
+
+# ---------------------------------------------------------------------- CLI
+
+def _cli(*argv, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *argv],
+        capture_output=True, text=True, timeout=timeout, cwd="/root/repo")
+
+
+def test_cli_version():
+    r = _cli("version")
+    assert r.returncode == 0
+    assert r.stdout.strip() == ray_tpu.__version__
+
+
+def test_cli_microbenchmark_quick():
+    r = _cli("microbenchmark", "--quick", timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "tasks: submit+get throughput" in r.stdout
+    assert "put: 8KB objects" in r.stdout
+
+
+def test_cli_start_status_stop():
+    r = _cli("start")
+    assert r.returncode == 0, r.stderr[-2000:]
+    try:
+        r2 = _cli("status", "--address", "auto")
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        summary = json.loads(r2.stdout[r2.stdout.index("{"):])
+        assert summary["nodes"] >= 1
+    finally:
+        r3 = _cli("stop")
+        assert r3.returncode == 0, r3.stderr[-2000:]
