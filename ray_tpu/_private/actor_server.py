@@ -40,6 +40,8 @@ class ActorServer:
         self.addr = worker.session.socket_path(sock_name)
         self._listener = protocol.make_listener(self.addr)
         self._queue: "queue.Queue" = queue.Queue()
+        self._send_lock = threading.Lock()  # replies come from executor
+        # threads AND the asyncio loop; Connection.send isn't thread-safe
         self._stopped = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         if any(inspect.iscoroutinefunction(getattr(type(instance), m, None))
@@ -105,12 +107,33 @@ class ActorServer:
             return fn(self.instance, *rest, **kwargs)
         method = getattr(self.instance, method_name)
         if inspect.iscoroutinefunction(method):
-            if self._loop is not None:
-                fut = asyncio.run_coroutine_threadsafe(
-                    method(*args, **kwargs), self._loop)
-                return fut.result()
-            return asyncio.run(method(*args, **kwargs))
+            if self._loop is None:
+                return asyncio.run(method(*args, **kwargs))
+            # handled by _handle_call's async fast path; reaching here means
+            # a coroutine method was invoked via __ray_apply__ — block, as
+            # there is no conn to reply on later
+            fut = asyncio.run_coroutine_threadsafe(
+                method(*args, **kwargs), self._loop)
+            return fut.result()
         return method(*args, **kwargs)
+
+    async def _run_async_call(self, method, args, kwargs, conn, msg) -> None:
+        """Body of an async method call: runs ON the event loop and replies
+        from its completion, so no executor thread blocks while the
+        coroutine waits (e.g. a queue actor with 100 parked get()s)."""
+        return_ids: List[str] = msg["return_ids"]
+        w = self.worker
+        try:
+            value = await method(*args, **kwargs)
+            results = w._store_results(return_ids, value, msg["num_returns"])
+            ok = True
+        except Exception as e:  # noqa: BLE001
+            err = exc.RayTaskError.from_exception(
+                f"{self.spec.get('class_name', 'Actor')}.{msg['method']}", e)
+            err_res = {"loc": "error", "data": serialize_to_bytes(err)[0]}
+            results = [err_res for _ in return_ids]
+            ok = False
+        self._seal_and_reply(conn, msg, results, ok)
 
     def _handle_call(self, conn, msg: dict) -> None:
         return_ids: List[str] = msg["return_ids"]
@@ -118,7 +141,16 @@ class ActorServer:
         w = self.worker
         try:
             args, kwargs = w._unpack_args(msg)
-            value = self._run_method(msg["method"], args, kwargs)
+            method_name = msg["method"]
+            if self._loop is not None and method_name not in (
+                    "__ray_terminate__", "__ray_ready__", "__ray_apply__"):
+                method = getattr(self.instance, method_name, None)
+                if method is not None and inspect.iscoroutinefunction(method):
+                    asyncio.run_coroutine_threadsafe(
+                        self._run_async_call(method, args, kwargs, conn, msg),
+                        self._loop)
+                    return  # executor thread freed; reply comes from the loop
+            value = self._run_method(method_name, args, kwargs)
             results = w._store_results(return_ids, value, num_returns)
             ok = True
         except ActorExit:
@@ -150,8 +182,10 @@ class ActorServer:
         inline = [r.get("data") if r["loc"] == "inline" else None
                   for r in results]
         try:
-            conn.send({"call_id": msg["call_id"], "return_ids": msg["return_ids"],
-                       "inline_results": inline, "ok": ok})
+            with self._send_lock:
+                conn.send({"call_id": msg["call_id"],
+                           "return_ids": msg["return_ids"],
+                           "inline_results": inline, "ok": ok})
         except (OSError, ValueError):
             pass  # caller went away; results are in the GCS regardless
 

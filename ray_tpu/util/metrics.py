@@ -170,14 +170,24 @@ def publish(worker=None) -> None:
 
 
 def collect_cluster() -> Dict[str, dict]:
-    """Merge every process's published snapshot (driver-side)."""
+    """Merge every live process's published snapshot (driver-side).
+
+    Each series gains a ``worker`` tag so identical name+tags from two
+    processes stay distinct samples (duplicate labels are invalid
+    Prometheus); snapshots from dead workers are skipped.
+    """
     import json
 
     from ray_tpu._private import worker as worker_mod
     w = worker_mod.global_worker()
+    live = {wk["worker_id"] for wk in w.rpc("list_workers")["workers"]
+            if wk["state"] != "dead"}
     keys = w.rpc("kv_keys", prefix="__metrics__/")["keys"]
     merged: Dict[str, dict] = {}
     for key in keys:
+        wid = key.split("/", 1)[1]
+        if wid not in live:
+            continue
         raw = w.rpc("kv_get", key=key).get("value")
         if not raw:
             continue
@@ -186,7 +196,10 @@ def collect_cluster() -> Dict[str, dict]:
             dst = merged.setdefault(name, {"kind": m["kind"],
                                            "description": m["description"],
                                            "series": []})
-            dst["series"].extend(m["series"])
+            for s in m["series"]:
+                dst["series"].append(
+                    {"tags": {**s["tags"], "worker": wid[:12]},
+                     "value": s["value"]})
     return merged
 
 

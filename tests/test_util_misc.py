@@ -1,0 +1,186 @@
+"""ray.util misc parity: ActorPool, distributed Queue, multiprocessing.Pool
+shim, joblib backend (SURVEY.md §2.3 "ray.util misc")."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        return 2 * x
+
+    def slow_double(self, x):
+        time.sleep(0.05 * (3 - x))  # later values finish first
+        return 2 * x
+
+
+# ---------------------------------------------------------------- ActorPool
+
+def test_actor_pool_map_ordered(ray_start_regular):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_actor_pool_unordered_and_reuse(ray_start_regular):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = set(pool.map_unordered(lambda a, v: a.slow_double.remote(v),
+                                 [0, 1, 2]))
+    assert out == {0, 2, 4}
+    # pool reusable after drain
+    assert list(pool.map(lambda a, v: a.double.remote(v), [5])) == [10]
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    pool = ActorPool([_Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)  # queued (1 actor)
+    assert pool.has_next()
+    assert pool.get_next() == 20
+    assert pool.get_next() == 40
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_actor_pool_push_pop(ray_start_regular):
+    a, b = _Doubler.remote(), _Doubler.remote()
+    pool = ActorPool([a])
+    assert pool.pop_idle() is not None
+    assert not pool.has_free()
+    pool.push(b)
+    assert pool.has_free()
+
+
+# -------------------------------------------------------------------- Queue
+
+def test_queue_fifo(ray_start_regular):
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+    assert q.empty()
+
+
+def test_queue_maxsize_and_nowait(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    assert q.get_nowait() == 1
+    with pytest.raises(Empty):
+        Queue().get_nowait()
+
+
+def test_queue_blocking_timeout(ray_start_regular):
+    q = Queue()
+    t0 = time.monotonic()
+    with pytest.raises(Empty):
+        q.get(timeout=0.3)
+    assert time.monotonic() - t0 >= 0.25
+
+
+def test_queue_cross_task(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q):
+        for i in range(3):
+            q.put(i * 100)
+        return True
+
+    assert ray_tpu.get(producer.remote(q))
+    assert [q.get(timeout=10) for _ in range(3)] == [0, 100, 200]
+
+
+# ---------------------------------------------------- multiprocessing.Pool
+
+def test_mp_pool_map(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+    with Pool(processes=2) as p:
+        assert p.map(lambda x: x * x, range(10)) == [x * x for x in range(10)]
+
+
+def test_mp_pool_apply_starmap_imap(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+    p = Pool(processes=2)
+    assert p.apply(lambda a, b: a + b, (2, 3)) == 5
+    ar = p.apply_async(lambda: 42)
+    assert ar.get(timeout=30) == 42 and ar.ready()
+    assert p.starmap(lambda a, b: a * b, [(1, 2), (3, 4)]) == [2, 12]
+    assert list(p.imap(lambda x: -x, [1, 2, 3])) == [-1, -2, -3]
+    assert set(p.imap_unordered(lambda x: -x, [1, 2, 3])) == {-1, -2, -3}
+    p.close()
+    p.join()
+    with pytest.raises(ValueError):
+        p.map(lambda x: x, [1])
+
+
+# ------------------------------------------------------------------- joblib
+
+def test_joblib_backend(ray_start_regular):
+    joblib = pytest.importorskip("joblib")
+    import ray_tpu.util.joblib  # noqa: F401 - registers the backend
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(lambda x: x ** 2)(i)
+                                for i in range(6))
+    assert out == [0, 1, 4, 9, 16, 25]
+
+
+def test_queue_many_parked_getters_no_deadlock(ray_start_regular):
+    """More blocked gets than the actor's executor threads must not wedge
+    the queue actor — parked gets live on the event loop, not threads."""
+    q = Queue()
+    getters = [q.get_async() for _ in range(25)]  # > max_concurrency=16
+    time.sleep(0.2)
+    for i in range(25):
+        q.put(i)
+    got = sorted(ray_tpu.get(getters, timeout=60))
+    assert got == list(range(25))
+
+
+def test_queue_async_refs_return_items(ray_start_regular):
+    q = Queue()
+    assert ray_tpu.get(q.put_async("x"), timeout=30) is True
+    assert ray_tpu.get(q.get_async(), timeout=30) == "x"
+
+
+def test_actor_pool_error_does_not_leak_actor(ray_start_regular):
+    @ray_tpu.remote
+    class F:
+        def boom(self):
+            raise ValueError("nope")
+
+        def ok(self):
+            return 1
+
+    pool = ActorPool([F.remote()])
+    pool.submit(lambda a, v: a.boom.remote(), None)
+    with pytest.raises(Exception):
+        pool.get_next()
+    # actor must be back in the pool and usable
+    pool.submit(lambda a, v: a.ok.remote(), None)
+    assert pool.get_next() == 1
+
+
+def test_actor_pool_get_next_timeout_keeps_state(ray_start_regular):
+    @ray_tpu.remote
+    class Slow:
+        def f(self):
+            time.sleep(1.0)
+            return 7
+
+    pool = ActorPool([Slow.remote()])
+    pool.submit(lambda a, v: a.f.remote(), None)
+    with pytest.raises(TimeoutError):
+        pool.get_next(timeout=0.05)
+    assert pool.get_next(timeout=30) == 7
