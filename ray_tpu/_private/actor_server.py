@@ -118,22 +118,46 @@ class ActorServer:
         return method(*args, **kwargs)
 
     async def _run_async_call(self, method, args, kwargs, conn, msg) -> None:
-        """Body of an async method call: runs ON the event loop and replies
-        from its completion, so no executor thread blocks while the
-        coroutine waits (e.g. a queue actor with 100 parked get()s)."""
+        """Body of an async method call: only the await runs ON the event
+        loop (no executor thread parked while the coroutine waits); result
+        serialization, sealing, and the reply — all blocking I/O — are
+        handed back to a thread so parked coroutines never stall behind
+        them.  BaseException (incl. ActorExit) must be caught here: an
+        unobserved exception in the loop future would hang the caller."""
+        value = err = None
+        try:
+            value = await method(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            err = e
+        asyncio.get_running_loop().run_in_executor(
+            None, self._complete_async_call, conn, msg, value, err)
+
+    def _complete_async_call(self, conn, msg, value, err) -> None:
         return_ids: List[str] = msg["return_ids"]
         w = self.worker
         try:
-            value = await method(*args, **kwargs)
-            results = w._store_results(return_ids, value, msg["num_returns"])
-            ok = True
-        except Exception as e:  # noqa: BLE001
-            err = exc.RayTaskError.from_exception(
-                f"{self.spec.get('class_name', 'Actor')}.{msg['method']}", e)
-            err_res = {"loc": "error", "data": serialize_to_bytes(err)[0]}
-            results = [err_res for _ in return_ids]
-            ok = False
-        self._seal_and_reply(conn, msg, results, ok)
+            if err is None:
+                results = w._store_results(return_ids, value,
+                                           msg["num_returns"])
+                ok = True
+            elif isinstance(err, ActorExit):
+                err_res = {"loc": "error",
+                           "data": serialize_to_bytes(exc.RayActorError(
+                               self.actor_id, "actor exited"))[0]}
+                results = [err_res for _ in return_ids]
+                ok = False
+            else:
+                wrapped = exc.RayTaskError.from_exception(
+                    f"{self.spec.get('class_name', 'Actor')}."
+                    f"{msg['method']}", err)
+                err_res = {"loc": "error",
+                           "data": serialize_to_bytes(wrapped)[0]}
+                results = [err_res for _ in return_ids]
+                ok = False
+            self._seal_and_reply(conn, msg, results, ok)
+        finally:
+            if isinstance(err, ActorExit):
+                self._shutdown()
 
     def _handle_call(self, conn, msg: dict) -> None:
         return_ids: List[str] = msg["return_ids"]

@@ -1341,6 +1341,25 @@ class GcsServer:
                 "state": w.state, "actor_id": w.actor_id,
             } for w in self.workers.values()]}
 
+    def _h_resource_demand(self, msg: dict) -> dict:
+        """Unfulfilled resource shapes for the autoscaler: dep-ready pending
+        tasks/actor creations that lack capacity, plus unplaced PG bundles
+        (reference: autoscaler load_metrics fed by the GCS resource view)."""
+        with self.lock:
+            shapes = []
+            for spec in self.pending_tasks:
+                if self._deps_status(spec) == "ready":
+                    shapes.append(self._task_resources(spec))
+            for spec in self.infeasible_tasks:
+                shapes.append(self._task_resources(spec))
+            bundles = []
+            for pg in self.pgs.values():
+                if pg.state == PENDING:
+                    for i, b in enumerate(pg.bundles):
+                        if pg.assignment[i] is None:
+                            bundles.append(dict(b))
+            return {"task_shapes": shapes, "pg_bundles": bundles}
+
     def _h_store_stats(self, msg: dict) -> dict:
         return {"stats": self.store.stats()}
 

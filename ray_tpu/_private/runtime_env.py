@@ -1,0 +1,151 @@
+"""Runtime environments: per-task/actor env_vars, working_dir, py_modules.
+
+Reference: ``python/ray/_private/runtime_env/`` (SURVEY.md §2.3) — the
+driver uploads ``working_dir``/``py_modules`` into the GCS KV
+(content-addressed zips); workers download+extract into a session cache,
+chdir into the working dir and extend ``sys.path``, then undo after the
+task (env application is per-task here since workers are pooled).
+
+Omitted relative to the reference: pip/conda/container isolation — those
+need network/process isolation this environment doesn't have; env shape is
+validated so unsupported keys fail loudly rather than silently no-op.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "config"}
+_URI_PREFIX = "kv://runtime_env/"
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+_MAX_ZIP_BYTES = 64 * 1024 * 1024
+
+
+def validate(runtime_env: Optional[dict]) -> None:
+    if not runtime_env:
+        return
+    unknown = set(runtime_env) - SUPPORTED_KEYS
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; supported: "
+            f"{sorted(SUPPORTED_KEYS)} (pip/conda/container isolation is "
+            f"not available in this build)")
+
+
+# ---------------------------------------------------------------- packaging
+def _zip_dir(path: Path) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for p in sorted(path.rglob("*")):
+            if any(part in _EXCLUDE_DIRS for part in p.parts):
+                continue
+            if p.is_file():
+                # fixed date_time → content-addressed hash is stable
+                zi = zipfile.ZipInfo(str(p.relative_to(path)),
+                                     date_time=(1980, 1, 1, 0, 0, 0))
+                zi.external_attr = (p.stat().st_mode & 0xFFFF) << 16
+                zf.writestr(zi, p.read_bytes())
+    data = buf.getvalue()
+    if len(data) > _MAX_ZIP_BYTES:
+        raise ValueError(f"working_dir zip is {len(data)} bytes "
+                         f"(limit {_MAX_ZIP_BYTES}); exclude large data")
+    return data
+
+
+def upload_dir(path: str, worker) -> str:
+    """Zip + content-address + store in GCS KV; returns kv:// URI."""
+    p = Path(path).resolve()
+    if not p.is_dir():
+        raise ValueError(f"runtime_env directory not found: {path}")
+    data = _zip_dir(p)
+    digest = hashlib.sha256(data).hexdigest()[:16]
+    key = f"runtime_env/{digest}"
+    uri = _URI_PREFIX + digest
+    if not worker.rpc("kv_get", key=key).get("value"):
+        worker.rpc("kv_put", key=key, value=data)
+    return uri
+
+
+def prepare(runtime_env: Optional[dict], worker) -> Optional[dict]:
+    """Driver-side: resolve local paths into uploaded URIs (at submit)."""
+    if not runtime_env:
+        return runtime_env
+    validate(runtime_env)
+    env = dict(runtime_env)
+    wd = env.get("working_dir")
+    if wd and not str(wd).startswith(_URI_PREFIX):
+        env["working_dir"] = upload_dir(wd, worker)
+    mods = env.get("py_modules")
+    if mods:
+        env["py_modules"] = [
+            m if str(m).startswith(_URI_PREFIX) else upload_dir(m, worker)
+            for m in mods]
+    return env
+
+
+# --------------------------------------------------------------- worker side
+def ensure_local(uri: str, worker) -> Path:
+    """Fetch + extract a kv:// URI into the session cache; idempotent."""
+    digest = uri[len(_URI_PREFIX):]
+    cache = Path(worker.session.path) / "runtime_env" / digest
+    if cache.exists():
+        return cache
+    raw = worker.rpc("kv_get", key=f"runtime_env/{digest}").get("value")
+    if raw is None:
+        raise FileNotFoundError(f"runtime_env blob missing from KV: {uri}")
+    tmp = cache.with_name(cache.name + f".tmp{os.getpid()}")
+    tmp.mkdir(parents=True, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(raw)) as zf:
+        zf.extractall(tmp)
+    try:
+        tmp.rename(cache)  # atomic publish; losers clean up
+    except OSError:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return cache
+
+
+def apply(runtime_env: Optional[dict], worker) -> Dict[str, Any]:
+    """Apply working_dir/py_modules/env_vars; returns restore state."""
+    saved: Dict[str, Any] = {"env": {}, "cwd": None, "sys_path": []}
+    if not runtime_env:
+        return saved
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        saved["env"][k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    wd = runtime_env.get("working_dir")
+    if wd:
+        local = ensure_local(wd, worker)
+        saved["cwd"] = os.getcwd()
+        os.chdir(local)
+        sys.path.insert(0, str(local))
+        saved["sys_path"].append(str(local))
+    for m in (runtime_env.get("py_modules") or []):
+        local = ensure_local(m, worker)
+        sys.path.insert(0, str(local))
+        saved["sys_path"].append(str(local))
+    return saved
+
+
+def restore(saved: Dict[str, Any]) -> None:
+    for k, v in saved.get("env", {}).items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    if saved.get("cwd"):
+        try:
+            os.chdir(saved["cwd"])
+        except OSError:
+            pass
+    for p in saved.get("sys_path", []):
+        try:
+            sys.path.remove(p)
+        except ValueError:
+            pass

@@ -387,6 +387,9 @@ class Worker:
                max_retries: Optional[int] = None, retry_exceptions: bool = False,
                scheduling_strategy: Any = None, name: Optional[str] = None,
                runtime_env: Optional[dict] = None) -> List[ObjectRef]:
+        if runtime_env:
+            from ray_tpu._private import runtime_env as renv
+            runtime_env = renv.prepare(runtime_env, self)
         fn_id = self.export_callable(fn)
         fields, deps, borrows, transient = self._pack_args(args, kwargs)
         task_id = TaskID.new()
@@ -422,6 +425,9 @@ class Worker:
                      get_if_exists: bool = False,
                      scheduling_strategy: Any = None,
                      runtime_env: Optional[dict] = None) -> dict:
+        if runtime_env:
+            from ray_tpu._private import runtime_env as renv
+            runtime_env = renv.prepare(runtime_env, self)
         class_blob_id = self.export_callable(cls)
         fields, deps, borrows, transient = self._pack_args(args, kwargs)
         from ray_tpu._private.ids import ActorID
@@ -578,19 +584,12 @@ class Worker:
         return out
 
     def _apply_runtime_env(self, spec: dict):
-        env = (spec.get("runtime_env") or {}).get("env_vars") or {}
-        saved = {}
-        for k, v in env.items():
-            saved[k] = os.environ.get(k)
-            os.environ[k] = str(v)
-        return saved
+        from ray_tpu._private import runtime_env as renv
+        return renv.apply(spec.get("runtime_env"), self)
 
     def _restore_runtime_env(self, saved: dict) -> None:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+        from ray_tpu._private import runtime_env as renv
+        renv.restore(saved)
 
     def _execute_task(self, spec: dict) -> None:
         t0 = time.time()
@@ -629,6 +628,8 @@ class Worker:
         from ray_tpu._private.actor_server import ActorServer
         self._current_spec = spec
         try:
+            # actor-lifetime runtime env (never restored: process is dedicated)
+            self._apply_runtime_env(spec)
             cls = self.fetch_callable(spec["class_blob_id"])
             args, kwargs = self._unpack_args(spec)
             instance = cls(*args, **kwargs)

@@ -1,0 +1,148 @@
+"""StandardAutoscaler: reconcile resource demand against running nodes.
+
+Reference: ``python/ray/autoscaler/_private/autoscaler.py`` (SURVEY.md
+§2.3) — a periodic ``update()``: read unfulfilled demand from the control
+plane, bin-pack onto configured node types (resource_demand_scheduler),
+launch the difference through the NodeProvider, and reap nodes idle longer
+than ``idle_timeout_s`` (never below ``min_workers``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler import resource_demand_scheduler as rds
+from ray_tpu.autoscaler.node_provider import (
+    NODE_KIND_WORKER, NodeProvider, TAG_NODE_KIND, TAG_NODE_TYPE,
+)
+
+
+class AutoscalerConfig:
+    """Subset of the reference cluster YAML that matters here.
+
+    node_types: {name: {"resources": {...}, "min_workers": int,
+                        "max_workers": int}}
+    """
+
+    def __init__(self, node_types: Dict[str, dict],
+                 max_workers: int = 100, idle_timeout_s: float = 60.0):
+        self.node_types = node_types
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+
+
+class StandardAutoscaler:
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider):
+        self.config = config
+        self.provider = provider
+        self._idle_since: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    # -- inputs --------------------------------------------------------------
+    def _demand(self) -> List[Dict[str, float]]:
+        from ray_tpu._private import worker as worker_mod
+        resp = worker_mod.global_worker().rpc("resource_demand")
+        return list(resp["task_shapes"]) + list(resp["pg_bundles"])
+
+    def _node_utilization(self) -> Dict[str, bool]:
+        """node_id -> is_idle (all resources available == total)."""
+        from ray_tpu._private import worker as worker_mod
+        nodes = worker_mod.global_worker().rpc("list_nodes")["nodes"]
+        out = {}
+        for n in nodes:
+            if not n["alive"]:
+                continue
+            total = {k: v for k, v in n["resources_total"].items()
+                     if not k.startswith("node:")}
+            avail = n["resources_available"]
+            out[n["node_id"]] = all(
+                avail.get(k, 0.0) >= v for k, v in total.items())
+        return out
+
+    def _counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for nid in self.provider.non_terminated_nodes({}):
+            t = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
+            counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    # -- reconcile -----------------------------------------------------------
+    def update(self) -> Dict[str, Any]:
+        """One reconcile step; returns a report for logging/tests."""
+        with self._lock:
+            demand = self._demand()
+            counts = self._counts()
+            to_launch = rds.get_nodes_to_launch(
+                self.config.node_types, counts, demand,
+                max_total_nodes=self.config.max_workers)
+            launched = {}
+            for t, n in to_launch.items():
+                cfg = self.config.node_types[t]
+                ids = self.provider.create_node(
+                    {"resources": cfg["resources"]},
+                    {TAG_NODE_KIND: NODE_KIND_WORKER, TAG_NODE_TYPE: t}, n)
+                launched[t] = ids
+
+            terminated = self._scale_down(counts, launched)
+            infeasible = rds.infeasible_shapes(self.config.node_types, demand)
+            return {"demand": demand, "launched": launched,
+                    "terminated": terminated, "infeasible": infeasible}
+
+    def _scale_down(self, counts: Dict[str, int],
+                    launched: Dict[str, list]) -> List[str]:
+        now = time.monotonic()
+        idle = self._node_utilization()
+        just_launched = {nid for ids in launched.values() for nid in ids}
+        terminated = []
+        for nid in self.provider.non_terminated_nodes({}):
+            if nid in just_launched:
+                self._idle_since.pop(nid, None)
+                continue
+            if not idle.get(nid, False):
+                self._idle_since.pop(nid, None)
+                continue
+            since = self._idle_since.setdefault(nid, now)
+            if now - since < self.config.idle_timeout_s:
+                continue
+            t = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
+            cfg = self.config.node_types.get(t, {})
+            live = counts.get(t, 0) + len(launched.get(t, []))
+            if live - len([x for x in terminated
+                           if self.provider.node_tags(x).get(TAG_NODE_TYPE)
+                           == t]) <= cfg.get("min_workers", 0):
+                continue
+            self.provider.terminate_node(nid)
+            self._idle_since.pop(nid, None)
+            terminated.append(nid)
+        return terminated
+
+
+class AutoscalerLoop:
+    """Background thread calling update() periodically (the monitor)."""
+
+    def __init__(self, autoscaler: StandardAutoscaler,
+                 interval_s: float = 5.0):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.autoscaler.update()
+            except Exception:  # noqa: BLE001 - keep reconciling
+                import logging
+                logging.getLogger(__name__).exception("autoscaler update")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -28,18 +28,33 @@ class ActorPool:
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         """fn(actor, value) -> ObjectRef; queued if no actor is idle."""
         if self._idle:
-            actor = self._idle.pop()
-            ref = fn(actor, value)
-            self._future_to_actor[ref] = actor
-            self._index_to_future[self._next_task_index] = ref
-            self._next_task_index += 1
+            self._dispatch(fn, value, reraise=True)
         else:
             self._pending_submits.append((fn, value))
+
+    def _dispatch(self, fn: Callable, value: Any, *, reraise: bool) -> None:
+        actor = self._idle.pop()
+        try:
+            ref = fn(actor, value)
+        except BaseException:
+            # a raising submit fn must not leak the actor out of the pool —
+            # and when invoked from a drain inside get_next's finally,
+            # must not mask the result being returned
+            self._idle.append(actor)
+            if reraise:
+                raise
+            import logging
+            logging.getLogger(__name__).exception(
+                "ActorPool submit fn raised; dropping queued item")
+            return
+        self._future_to_actor[ref] = actor
+        self._index_to_future[self._next_task_index] = ref
+        self._next_task_index += 1
 
     def _maybe_drain(self) -> None:
         while self._pending_submits and self._idle:
             fn, value = self._pending_submits.pop(0)
-            self.submit(fn, value)
+            self._dispatch(fn, value, reraise=False)
 
     # -- retrieval -----------------------------------------------------------
     def has_next(self) -> bool:

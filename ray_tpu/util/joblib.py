@@ -56,22 +56,19 @@ class RayTpuBackend(ParallelBackendBase):
         return cpus if n_jobs is None or n_jobs < 0 else n_jobs
 
     def apply_async(self, func: Callable, callback: Callable | None = None):
-        @ray_tpu.remote
-        def _run_joblib_batch(f):
-            return f()
-
-        ref = _run_joblib_batch.remote(func)
-        future = _TaskFuture(ref, callback)
-        if callback is not None:
-            # joblib's sequential retrieval calls .get(); eager callback
-            # dispatch isn't required for correctness
-            pass
-        return future
+        # joblib's retrieval calls future.get(), which fires the callback —
+        # eager dispatch isn't required for correctness
+        return _TaskFuture(_run_joblib_batch.remote(func), callback)
 
     def abort_everything(self, ensure_ready: bool = True) -> None:
         if ensure_ready:
             self.configure(n_jobs=self.parallel.n_jobs,
                            parallel=self.parallel)
+
+
+@ray_tpu.remote
+def _run_joblib_batch(f):
+    return f()
 
 
 def register_ray_tpu() -> None:

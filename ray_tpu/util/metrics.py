@@ -187,6 +187,7 @@ def collect_cluster() -> Dict[str, dict]:
     for key in keys:
         wid = key.split("/", 1)[1]
         if wid not in live:
+            w.rpc("kv_del", key=key)  # reap dead publishers' snapshots
             continue
         raw = w.rpc("kv_get", key=key).get("value")
         if not raw:
@@ -198,7 +199,7 @@ def collect_cluster() -> Dict[str, dict]:
                                            "series": []})
             for s in m["series"]:
                 dst["series"].append(
-                    {"tags": {**s["tags"], "worker": wid[:12]},
+                    {"tags": {**s["tags"], "worker": wid},
                      "value": s["value"]})
     return merged
 

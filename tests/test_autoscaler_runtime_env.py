@@ -1,0 +1,180 @@
+"""Autoscaler (SURVEY.md §2.3) and runtime_env (working_dir/py_modules)."""
+
+import os
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalerConfig, FakeMultiNodeProvider, StandardAutoscaler,
+    get_nodes_to_launch, infeasible_shapes,
+)
+
+
+# ----------------------------------------------------- demand bin-packing
+
+def test_get_nodes_to_launch_packs_shapes():
+    types = {
+        "cpu4": {"resources": {"CPU": 4}, "min_workers": 0, "max_workers": 5},
+        "tpu8": {"resources": {"TPU": 8}, "min_workers": 0, "max_workers": 2},
+    }
+    # 6 single-CPU tasks fit on two cpu4 nodes; one TPU shape needs tpu8
+    demand = [{"CPU": 1}] * 6 + [{"TPU": 8}]
+    out = get_nodes_to_launch(types, {}, demand)
+    assert out == {"cpu4": 2, "tpu8": 1}
+
+    # consolidation: if the TPU node type also carries CPUs, small CPU
+    # shapes ride its spare capacity instead of forcing extra nodes
+    types["tpu8"]["resources"] = {"CPU": 8, "TPU": 8}
+    assert get_nodes_to_launch(types, {}, demand) == {"tpu8": 1}
+
+
+def test_get_nodes_to_launch_honors_min_max():
+    types = {"n": {"resources": {"CPU": 2}, "min_workers": 2,
+                   "max_workers": 3}}
+    out = get_nodes_to_launch(types, {}, [{"CPU": 2}] * 10)
+    assert out == {"n": 3}  # 2 for min + 1 more up to max
+    assert get_nodes_to_launch(types, {"n": 3}, [{"CPU": 2}] * 10) == {}
+
+
+def test_infeasible_shapes():
+    types = {"n": {"resources": {"CPU": 4}}}
+    assert infeasible_shapes(types, [{"CPU": 2}, {"GPU": 1}]) == [{"GPU": 1}]
+
+
+# ------------------------------------------------------ end-to-end scaling
+
+def test_autoscaler_scales_up_for_pending_tasks(ray_start_2_cpus):
+    """Pending TPU-shaped tasks drive the provider to add a TPU node, after
+    which they schedule and run."""
+    provider = FakeMultiNodeProvider()
+    config = AutoscalerConfig(node_types={
+        "tpu_host": {"resources": {"CPU": 4, "TPU": 4},
+                     "min_workers": 0, "max_workers": 2},
+    }, idle_timeout_s=9999)
+    scaler = StandardAutoscaler(config, provider)
+
+    @ray_tpu.remote(num_tpus=2, num_cpus=0)
+    def tpu_task():
+        return "ran"
+
+    refs = [tpu_task.remote() for _ in range(2)]
+    time.sleep(0.3)  # let them land in the pending queue
+    report = scaler.update()
+    assert report["launched"].get("tpu_host"), report
+    assert ray_tpu.get(refs, timeout=60) == ["ran", "ran"]
+    assert not report["infeasible"]
+
+
+def test_autoscaler_scales_down_idle_nodes(ray_start_2_cpus):
+    provider = FakeMultiNodeProvider()
+    config = AutoscalerConfig(node_types={
+        "w": {"resources": {"CPU": 2}, "min_workers": 1, "max_workers": 4},
+    }, idle_timeout_s=0.0)
+    scaler = StandardAutoscaler(config, provider)
+    r1 = scaler.update()  # min_workers=1 launch
+    assert sum(len(v) for v in r1["launched"].values()) == 1
+    provider.create_node({"resources": {"CPU": 2}},
+                         {"node-type": "w", "node-kind": "worker"}, 2)
+    assert len(provider.non_terminated_nodes({})) == 3
+    scaler.update()   # records idle
+    report = scaler.update()
+    # idle nodes reaped down to min_workers
+    deadline = time.time() + 5
+    while len(provider.non_terminated_nodes({})) > 1 and time.time() < deadline:
+        report = scaler.update()
+        time.sleep(0.05)
+    assert len(provider.non_terminated_nodes({})) == 1
+
+
+def test_autoscaler_reports_infeasible(ray_start_2_cpus):
+    provider = FakeMultiNodeProvider()
+    config = AutoscalerConfig(node_types={
+        "small": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 2},
+    })
+    scaler = StandardAutoscaler(config, provider)
+
+    @ray_tpu.remote(resources={"FPGA": 1})
+    def impossible():
+        return 1
+
+    ref = impossible.remote()
+    time.sleep(0.3)
+    report = scaler.update()
+    assert {"FPGA": 1.0, "CPU": 1.0} in report["infeasible"] or \
+        any("FPGA" in s for s in report["infeasible"])
+    del ref
+
+
+# ---------------------------------------------------------- runtime_env
+
+def test_runtime_env_validation(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        f.remote()
+
+
+def test_runtime_env_working_dir(ray_start_regular, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "data.txt").write_text("hello from working_dir")
+    (proj / "helper.py").write_text("VALUE = 41\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def read():
+        import helper  # importable: working_dir is on sys.path
+        with open("data.txt") as fh:  # cwd is the working_dir
+            return fh.read(), helper.VALUE + 1
+
+    text, val = ray_tpu.get(read.remote())
+    assert text == "hello from working_dir"
+    assert val == 42
+
+    # a task WITHOUT the env must not see the working_dir
+    @ray_tpu.remote
+    def other():
+        import os
+        return os.path.exists("data.txt")
+
+    assert ray_tpu.get(other.remote()) is False
+
+
+def test_runtime_env_py_modules(ray_start_regular, tmp_path):
+    mod = tmp_path / "mymod"
+    (mod / "pkg").mkdir(parents=True)
+    (mod / "pkg" / "__init__.py").write_text("NAME = 'pkg-from-env'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def use():
+        import pkg
+        return pkg.NAME
+
+    assert ray_tpu.get(use.remote()) == "pkg-from-env"
+
+
+def test_runtime_env_actor_working_dir(ray_start_regular, tmp_path):
+    proj = tmp_path / "aproj"
+    proj.mkdir()
+    (proj / "marker.txt").write_text("actor-env")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    class A:
+        def read(self):
+            with open("marker.txt") as fh:
+                return fh.read()
+
+    a = A.remote()
+    assert ray_tpu.get(a.read.remote()) == "actor-env"
+
+
+def test_runtime_env_env_vars_still_work(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_RE_VAR": "yes"}})
+    def f():
+        return os.environ.get("MY_RE_VAR")
+
+    assert ray_tpu.get(f.remote()) == "yes"

@@ -184,3 +184,29 @@ def test_actor_pool_get_next_timeout_keeps_state(ray_start_regular):
     with pytest.raises(TimeoutError):
         pool.get_next(timeout=0.05)
     assert pool.get_next(timeout=30) == 7
+
+
+def test_async_actor_exit_actor(ray_start_regular):
+    """exit_actor() from an ASYNC method must reply and kill the actor."""
+    from ray_tpu._private.actor_server import exit_actor
+
+    @ray_tpu.remote
+    class A:
+        async def stop(self):
+            exit_actor()
+
+        async def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    with pytest.raises(Exception):
+        ray_tpu.get(a.stop.remote(), timeout=30)
+
+
+def test_actor_pool_bad_submit_fn_keeps_actor(ray_start_regular):
+    pool = ActorPool([_Doubler.remote()])
+    with pytest.raises(AttributeError):
+        pool.submit(lambda a, v: a.nonexistent.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 4)
+    assert pool.get_next() == 8
