@@ -86,6 +86,19 @@ def init(address: Optional[str] = None, *,
                 head_res["TPU"] = float(tpus)
             _head = GcsServer(session, head_res)
             session.write_descriptor({"gcs": _head.rpc_path})
+        elif address.startswith("ray://"):
+            # remote-client mode through the TCP proxy (reference:
+            # ray.init("ray://host:10001") — Ray Client)
+            hostport = address[len("ray://"):]
+            host, _, port = hostport.partition(":")
+            rtlog.setup("client", None)
+            w = _worker_mod.Worker(None, role="driver",
+                                   proxy_addr=(host, int(port or 10001)))
+            w.namespace = namespace
+            _worker_mod.set_global_worker(w)
+            atexit.register(shutdown)
+            return {"session_dir": None, "node_id": w.node_id,
+                    "client": True}
         elif address == "auto":
             # attach to the latest session on this machine (reference:
             # ray.init(address="auto"))

@@ -137,9 +137,15 @@ class ActorServer:
         w = self.worker
         try:
             if err is None:
-                results = w._store_results(return_ids, value,
-                                           msg["num_returns"])
-                ok = True
+                try:
+                    results = w._store_results(return_ids, value,
+                                               msg["num_returns"])
+                    ok = True
+                except Exception as store_err:  # noqa: BLE001 - e.g.
+                    # unpicklable result: the caller must still get a reply
+                    err = store_err
+            if err is None:
+                pass
             elif isinstance(err, ActorExit):
                 err_res = {"loc": "error",
                            "data": serialize_to_bytes(exc.RayActorError(

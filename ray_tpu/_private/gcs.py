@@ -1360,6 +1360,26 @@ class GcsServer:
                             bundles.append(dict(b))
             return {"task_shapes": shapes, "pg_bundles": bundles}
 
+    def _h_fetch_object(self, msg: dict) -> dict:
+        """Object bytes through the control plane — the remote-client data
+        path (a client cannot mmap this machine's /dev/shm)."""
+        oid = msg["object_id"]
+        with self.lock:
+            meta = self.objects.get(oid)
+            if meta is None or meta.state != READY:
+                return {"data": None}
+            loc, data = meta.loc, meta.data
+        if loc == "inline":
+            return {"data": data}
+        if loc == "slab":
+            return {"data": self.slab.get(oid) if self.slab else None}
+        self.store.restore(oid)
+        try:
+            from ray_tpu._private.shm_store import _seg_path
+            return {"data": _seg_path(oid).read_bytes()}
+        except FileNotFoundError:
+            return {"data": None}
+
     def _h_store_stats(self, msg: dict) -> dict:
         return {"stats": self.store.stats()}
 

@@ -41,6 +41,16 @@ def connect(path: str) -> Connection:
     return Client(address=path, family="AF_UNIX", authkey=AUTHKEY)
 
 
+def make_tcp_listener(host: str, port: int) -> Listener:
+    """TCP listener for the client proxy (reference: Ray Client's gRPC
+    endpoint ray://host:10001)."""
+    return Listener(address=(host, port), family="AF_INET", authkey=AUTHKEY)
+
+
+def connect_tcp(host: str, port: int) -> Connection:
+    return Client(address=(host, port), family="AF_INET", authkey=AUTHKEY)
+
+
 class RpcChannel:
     """Synchronous request/response client over one Connection."""
 
@@ -76,11 +86,13 @@ class RpcChannel:
 
 
 class RpcPool:
-    """Thread-local RpcChannel factory to a fixed socket path."""
+    """Thread-local RpcChannel factory to a fixed socket path (or any
+    custom ``connect_fn`` — the client proxy tunnels through TCP)."""
 
-    def __init__(self, path: str, on_new=None):
+    def __init__(self, path: str, on_new=None, connect_fn=None):
         self._path = path
         self._on_new = on_new
+        self._connect_fn = connect_fn or (lambda: connect(self._path))
         self._tls = threading.local()
         self._all = []
         self._lock = threading.Lock()
@@ -88,7 +100,7 @@ class RpcPool:
     def channel(self) -> RpcChannel:
         ch = getattr(self._tls, "ch", None)
         if ch is None:
-            ch = RpcChannel(connect(self._path))
+            ch = RpcChannel(self._connect_fn())
             self._tls.ch = ch
             with self._lock:
                 self._all.append(ch)

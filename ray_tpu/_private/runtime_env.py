@@ -112,24 +112,32 @@ def ensure_local(uri: str, worker) -> Path:
 
 
 def apply(runtime_env: Optional[dict], worker) -> Dict[str, Any]:
-    """Apply working_dir/py_modules/env_vars; returns restore state."""
+    """Apply working_dir/py_modules/env_vars; returns restore state.
+
+    Exception-safe: a failure mid-application (missing KV blob, corrupt
+    zip) restores whatever was already applied before re-raising, so the
+    pooled worker process is left clean for the next task."""
     saved: Dict[str, Any] = {"env": {}, "cwd": None, "sys_path": []}
     if not runtime_env:
         return saved
-    for k, v in (runtime_env.get("env_vars") or {}).items():
-        saved["env"][k] = os.environ.get(k)
-        os.environ[k] = str(v)
-    wd = runtime_env.get("working_dir")
-    if wd:
-        local = ensure_local(wd, worker)
-        saved["cwd"] = os.getcwd()
-        os.chdir(local)
-        sys.path.insert(0, str(local))
-        saved["sys_path"].append(str(local))
-    for m in (runtime_env.get("py_modules") or []):
-        local = ensure_local(m, worker)
-        sys.path.insert(0, str(local))
-        saved["sys_path"].append(str(local))
+    try:
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            saved["env"][k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        wd = runtime_env.get("working_dir")
+        if wd:
+            local = ensure_local(wd, worker)
+            saved["cwd"] = os.getcwd()
+            os.chdir(local)
+            sys.path.insert(0, str(local))
+            saved["sys_path"].append(str(local))
+        for m in (runtime_env.get("py_modules") or []):
+            local = ensure_local(m, worker)
+            sys.path.insert(0, str(local))
+            saved["sys_path"].append(str(local))
+    except BaseException:
+        restore(saved)
+        raise
     return saved
 
 

@@ -98,13 +98,27 @@ class _TaskContext(threading.local):
 
 
 class Worker:
-    def __init__(self, session: Session, role: str, node_id: Optional[str] = None):
+    def __init__(self, session: Optional[Session], role: str,
+                 node_id: Optional[str] = None,
+                 proxy_addr: Optional[tuple] = None):
         self.session = session
         self.role = role
         self.worker_id = WorkerID.new()
         self.node_id = node_id
-        self.gcs_path = session.socket_path("gcs.sock")
-        self.pool = protocol.RpcPool(self.gcs_path, on_new=self._on_new_channel)
+        self.proxy_addr = proxy_addr
+        self.is_client = proxy_addr is not None
+        if self.is_client:
+            # remote-client mode (reference: Ray Client, SURVEY.md §2.3):
+            # every connection tunnels through the TCP proxy; no local
+            # data plane (see put/_materialize client branches)
+            self.gcs_path = "gcs"
+            self.pool = protocol.RpcPool(
+                self.gcs_path, on_new=self._on_new_channel,
+                connect_fn=lambda: self._tunnel("gcs"))
+        else:
+            self.gcs_path = session.socket_path("gcs.sock")
+            self.pool = protocol.RpcPool(self.gcs_path,
+                                         on_new=self._on_new_channel)
         self._put_seq = _counter()
         self._ret_seq = _counter()
         self._task_seq = _counter()
@@ -123,7 +137,7 @@ class Worker:
         self._stop = threading.Event()
         self._profile_events: List[dict] = []
         self._slab = None          # native slab store attachment (lazy)
-        self._slab_tried = False
+        self._slab_tried = self.is_client  # clients have no local data plane
         # registration happens on first channel creation
         info = self.pool.call("register_client", role=role,
                               client_id=self.worker_id, pid=os.getpid(),
@@ -142,6 +156,22 @@ class Worker:
 
     def rpc_oneway(self, kind: str, **fields: Any) -> None:
         self.pool.channel().send_oneway(kind, client_id=self.worker_id, **fields)
+
+    def _tunnel(self, target: str):
+        """Open a proxied connection to a cluster-local unix socket."""
+        conn = protocol.connect_tcp(*self.proxy_addr)
+        conn.send({"target": target})
+        resp = conn.recv()
+        if resp.get("error"):
+            conn.close()
+            raise ConnectionError(f"client proxy: {resp['error']}")
+        return conn
+
+    def open_conn(self, addr: str):
+        """Connect to a cluster socket directly or via the client proxy."""
+        if self.is_client:
+            return self._tunnel(addr)
+        return protocol.connect(addr)
 
     def _send_event(self, msg: dict) -> None:
         with self._task_conn_lock:
@@ -181,7 +211,8 @@ class Worker:
         wire, refs = serialize_to_bytes(value)
         contained = [str(r.id) for r in refs]
         slab = self.slab
-        tiny = len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes
+        tiny = len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes or \
+            self.is_client  # client data plane = control plane (proxied)
         if slab is not None and len(wire) <= GLOBAL_CONFIG.slab_object_max_bytes \
                 and slab.put(str(oid), wire):
             self.rpc("put_object", object_id=str(oid), loc="slab",
@@ -202,6 +233,11 @@ class Worker:
             raise err
         if meta["loc"] == "inline":
             return deserialize_from(memoryview(meta["data"]))
+        if self.is_client and meta["loc"] in ("slab", "shm", "spilled"):
+            data = self.rpc("fetch_object", object_id=oid).get("data")
+            if data is None:
+                raise FileNotFoundError(oid)  # lost → reconstruction retry
+            return deserialize_from(memoryview(data))
         if meta["loc"] == "slab":
             slab = self.slab
             data = slab.get(oid) if slab is not None else None
@@ -596,8 +632,11 @@ class Worker:
         self._current_spec = spec
         self.ctx.in_task = True
         self.ctx.task_id = spec["task_id"]
-        saved_env = self._apply_runtime_env(spec)
+        saved_env = {}
         try:
+            # inside the try: a bad runtime_env (missing KV blob, corrupt
+            # zip) must fail THIS task, not kill the pooled worker process
+            saved_env = self._apply_runtime_env(spec)
             fn = self.fetch_callable(spec["fn_id"])
             args, kwargs = self._unpack_args(spec)
             value = fn(*args, **kwargs)
@@ -681,7 +720,7 @@ class _ActorChannel:
                 raise exc.GetTimeoutError(
                     f"actor {self.actor_id} not ready after {timeout}s")
             time.sleep(0.05)
-        self._conn = protocol.connect(info["addr"])
+        self._conn = self.worker.open_conn(info["addr"])
         self._incarnation = info["incarnation"]
         threading.Thread(target=self._read_loop, args=(self._conn,),
                          name=f"actor-ch-{self.actor_id[:6]}", daemon=True).start()

@@ -96,6 +96,7 @@ class StandardAutoscaler:
         idle = self._node_utilization()
         just_launched = {nid for ids in launched.values() for nid in ids}
         terminated = []
+        terminated_per_type: Dict[str, int] = {}
         for nid in self.provider.non_terminated_nodes({}):
             if nid in just_launched:
                 self._idle_since.pop(nid, None)
@@ -106,16 +107,19 @@ class StandardAutoscaler:
             since = self._idle_since.setdefault(nid, now)
             if now - since < self.config.idle_timeout_s:
                 continue
+            # resolve the type BEFORE terminating (providers forget
+            # terminated nodes) and count kills per type so the
+            # min_workers floor holds within one update
             t = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
             cfg = self.config.node_types.get(t, {})
-            live = counts.get(t, 0) + len(launched.get(t, []))
-            if live - len([x for x in terminated
-                           if self.provider.node_tags(x).get(TAG_NODE_TYPE)
-                           == t]) <= cfg.get("min_workers", 0):
+            live = counts.get(t, 0) + len(launched.get(t, [])) \
+                - terminated_per_type.get(t, 0)
+            if live <= cfg.get("min_workers", 0):
                 continue
             self.provider.terminate_node(nid)
             self._idle_since.pop(nid, None)
             terminated.append(nid)
+            terminated_per_type[t] = terminated_per_type.get(t, 0) + 1
         return terminated
 
 

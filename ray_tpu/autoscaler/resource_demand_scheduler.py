@@ -39,18 +39,21 @@ def get_nodes_to_launch(
     """
     to_launch: Dict[str, int] = {}
     counts = dict(current_counts)
+    pools: List[Tuple[str, ResourceDict]] = []  # launched-but-unfilled nodes
 
-    # 1. honor min_workers
+    # 1. honor min_workers — these fresh nodes join the packing pools so
+    # step 2 places demand on them before launching extras
     for t, cfg in node_types.items():
         need = cfg.get("min_workers", 0) - counts.get(t, 0)
         if need > 0:
             to_launch[t] = to_launch.get(t, 0) + need
             counts[t] = counts.get(t, 0) + need
+            for _ in range(need):
+                pools.append((t, dict(cfg["resources"])))
 
     # 2. pack remaining demand onto (existing capacity is handled by the
     # caller passing only UNFULFILLED demand) hypothetical new nodes,
     # largest shapes first so big bundles don't fragment
-    pools: List[Tuple[str, ResourceDict]] = []  # launched-but-unfilled nodes
     for shape in sorted(demand, key=lambda s: -sum(s.values())):
         placed = False
         for _, avail in pools:

@@ -1,0 +1,101 @@
+"""Dashboard-lite: a JSON/Prometheus HTTP endpoint over cluster state.
+
+Reference: ``python/ray/dashboard/`` (SURVEY.md §2.3) — aiohttp server +
+React UI.  This build keeps the *API surface* (REST endpoints over live
+cluster state, Prometheus metrics, a minimal HTML index) without the
+TypeScript client; everything is stdlib ``http.server`` on a thread.
+
+Endpoints:
+  GET /                    — minimal HTML summary page
+  GET /api/cluster_summary — nodes/resources/tasks/actors/objects rollup
+  GET /api/nodes|actors|tasks|objects|workers|placement_groups
+  GET /api/timeline        — Chrome trace JSON
+  GET /metrics             — Prometheus exposition (cluster-merged)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj) -> None:
+        self._send(200, json.dumps(obj, indent=2, default=str).encode(),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        from ray_tpu.util import metrics, state
+        try:
+            if self.path == "/metrics":
+                text = metrics.prometheus_text(metrics.collect_cluster())
+                self._send(200, text.encode(), "text/plain; version=0.0.4")
+            elif self.path == "/api/cluster_summary":
+                self._json(state.cluster_summary())
+            elif self.path == "/api/nodes":
+                self._json(state.list_nodes())
+            elif self.path == "/api/actors":
+                self._json(state.list_actors())
+            elif self.path == "/api/tasks":
+                self._json(state.list_tasks())
+            elif self.path == "/api/objects":
+                self._json(state.list_objects())
+            elif self.path == "/api/workers":
+                self._json(state.list_workers())
+            elif self.path == "/api/placement_groups":
+                self._json(state.list_placement_groups())
+            elif self.path == "/api/timeline":
+                import ray_tpu
+                self._json(ray_tpu.timeline())
+            elif self.path == "/":
+                s = state.cluster_summary()
+                html = (
+                    "<html><head><title>ray_tpu dashboard</title></head>"
+                    "<body><h1>ray_tpu</h1>"
+                    f"<p>nodes: {s['nodes']}</p>"
+                    f"<p>resources: {s['resources_available']} / "
+                    f"{s['resources_total']}</p>"
+                    f"<p>tasks: {s['tasks']}</p>"
+                    f"<p>actors: {s['actors']}</p>"
+                    f"<p>objects: {s['objects']['count']} "
+                    f"({s['objects']['total_bytes']} bytes)</p>"
+                    "<p>API: /api/cluster_summary /api/nodes /api/actors "
+                    "/api/tasks /api/objects /api/timeline /metrics</p>"
+                    "</body></html>")
+                self._send(200, html.encode(), "text/html")
+            else:
+                self._send(404, b"not found", "text/plain")
+        except Exception as e:  # noqa: BLE001
+            self._send(500, str(e).encode(), "text/plain")
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 8265) -> ThreadingHTTPServer:
+    """Start the dashboard HTTP server (daemon thread); returns the server."""
+    global _server
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    threading.Thread(target=srv.serve_forever, name="dashboard",
+                     daemon=True).start()
+    _server = srv
+    return srv
+
+
+def stop_dashboard() -> None:
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

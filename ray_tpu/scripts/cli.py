@@ -27,10 +27,22 @@ def _connect(address: Optional[str]) -> None:
 
 
 # ------------------------------------------------------------------ commands
+def _start_aux_servers(args) -> None:
+    from ray_tpu._private import worker as worker_mod
+    if getattr(args, "dashboard_port", None) is not None:
+        from ray_tpu.dashboard import start_dashboard
+        start_dashboard(port=args.dashboard_port)
+    if getattr(args, "client_server_port", None) is not None:
+        from ray_tpu.util.client import ClientProxyServer
+        ClientProxyServer(worker_mod.global_worker().session,
+                          port=args.client_server_port)
+
+
 def cmd_start(args) -> int:
     import ray_tpu
     if args.block:
         ray_tpu.init(num_cpus=args.num_cpus or None)
+        _start_aux_servers(args)
         desc = ray_tpu._worker_mod.global_worker().session.path  # noqa: SLF001
         print(f"head started (session {desc}); Ctrl-C to stop")
         try:
@@ -48,6 +60,7 @@ def cmd_start(args) -> int:
         for fd in (0, 1, 2):
             os.dup2(devnull, fd)
         ray_tpu.init(num_cpus=args.num_cpus or None)
+        _start_aux_servers(args)
         w = ray_tpu._worker_mod.global_worker()  # noqa: SLF001
         desc = w.session.read_descriptor()
         desc.update({"role": "head", "head_pid": os.getpid()})
@@ -161,6 +174,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--num-cpus", type=int, default=0)
     sp.add_argument("--block", action="store_true",
                     help="stay in the foreground")
+    sp.add_argument("--dashboard-port", type=int, default=None,
+                    help="serve the dashboard REST API on this port")
+    sp.add_argument("--client-server-port", type=int, default=None,
+                    help="accept ray:// remote clients on this port")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the latest head node")

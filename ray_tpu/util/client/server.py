@@ -1,0 +1,89 @@
+"""Client proxy server: TCP ⇄ cluster-local unix sockets.
+
+See package docstring.  Wire format: the first message on a new TCP
+connection is ``{"target": "gcs" | "<unix socket path>"}``; afterwards the
+proxy pumps pickled messages both ways until either side disconnects.
+Actor targets are validated against the session socket dir so a client
+cannot use the proxy to reach arbitrary local sockets.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ray_tpu._private import protocol, rtlog
+
+logger = rtlog.get("client-proxy")
+
+
+class ClientProxyServer:
+    def __init__(self, session, host: str = "0.0.0.0", port: int = 10001):
+        self.session = session
+        self.host = host
+        self.port = port
+        self._listener = protocol.make_tcp_listener(host, port)
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="client-proxy", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _resolve_target(self, target: str) -> Optional[str]:
+        if target == "gcs":
+            return self.session.socket_path("gcs.sock")
+        # actor sockets live in the session socket dir; refuse anything else
+        path = str(target)
+        if path.startswith(str(self.session.socket_dir) + "/"):
+            return path
+        return None
+
+    def _serve(self, client_conn) -> None:
+        try:
+            hello = client_conn.recv()
+            path = self._resolve_target(hello.get("target", ""))
+            if path is None:
+                client_conn.send({"error": "invalid target"})
+                client_conn.close()
+                return
+            upstream = protocol.connect(path)
+            client_conn.send({"ok": True})
+        except (EOFError, OSError, FileNotFoundError) as e:
+            try:
+                client_conn.send({"error": str(e)})
+            except (OSError, ValueError):
+                pass
+            client_conn.close()
+            return
+
+        def pump(src, dst):
+            while True:
+                try:
+                    dst.send(src.recv())
+                except (EOFError, OSError, ValueError):
+                    break
+            for c in (src, dst):
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=pump, args=(client_conn, upstream),
+                             daemon=True)
+        t.start()
+        pump(upstream, client_conn)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass

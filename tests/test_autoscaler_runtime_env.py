@@ -178,3 +178,21 @@ def test_runtime_env_env_vars_still_work(ray_start_regular):
         return os.environ.get("MY_RE_VAR")
 
     assert ray_tpu.get(f.remote()) == "yes"
+
+
+def test_runtime_env_missing_blob_fails_task_not_worker(ray_start_regular):
+    """A broken runtime_env must error the task, not crash the worker."""
+    @ray_tpu.remote(max_retries=0,
+                    runtime_env={"working_dir": "kv://runtime_env/deadbeef"})
+    def f():
+        return 1
+
+    with pytest.raises(Exception):
+        ray_tpu.get(f.remote(), timeout=60)
+
+    # the pooled worker survives and runs the next task
+    @ray_tpu.remote
+    def g():
+        return "alive"
+
+    assert ray_tpu.get(g.remote(), timeout=60) == "alive"

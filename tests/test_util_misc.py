@@ -210,3 +210,21 @@ def test_actor_pool_bad_submit_fn_keeps_actor(ray_start_regular):
         pool.submit(lambda a, v: a.nonexistent.remote(v), 1)
     pool.submit(lambda a, v: a.double.remote(v), 4)
     assert pool.get_next() == 8
+
+
+def test_async_actor_unpicklable_result_errors(ray_start_regular):
+    """Unpicklable async results must reply with an error, not hang."""
+    @ray_tpu.remote
+    class A:
+        async def bad(self):
+            import threading
+            return threading.Lock()  # unpicklable even by cloudpickle
+
+        async def ok(self):
+            return 5
+
+    a = A.remote()
+    assert ray_tpu.get(a.ok.remote(), timeout=30) == 5
+    with pytest.raises(Exception):
+        ray_tpu.get(a.bad.remote(), timeout=30)
+    assert ray_tpu.get(a.ok.remote(), timeout=30) == 5

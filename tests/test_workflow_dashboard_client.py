@@ -1,0 +1,183 @@
+"""Workflows (durable DAGs), dashboard-lite REST, remote-client proxy
+(SURVEY.md §2.5 workflows, §2.3 dashboard + Ray Client)."""
+
+import json
+import multiprocessing as mp
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+# ---------------------------------------------------------------- workflows
+
+def test_workflow_dag_runs(ray_start_regular, tmp_path):
+    @workflow.step
+    def double(x):
+        return 2 * x
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    node = add.bind(double.bind(3), double.bind(4))
+    out = workflow.run(node, workflow_id="wf1", storage=str(tmp_path))
+    assert out == 14
+    st = workflow.get_status("wf1", storage=str(tmp_path))
+    assert st["status"] == "SUCCEEDED"
+    assert set(st["steps"]) == {"double_0", "double_1", "add_0"}
+    assert workflow.list_all(storage=str(tmp_path)) == [("wf1", "SUCCEEDED")]
+
+
+def test_workflow_resume_skips_completed(ray_start_regular, tmp_path):
+    marker = tmp_path / "exec_count"
+    marker.write_text("0")
+
+    @workflow.step
+    def flaky(x):
+        n = int(marker.read_text()) + 1
+        marker.write_text(str(n))
+        if x == "boom" and n < 3:
+            raise RuntimeError("transient")
+        return f"ok-{x}"
+
+    @workflow.step
+    def precious():
+        # executed exactly once across run+resume (checkpointed)
+        cnt = tmp_path / "precious_count"
+        c = int(cnt.read_text()) + 1 if cnt.exists() else 1
+        cnt.write_text(str(c))
+        return c
+
+    @workflow.step
+    def combine(a, b):
+        return (a, b)
+
+    node = combine.bind(precious.bind(),
+                        flaky.options(max_retries=0).bind("boom"))
+    with pytest.raises(Exception):
+        workflow.run(node, workflow_id="wf2", storage=str(tmp_path))
+    assert workflow.get_status("wf2", storage=str(tmp_path))["status"] == "FAILED"
+
+    # resume: precious loads from its checkpoint; flaky retried until ok
+    marker.write_text("2")
+    out = workflow.resume("wf2", node, storage=str(tmp_path))
+    assert out == (1, "ok-boom")
+    assert (tmp_path / "precious_count").read_text() == "1"
+    assert workflow.get_status("wf2", storage=str(tmp_path))["status"] == \
+        "SUCCEEDED"
+
+
+def test_workflow_rerun_returns_cached(ray_start_regular, tmp_path):
+    calls = tmp_path / "calls"
+    calls.write_text("0")
+
+    @workflow.step
+    def once():
+        calls.write_text(str(int(calls.read_text()) + 1))
+        return 99
+
+    node = once.bind()
+    assert workflow.run(node, workflow_id="wf3", storage=str(tmp_path)) == 99
+    assert workflow.run(node, workflow_id="wf3", storage=str(tmp_path)) == 99
+    assert calls.read_text() == "1"
+
+
+# ---------------------------------------------------------------- dashboard
+
+def test_dashboard_endpoints(ray_start_regular):
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    class Probe:
+        def ping(self):
+            return 1
+
+    p = Probe.remote()
+    ray_tpu.get(p.ping.remote())
+
+    srv = start_dashboard(port=0)  # ephemeral port
+    port = srv.server_address[1]
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.read()
+
+        summary = json.loads(fetch("/api/cluster_summary"))
+        assert summary["nodes"] == 1
+        actors = json.loads(fetch("/api/actors"))
+        assert any(a["class_name"] == "Probe" for a in actors)
+        assert b"ray_tpu" in fetch("/")
+        assert b"# TYPE" in fetch("/metrics") or fetch("/metrics") == b"\n"
+        assert json.loads(fetch("/api/nodes"))[0]["alive"]
+    finally:
+        stop_dashboard()
+
+
+# ------------------------------------------------------------ client proxy
+
+def _client_driver(port, q):
+    import ray_tpu as rt
+    try:
+        rt.init(address=f"ray://127.0.0.1:{port}")
+
+        @rt.remote
+        def double(x):
+            return 2 * x
+
+        @rt.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        import numpy as np
+        big = np.arange(300_000)          # forces fetch_object path
+        ref = rt.put(big)
+        got = rt.get(ref)
+        task_out = rt.get(double.remote(21))
+        c = Counter.remote()
+        rt.get(c.add.remote(5))
+        actor_out = rt.get(c.add.remote(7))
+        # a large TASK RESULT lands on the cluster's shm/slab; the client
+        # must fetch it through the proxy
+        @rt.remote
+        def make_big():
+            import numpy as np
+            return np.ones(200_000)
+        big_sum = float(rt.get(make_big.remote()).sum())
+        q.put(("ok", int(got.sum()), task_out, actor_out, big_sum))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        q.put(("err", traceback.format_exc(), None, None, None))
+
+
+def test_client_proxy_end_to_end(ray_start_regular):
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util.client import ClientProxyServer
+
+    session = worker_mod.global_worker().session
+    proxy = ClientProxyServer(session, host="127.0.0.1", port=0)
+    port = proxy._listener.address[1]
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_client_driver, args=(port, q))
+        p.start()
+        status, a, b, c, d = q.get(timeout=120)
+        p.join(timeout=30)
+        assert status == "ok", a
+        assert a == sum(range(300_000))
+        assert b == 42
+        assert c == 12
+        assert d == 200_000.0
+    finally:
+        proxy.stop()
