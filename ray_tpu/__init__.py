@@ -23,6 +23,7 @@ from ray_tpu._private import rtlog
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.session import Session
+from ray_tpu._private import protocol as _protocol
 from ray_tpu._private import worker as _worker_mod
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor
 from ray_tpu.remote_function import RemoteFunction
@@ -77,6 +78,7 @@ def init(address: Optional[str] = None, *,
 
         if address is None or address == "local":
             session = Session()
+            _protocol.set_authkey(session.auth_key())
             rtlog.setup("driver", session.log_dir)
             head_res = dict(resources or {})
             head_res["CPU"] = float(num_cpus if num_cpus is not None
@@ -91,6 +93,9 @@ def init(address: Optional[str] = None, *,
             # ray.init("ray://host:10001") — Ray Client)
             hostport = address[len("ray://"):]
             host, _, port = hostport.partition(":")
+            key_hex = os.environ.get("RTPU_AUTH_KEY")
+            if key_hex:
+                _protocol.set_authkey(bytes.fromhex(key_hex))
             rtlog.setup("client", None)
             w = _worker_mod.Worker(None, role="driver",
                                    proxy_addr=(host, int(port or 10001)))
@@ -116,11 +121,13 @@ def init(address: Optional[str] = None, *,
                 raise ConnectionError(
                     f"no running ray_tpu cluster (latest session "
                     f"{session.path} has no live head process)")
+            _protocol.set_authkey(session.auth_key())
             rtlog.setup("driver", session.log_dir)
         else:
             # attach to an existing session (same machine)
             root, name = os.path.split(address)
             session = Session(root=root, name=name)
+            _protocol.set_authkey(session.auth_key())
             rtlog.setup("driver", session.log_dir)
 
         w = _worker_mod.Worker(session, role="driver")

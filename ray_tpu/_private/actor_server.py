@@ -144,18 +144,14 @@ class ActorServer:
                 except Exception as store_err:  # noqa: BLE001 - e.g.
                     # unpicklable result: the caller must still get a reply
                     err = store_err
-            if err is None:
-                pass
-            elif isinstance(err, ActorExit):
-                err_res = {"loc": "error",
-                           "data": serialize_to_bytes(exc.RayActorError(
-                               self.actor_id, "actor exited"))[0]}
-                results = [err_res for _ in return_ids]
-                ok = False
-            else:
-                wrapped = exc.RayTaskError.from_exception(
-                    f"{self.spec.get('class_name', 'Actor')}."
-                    f"{msg['method']}", err)
+            if err is not None:
+                if isinstance(err, ActorExit):
+                    wrapped: BaseException = exc.RayActorError(
+                        self.actor_id, "actor exited")
+                else:
+                    wrapped = exc.RayTaskError.from_exception(
+                        f"{self.spec.get('class_name', 'Actor')}."
+                        f"{msg['method']}", err)
                 err_res = {"loc": "error",
                            "data": serialize_to_bytes(wrapped)[0]}
                 results = [err_res for _ in return_ids]

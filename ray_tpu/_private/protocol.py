@@ -24,7 +24,18 @@ import threading
 from multiprocessing.connection import Client, Connection, Listener
 from typing import Any, Dict
 
-AUTHKEY = b"ray_tpu"
+# Per-session HMAC secret for every connection handshake.  Set from
+# Session.auth_key() at process startup (init / worker_main); the fallback
+# constant only exists for processes created before a session is known and
+# is never accepted across the TCP proxy (the proxy process has the real
+# key set).  Remote clients supply the key via RTPU_AUTH_KEY.
+_AUTHKEY = b"ray_tpu"
+
+
+def set_authkey(key: bytes) -> None:
+    global _AUTHKEY
+    _AUTHKEY = key
+
 
 # request kinds are plain strings in msg["kind"]; responses echo msg["rid"].
 
@@ -34,21 +45,21 @@ def make_listener(path: str) -> Listener:
         os.unlink(path)
     except FileNotFoundError:
         pass
-    return Listener(address=path, family="AF_UNIX", authkey=AUTHKEY)
+    return Listener(address=path, family="AF_UNIX", authkey=_AUTHKEY)
 
 
 def connect(path: str) -> Connection:
-    return Client(address=path, family="AF_UNIX", authkey=AUTHKEY)
+    return Client(address=path, family="AF_UNIX", authkey=_AUTHKEY)
 
 
 def make_tcp_listener(host: str, port: int) -> Listener:
     """TCP listener for the client proxy (reference: Ray Client's gRPC
     endpoint ray://host:10001)."""
-    return Listener(address=(host, port), family="AF_INET", authkey=AUTHKEY)
+    return Listener(address=(host, port), family="AF_INET", authkey=_AUTHKEY)
 
 
 def connect_tcp(host: str, port: int) -> Connection:
-    return Client(address=(host, port), family="AF_INET", authkey=AUTHKEY)
+    return Client(address=(host, port), family="AF_INET", authkey=_AUTHKEY)
 
 
 class RpcChannel:

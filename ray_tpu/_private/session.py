@@ -56,6 +56,28 @@ class Session:
         # Unix socket paths are limited to ~107 bytes; keep names short.
         return str(self.socket_dir / name)
 
+    def auth_key(self) -> bytes:
+        """Per-session control-plane secret (HMAC key for every socket).
+
+        Created once by the first accessor (the head), mode 0600; every
+        process of the session reads it from the session dir.  Remote
+        clients must receive it out-of-band (RTPU_AUTH_KEY) — the
+        multiprocessing handshake then provides real authentication
+        instead of a publicly-known constant."""
+        p = self.path / "auth.key"
+        try:
+            return bytes.fromhex(p.read_text().strip())
+        except FileNotFoundError:
+            pass
+        key = os.urandom(32)
+        try:
+            fd = os.open(str(p), os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(key.hex())
+            return key
+        except FileExistsError:
+            return bytes.fromhex(p.read_text().strip())
+
     def slab_path(self) -> str:
         """Path of the session's native slab store segment (C++ small-object
         data plane; ray_tpu/native/src/slab_store.cc). Derived from the

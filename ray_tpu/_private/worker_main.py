@@ -48,6 +48,8 @@ def main() -> None:
     node_id = os.environ["RTPU_NODE_ID"]
     root, name = os.path.split(session_dir)
     session = Session(root=root, name=name)
+    from ray_tpu._private import protocol
+    protocol.set_authkey(session.auth_key())
     rtlog.setup("worker", session.log_dir)
 
     worker = Worker(session, role="worker", node_id=node_id)

@@ -85,15 +85,18 @@ class ReplayBuffer:
     """Uniform ring buffer over column arrays (reference:
     ``rllib/utils/replay_buffers``)."""
 
-    def __init__(self, capacity: int):
+    DEFAULT_KEYS = (OBS, ACTIONS, REWARDS, NEXT_OBS, TERMINATEDS)
+
+    def __init__(self, capacity: int, keys: Optional[tuple] = None):
         self.capacity = capacity
+        self.keys = tuple(keys) if keys else self.DEFAULT_KEYS
         self._cols: Dict[str, np.ndarray] = {}
         self._idx = 0
         self._size = 0
 
     def add_batch(self, batch: SampleBatch) -> None:
         n = batch.count
-        for k in (OBS, ACTIONS, REWARDS, NEXT_OBS, TERMINATEDS):
+        for k in self.keys:
             v = batch[k]
             if k not in self._cols:
                 self._cols[k] = np.zeros((self.capacity,) + v.shape[1:],

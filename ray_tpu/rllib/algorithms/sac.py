@@ -1,0 +1,252 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference: ``rllib/algorithms/sac/`` (SURVEY.md §2.5) — off-policy
+maximum-entropy RL: a squashed-Gaussian actor, twin Q critics with target
+networks (clipped double-Q), and automatic entropy-temperature tuning
+against a target entropy of ``-dim(A)``.  The learner is one jitted update
+(actor + critics + alpha in a single compiled step).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import models
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
+from ray_tpu.rllib.evaluation import synchronous_parallel_sample
+from ray_tpu.rllib.sample_batch import (
+    ACTION_DIST_INPUTS, ACTION_LOGP, ACTIONS, NEXT_OBS, OBS, REWARDS,
+    SampleBatch, TERMINATEDS, VF_PREDS)
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def _actor_apply(params, obs, num_layers):
+    out = models.q_net_apply(params, obs, num_layers)  # (B, 2*act_dim)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+
+def _sample_squashed(params, obs, key, num_layers):
+    """Reparameterized tanh-Gaussian sample + log-prob (with the tanh
+    Jacobian correction from the SAC paper)."""
+    mean, log_std = _actor_apply(params, obs, num_layers)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mean.shape)
+    pre = mean + std * eps
+    act = jnp.tanh(pre)
+    logp = (-0.5 * (eps ** 2 + 2 * log_std + math.log(2 * math.pi))).sum(-1)
+    logp = logp - jnp.log(1 - act ** 2 + 1e-6).sum(-1)
+    return act, logp
+
+
+class SACPolicy:
+    """Squashed-Gaussian actor for Box action spaces."""
+
+    def __init__(self, observation_space, action_space,
+                 config: Optional[dict] = None):
+        config = config or {}
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config
+        obs_dim = models.flat_obs_dim(observation_space)
+        self.act_dim = int(np.prod(action_space.shape))
+        self.low = np.asarray(action_space.low, np.float32)
+        self.high = np.asarray(action_space.high, np.float32)
+        hiddens = tuple(config.get("fcnet_hiddens", (256, 256)))
+        self._num_layers = len(hiddens) + 1
+        self.model_config = models.ModelConfig(
+            obs_dim=obs_dim, num_outputs=2 * self.act_dim, hiddens=hiddens)
+        seed = config.get("seed", 0)
+        self.params = models.init_q_net(jax.random.key(seed),
+                                        self.model_config)
+        self._key = jax.random.key(seed + 1)
+        n_layers = self._num_layers
+
+        @jax.jit
+        def _act(params, obs, key, deterministic):
+            mean, log_std = _actor_apply(params, obs, n_layers)
+            det = jnp.tanh(mean)
+            sto, _ = _sample_squashed(params, obs, key, n_layers)
+            return jnp.where(deterministic, det, sto)
+
+        self._act = _act
+
+    def _scale(self, a: np.ndarray) -> np.ndarray:
+        return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        self._key, sub = jax.random.split(self._key)
+        a = np.asarray(self._act(self.params,
+                                 jnp.asarray(obs, jnp.float32), sub,
+                                 not explore))
+        n = len(a)
+        extras = {VF_PREDS: np.zeros(n, np.float32),
+                  ACTION_LOGP: np.zeros(n, np.float32),
+                  ACTION_DIST_INPUTS: np.zeros((n, 2 * self.act_dim),
+                                               np.float32)}
+        # env sees the scaled action; the buffer stores the raw tanh output
+        return self._scale(a).astype(np.float32), {**extras, "raw_action": a}
+
+    def compute_single_action(self, obs, explore: bool = True):
+        a, extras = self.compute_actions(obs[None], explore)
+        return a[0], {k: v[0] for k, v in extras.items()}
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        # GAE bootstrap hook; unused by the SAC learner (replay-based)
+        return np.zeros(len(obs), np.float32)
+
+    def get_weights(self):
+        return {"params": jax.tree_util.tree_map(np.asarray, self.params)}
+
+    def set_weights(self, weights):
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights["params"])
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self._cfg.update({
+            "policy_class": SACPolicy,
+            "actor_lr": 3e-4, "critic_lr": 3e-4, "alpha_lr": 3e-4,
+            "gamma": 0.99, "tau": 0.005,
+            "buffer_size": 100_000, "learning_starts": 256,
+            "train_batch_size": 256, "num_sgd_per_step": 1,
+            "rollout_fragment_length": 1,
+            "fcnet_hiddens": (256, 256),
+        })
+
+
+class SAC(Algorithm):
+    _default_config_cls = SACConfig
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        policy = self.workers.local_worker.policy
+        obs_dim = policy.model_config.obs_dim
+        act_dim = policy.act_dim
+        hiddens = tuple(config["fcnet_hiddens"])
+        q_cfg = models.ModelConfig(obs_dim=obs_dim + act_dim, num_outputs=1,
+                                   hiddens=hiddens)
+        self._q_layers = len(hiddens) + 1
+        seed = config.get("seed") or 0
+        k1, k2 = jax.random.split(jax.random.key(seed + 100))
+        self.q1 = models.init_q_net(k1, q_cfg)
+        self.q2 = models.init_q_net(k2, q_cfg)
+        self.q1_t, self.q2_t = self.q1, self.q2
+        self.log_alpha = jnp.zeros(())
+        self.buffer = ReplayBuffer(
+            int(config["buffer_size"]),
+            keys=(OBS, "raw_action", REWARDS, NEXT_OBS, TERMINATEDS))
+        self._rng = np.random.default_rng(seed)
+        self._learn_key = jax.random.key(seed + 7)
+
+        actor_opt = optax.adam(config["actor_lr"])
+        critic_opt = optax.adam(config["critic_lr"])
+        alpha_opt = optax.adam(config["alpha_lr"])
+        self._actor_state = actor_opt.init(policy.params)
+        self._critic_state = critic_opt.init((self.q1, self.q2))
+        self._alpha_state = alpha_opt.init(self.log_alpha)
+
+        gamma = float(config["gamma"])
+        tau = float(config["tau"])
+        target_entropy = -float(act_dim)
+        a_layers = policy._num_layers
+        q_layers = self._q_layers
+
+        def q_apply(qp, obs, act):
+            return models.q_net_apply(
+                qp, jnp.concatenate([obs, act], -1), q_layers)[:, 0]
+
+        def update(actor_p, q1, q2, q1_t, q2_t, log_alpha,
+                   actor_s, critic_s, alpha_s, mb, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # critics: clipped double-Q against the entropy-regularized
+            # bootstrap target
+            next_a, next_logp = _sample_squashed(actor_p, mb[NEXT_OBS], k1,
+                                                 a_layers)
+            q_next = jnp.minimum(q_apply(q1_t, mb[NEXT_OBS], next_a),
+                                 q_apply(q2_t, mb[NEXT_OBS], next_a))
+            target = mb[REWARDS] + gamma * (1 - mb["dones"]) * \
+                jax.lax.stop_gradient(q_next - alpha * next_logp)
+
+            def critic_loss(qs):
+                q1_, q2_ = qs
+                l1 = jnp.square(q_apply(q1_, mb[OBS], mb["raw_action"])
+                                - target).mean()
+                l2 = jnp.square(q_apply(q2_, mb[OBS], mb["raw_action"])
+                                - target).mean()
+                return l1 + l2
+
+            c_grads = jax.grad(critic_loss)((q1, q2))
+            c_updates, critic_s = critic_opt.update(c_grads, critic_s,
+                                                    (q1, q2))
+            q1, q2 = optax.apply_updates((q1, q2), c_updates)
+
+            # actor: maximize E[min Q - alpha * logp]
+            def actor_loss(ap):
+                a, logp = _sample_squashed(ap, mb[OBS], k2, a_layers)
+                q = jnp.minimum(q_apply(q1, mb[OBS], a),
+                                q_apply(q2, mb[OBS], a))
+                return (alpha * logp - q).mean(), logp
+
+            a_grads, logp = jax.grad(actor_loss, has_aux=True)(actor_p)
+            a_updates, actor_s = actor_opt.update(a_grads, actor_s, actor_p)
+            actor_p = optax.apply_updates(actor_p, a_updates)
+
+            # temperature: drive entropy toward the target
+            def alpha_loss(la):
+                return (-jnp.exp(la) *
+                        jax.lax.stop_gradient(logp + target_entropy)).mean()
+
+            al_grad = jax.grad(alpha_loss)(log_alpha)
+            al_update, alpha_s = alpha_opt.update(al_grad, alpha_s, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, al_update)
+
+            # polyak target sync
+            q1_t = jax.tree_util.tree_map(
+                lambda t, s: (1 - tau) * t + tau * s, q1_t, q1)
+            q2_t = jax.tree_util.tree_map(
+                lambda t, s: (1 - tau) * t + tau * s, q2_t, q2)
+            metrics = {"alpha": jnp.exp(log_alpha),
+                       "entropy": -logp.mean()}
+            return (actor_p, q1, q2, q1_t, q2_t, log_alpha,
+                    actor_s, critic_s, alpha_s, metrics)
+
+        self._update = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        policy = self.workers.local_worker.policy
+        batch = synchronous_parallel_sample(self.workers)
+        self.buffer.add_batch(batch)
+        info: Dict[str, Any] = {"buffer_size": len(self.buffer)}
+        if len(self.buffer) < int(self.config["learning_starts"]):
+            return info
+        for _ in range(int(self.config["num_sgd_per_step"])):
+            mb = self.buffer.sample(int(self.config["train_batch_size"]),
+                                    self._rng)
+            device_mb = {
+                OBS: jnp.asarray(mb[OBS]),
+                "raw_action": jnp.asarray(mb["raw_action"]),
+                REWARDS: jnp.asarray(mb[REWARDS]),
+                NEXT_OBS: jnp.asarray(mb[NEXT_OBS]),
+                "dones": jnp.asarray(mb[TERMINATEDS].astype(np.float32)),
+            }
+            self._learn_key, sub = jax.random.split(self._learn_key)
+            (policy.params, self.q1, self.q2, self.q1_t, self.q2_t,
+             self.log_alpha, self._actor_state, self._critic_state,
+             self._alpha_state, metrics) = self._update(
+                policy.params, self.q1, self.q2, self.q1_t, self.q2_t,
+                self.log_alpha, self._actor_state, self._critic_state,
+                self._alpha_state, device_mb, sub)
+            info.update({k: float(v) for k, v in metrics.items()})
+        self.workers.sync_weights()
+        return info

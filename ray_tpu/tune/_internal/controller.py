@@ -70,6 +70,7 @@ def _trial_task(run_id: str, fn_blob: bytes, config: Dict[str, Any],
 class TuneController:
     def __init__(self, trainable: Any, trials: List[Trial], *,
                  scheduler: Optional[TrialScheduler] = None,
+                 searcher: Any = None,
                  metric: Optional[str] = None, mode: str = "max",
                  stop: Optional[Dict[str, Any]] = None,
                  max_concurrent: int = 4, storage_root: str = "",
@@ -77,6 +78,7 @@ class TuneController:
         import cloudpickle
         self.fn_blob = cloudpickle.dumps(trainable)
         self.trials = trials
+        self.searcher = searcher
         self.scheduler = scheduler or FIFOScheduler()
         self.scheduler.set_metric(metric, mode)
         self.metric = metric
@@ -145,6 +147,8 @@ class TuneController:
 
     # ---------------------------------------------------------------- loop
     def _launch(self, trial: Trial) -> None:
+        if trial.config is None and self.searcher is not None:
+            trial.config = self.searcher.suggest(trial.id)
         storage = os.path.join(self.exp_dir, trial.id)
         # Clones/restores continue the iteration numbering (stop criteria
         # stay run-global).  When resuming from a checkpoint older than the
@@ -228,6 +232,9 @@ class TuneController:
                     trial.error = e
                 self.scheduler.on_trial_complete(self, trial,
                                                  trial.last_result)
+                if self.searcher is not None:
+                    self.searcher.on_trial_complete(trial.id,
+                                                    trial.last_result)
                 # reclaim this launch's control/report keys
                 internal_kv._internal_kv_del(f"{trial.run_id}/ctl/stop",
                                              namespace=NAMESPACE)

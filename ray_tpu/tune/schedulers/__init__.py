@@ -12,3 +12,6 @@ from ray_tpu.tune.schedulers.median_stopping import (  # noqa: F401
 from ray_tpu.tune.schedulers.pbt import (  # noqa: F401
     PopulationBasedTraining,
 )
+from ray_tpu.tune.schedulers.hyperband import (  # noqa: F401
+    HyperBandScheduler,
+)

@@ -5,3 +5,5 @@ from ray_tpu.tune.search.sample import (  # noqa: F401
     qrandint, quniform, randint, randn, sample_from, uniform,
 )
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator  # noqa: F401
+from ray_tpu.tune.search.searcher import Searcher  # noqa: F401
+from ray_tpu.tune.search.tpe import TPESearcher  # noqa: F401

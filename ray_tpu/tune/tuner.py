@@ -53,13 +53,25 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
-        gen = tc.search_alg or BasicVariantGenerator(seed=tc.seed)
-        configs = gen.generate(self.param_space, tc.num_samples)
         exp_name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
-        trials = [Trial(f"{exp_name}_{i:05d}", cfg)
-                  for i, cfg in enumerate(configs)]
+        from ray_tpu.tune.search.searcher import Searcher
+        searcher = None
+        if isinstance(tc.search_alg, Searcher):
+            # adaptive search: configs proposed lazily at launch time so
+            # later trials exploit earlier results
+            searcher = tc.search_alg
+            searcher.set_search_properties(tc.metric, tc.mode,
+                                           self.param_space)
+            trials = [Trial(f"{exp_name}_{i:05d}", None)
+                      for i in range(tc.num_samples)]
+        else:
+            gen = tc.search_alg or BasicVariantGenerator(seed=tc.seed)
+            configs = gen.generate(self.param_space, tc.num_samples)
+            trials = [Trial(f"{exp_name}_{i:05d}", cfg)
+                      for i, cfg in enumerate(configs)]
         controller = TuneController(
             self.trainable, trials, scheduler=tc.scheduler,
+            searcher=searcher,
             metric=tc.metric, mode=tc.mode,
             stop=self.run_config.stop or {},
             max_concurrent=tc.max_concurrent_trials,

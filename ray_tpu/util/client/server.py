@@ -18,7 +18,12 @@ logger = rtlog.get("client-proxy")
 
 
 class ClientProxyServer:
-    def __init__(self, session, host: str = "0.0.0.0", port: int = 10001):
+    """Binds loopback by default; exposing it beyond localhost requires an
+    explicit host AND sharing the session auth key (RTPU_AUTH_KEY on the
+    client) — the connection handshake HMACs against the per-session
+    secret, never the module default."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 10001):
         self.session = session
         self.host = host
         self.port = port
@@ -38,11 +43,14 @@ class ClientProxyServer:
                              daemon=True).start()
 
     def _resolve_target(self, target: str) -> Optional[str]:
+        import os
         if target == "gcs":
             return self.session.socket_path("gcs.sock")
-        # actor sockets live in the session socket dir; refuse anything else
-        path = str(target)
-        if path.startswith(str(self.session.socket_dir) + "/"):
+        # actor sockets live in the session socket dir; refuse anything
+        # else — realpath first so ../ traversal cannot escape it
+        path = os.path.realpath(str(target))
+        sock_dir = os.path.realpath(str(self.session.socket_dir))
+        if os.path.dirname(path) == sock_dir:
             return path
         return None
 

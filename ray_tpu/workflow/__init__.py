@@ -9,7 +9,7 @@ storage and re-executes the rest.  API:
 
     node = combine.bind(fetch.bind(1), fetch.bind(2))
     workflow.run(node, workflow_id="demo", storage="/path")
-    workflow.resume("demo", storage="/path")     # after a crash
+    workflow.resume("demo", node, storage="/path")   # after a crash
 
 Each step runs as one cluster task; results are pickled per-step under
 ``<storage>/<workflow_id>/<step>.pkl`` with a ``status.json`` index, so a
@@ -152,32 +152,57 @@ def run(node: WorkflowStepNode, *, workflow_id: Optional[str] = None,
     status["root"] = keys[id(node)]
     store.write_status(status)
 
+    # Independent branches run CONCURRENTLY: every step whose deps are
+    # resolved is submitted; results are checkpointed as they complete.
     results: Dict[int, Any] = {}
     for n in order:
         key = keys[id(n)]
         if store.has_result(key):
             results[id(n)] = store.load_result(key)
             status["steps"][key] = "SUCCEEDED"
-            continue
 
-        def resolve(v):
-            return results[id(v)] if isinstance(v, WorkflowStepNode) else v
+    def deps(n):
+        return [a for a in list(n.args) + list(n.kwargs.values())
+                if isinstance(a, WorkflowStepNode)]
 
-        args = tuple(resolve(a) for a in n.args)
-        kwargs = {k: resolve(v) for k, v in n.kwargs.items()}
-        task = ray_tpu.remote(max_retries=n.max_retries)(n.fn)
+    remaining = [n for n in order if id(n) not in results]
+    in_flight: Dict[Any, WorkflowStepNode] = {}  # ref -> node
+    failure: Optional[BaseException] = None
+    while remaining or in_flight:
+        launched = []
+        for n in remaining:
+            if failure is not None:
+                break
+            if all(id(d) in results for d in deps(n)):
+                def resolve(v):
+                    return results[id(v)] \
+                        if isinstance(v, WorkflowStepNode) else v
+                args = tuple(resolve(a) for a in n.args)
+                kwargs = {k: resolve(v) for k, v in n.kwargs.items()}
+                task = ray_tpu.remote(max_retries=n.max_retries)(n.fn)
+                in_flight[task.remote(*args, **kwargs)] = n
+                launched.append(n)
+        remaining = [n for n in remaining if n not in launched]
+        if not in_flight:
+            break
+        done, _ = ray_tpu.wait(list(in_flight), num_returns=1)
+        n = in_flight.pop(done[0])
+        key = keys[id(n)]
         try:
-            value = ray_tpu.get(task.remote(*args, **kwargs))
-        except Exception:
+            value = ray_tpu.get(done[0])
+        except Exception as e:  # noqa: BLE001
             status["steps"][key] = "FAILED"
-            status["status"] = "FAILED"
-            store.write_status(status)
-            raise
+            failure = e
+            continue  # drain remaining in-flight steps (checkpoint them)
         store.save_result(key, value)
         status["steps"][key] = "SUCCEEDED"
         store.write_status(status)
         results[id(n)] = value
 
+    if failure is not None:
+        status["status"] = "FAILED"
+        store.write_status(status)
+        raise failure
     status["status"] = "SUCCEEDED"
     store.write_status(status)
     return results[id(node)]

@@ -1,0 +1,151 @@
+"""TPE searcher, HyperBand scheduler, SAC, ES
+(SURVEY.md §2.5 tune searchers / RLlib algorithm families)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import HyperBandScheduler
+from ray_tpu.tune.search import TPESearcher, uniform
+
+
+# -------------------------------------------------------------------- TPE
+
+def test_tpe_outperforms_random_on_quadratic():
+    """On min (x-0.7)^2, TPE's later proposals concentrate near 0.7."""
+    searcher = TPESearcher(metric="loss", mode="min", n_initial_points=8,
+                          seed=0)
+    searcher.set_search_properties("loss", "min", {"x": uniform(0.0, 1.0)})
+    xs = []
+    for i in range(60):
+        cfg = searcher.suggest(f"t{i}")
+        xs.append(cfg["x"])
+        searcher.on_trial_complete(f"t{i}", {"loss": (cfg["x"] - 0.7) ** 2})
+    late = np.asarray(xs[40:])
+    assert abs(late.mean() - 0.7) < 0.15, late.mean()
+    # adaptive phase concentrates relative to the uniform phase
+    assert late.std() < np.asarray(xs[:8]).std()
+
+
+def test_tpe_categorical_and_log():
+    from ray_tpu.tune.search import choice, loguniform
+    searcher = TPESearcher(metric="score", mode="max", n_initial_points=6,
+                          seed=1)
+    searcher.set_search_properties("score", "max", {
+        "algo": choice(["good", "bad"]),
+        "lr": loguniform(1e-5, 1e-1),
+    })
+    picks = []
+    for i in range(50):
+        cfg = searcher.suggest(f"t{i}")
+        picks.append(cfg["algo"])
+        score = (1.0 if cfg["algo"] == "good" else 0.0) - \
+            abs(np.log10(cfg["lr"]) + 3)
+        searcher.on_trial_complete(f"t{i}", {"score": score})
+    assert picks[20:].count("good") > picks[20:].count("bad")
+
+
+def test_tpe_with_tuner(ray_start_regular, tmp_path):
+    def objective(config):
+        tune.report({"loss": (config["x"] - 0.5) ** 2})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": uniform(0, 1)},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    num_samples=12, max_concurrent_trials=2,
+                                    search_alg=TPESearcher(n_initial_points=4,
+                                                           seed=0)),
+        run_config=ray_tpu.train.RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="loss", mode="min")
+    assert best.metrics["loss"] < 0.1
+
+
+# -------------------------------------------------------------- HyperBand
+
+def test_hyperband_stops_bad_trials(ray_start_regular, tmp_path):
+    def trainable(config):
+        for i in range(20):
+            tune.report({"score": config["quality"] * (i + 1)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([0.1, 0.2, 1.0, 2.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=4,
+            scheduler=HyperBandScheduler(max_t=16, reduction_factor=2)),
+        run_config=ray_tpu.train.RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    results = {r.metrics["config"]["quality"]:
+               r.metrics.get("training_iteration", 0) for r in grid}
+    # the best trial runs longest; the worst is culled earlier
+    assert results[2.0] >= results[0.1]
+    best = grid.get_best_result(metric="score", mode="max")
+    assert best.metrics["config"]["quality"] == 2.0
+
+
+def test_hyperband_brackets_structure():
+    hb = HyperBandScheduler(max_t=81, reduction_factor=3)
+    assert len(hb.brackets) == 5  # s = 4..0
+    # most aggressive bracket halves from r0=1; the laziest (s=0) runs the
+    # full budget with no halving (classic HyperBand's random-search arm)
+    assert hb.brackets[0].milestones == [1, 3, 9, 27]
+    assert hb.brackets[-2].milestones == [27]
+    assert hb.brackets[-1].milestones == []
+
+
+# -------------------------------------------------------------------- SAC
+
+def test_sac_learns_on_pendulum(ray_start_regular):
+    pytest.importorskip("gymnasium")
+    from ray_tpu.rllib.algorithms import SACConfig
+
+    algo = (SACConfig()
+            .environment("Pendulum-v1")
+            .rollouts(num_rollout_workers=0, rollout_fragment_length=64)
+            .training(learning_starts=128, train_batch_size=64,
+                      num_sgd_per_step=4, fcnet_hiddens=(64, 64))
+            .debugging(seed=0)
+            .build())
+    seen = []
+    for i in range(20):
+        result = algo.train()
+        r = result.get("episode_reward_mean")
+        if r is not None and np.isfinite(r):
+            seen.append(r)
+    # episodes completed, rewards finite, entropy temperature alive
+    assert seen, "no episodes completed in 20 iterations"
+    assert float(result["info"]["alpha"]) > 0
+    assert np.isfinite(float(result["info"]["entropy"]))
+
+
+def test_sac_action_bounds(ray_start_regular):
+    pytest.importorskip("gymnasium")
+    from ray_tpu.rllib.algorithms import SACConfig
+
+    algo = (SACConfig().environment("Pendulum-v1")
+            .rollouts(num_rollout_workers=0).build())
+    pol = algo.workers.local_worker.policy
+    obs = np.random.randn(16, 3).astype(np.float32)
+    acts, extras = pol.compute_actions(obs)
+    assert acts.shape == (16, 1)
+    assert (acts >= pol.low - 1e-5).all() and (acts <= pol.high + 1e-5).all()
+    assert "raw_action" in extras
+
+
+# --------------------------------------------------------------------- ES
+
+def test_es_improves_cartpole(ray_start_regular):
+    pytest.importorskip("gymnasium")
+    from ray_tpu.rllib.algorithms import ESConfig
+
+    algo = (ESConfig().environment("CartPole-v1")
+            .training(episodes_per_batch=8, noise_std=0.5, step_size=0.2,
+                      fcnet_hiddens=(16,))
+            .debugging(seed=3)
+            .build())
+    rewards = [algo.train()["episode_reward_mean"] for _ in range(12)]
+    # derivative-free optimization is noisy; require clear improvement
+    assert max(rewards[4:]) > rewards[0] + 10, rewards

@@ -121,7 +121,10 @@ def test_dashboard_endpoints(ray_start_regular):
 
 # ------------------------------------------------------------ client proxy
 
-def _client_driver(port, q):
+def _client_driver(port, key_hex, q):
+    import os
+    os.environ["RTPU_AUTH_KEY"] = key_hex  # shared out-of-band, like the
+    # reference's client auth token
     import ray_tpu as rt
     try:
         rt.init(address=f"ray://127.0.0.1:{port}")
@@ -170,7 +173,8 @@ def test_client_proxy_end_to_end(ray_start_regular):
     try:
         ctx = mp.get_context("spawn")
         q = ctx.Queue()
-        p = ctx.Process(target=_client_driver, args=(port, q))
+        p = ctx.Process(target=_client_driver,
+                        args=(port, session.auth_key().hex(), q))
         p.start()
         status, a, b, c, d = q.get(timeout=120)
         p.join(timeout=30)
