@@ -43,6 +43,11 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16          # activation dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # full: recompute everything in bwd (min HBM).  dots: save matmul
+    # outputs without batch dims (MLP/projections) and recompute only
+    # attention — the standard transformer trade (big step-time win when
+    # HBM allows).  Ignored when remat=False.
+    remat_policy: str = "full"  # full | dots
     attn_impl: str = "dense"   # dense | flash | blockwise | ring | ulysses
     context_axis: Optional[str] = None  # mesh axis for SP/CP ("context")
     pipeline_axis: Optional[str] = None  # mesh axis for PP ("pipeline")
@@ -200,7 +205,16 @@ def forward(params: Params, tokens: jax.Array,
 
     block = partial(_block, cfg=cfg, attn=attn)
     if cfg.remat:
-        block = jax.checkpoint(block)
+        if cfg.remat_policy == "dots":
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy == "full":
+            block = jax.checkpoint(block)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} "
+                f"(expected 'full' or 'dots')")
 
     def scan_body(carry, lp):
         return block(carry, lp), None

@@ -34,15 +34,18 @@ DEFAULT_BLOCK = 128
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
                   seq_len: int, causal: bool, scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
+    # Keep q/k/v in their storage dtype (bf16) for the MXU — f32 inputs
+    # would quarter matmul throughput; accumulation stays f32 via
+    # preferred_element_type.  The scale folds into f32 scores.
+    q = q_ref[0]                                      # (block_q, D) bf16
     D = q.shape[-1]
 
     def body(j, carry):
         acc, m, l = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -54,7 +57,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
         corr = jnp.exp(m - m_new)
         l = l * corr + p.sum(axis=-1)
         acc = acc * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l
 

@@ -42,6 +42,10 @@ class AlgorithmConfig:
         return self
 
     def rollouts(self, **kw):
+        # reference spells it both ways across versions; WorkerSet reads
+        # "num_workers", so alias the newer name onto it
+        if "num_rollout_workers" in kw:
+            kw["num_workers"] = kw.pop("num_rollout_workers")
         self._cfg.update(kw)
         return self
 

@@ -35,6 +35,8 @@ def _start_aux_servers(args) -> None:
     if getattr(args, "client_server_port", None) is not None:
         from ray_tpu.util.client import ClientProxyServer
         ClientProxyServer(worker_mod.global_worker().session,
+                          host=getattr(args, "client_server_host", None)
+                          or "127.0.0.1",
                           port=args.client_server_port)
 
 
@@ -178,6 +180,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="serve the dashboard REST API on this port")
     sp.add_argument("--client-server-port", type=int, default=None,
                     help="accept ray:// remote clients on this port")
+    sp.add_argument("--client-server-host", default=None,
+                    help="bind address for the client server (default "
+                         "loopback; 0.0.0.0 requires sharing the session "
+                         "auth key with clients via RTPU_AUTH_KEY)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the latest head node")

@@ -15,6 +15,7 @@ class Searcher:
     def __init__(self, metric: Optional[str] = None,
                  mode: Optional[str] = None):
         self.metric = metric
+        self._mode_explicit = mode is not None
         self.mode = mode or "max"
         self._space: Optional[Dict[str, Any]] = None
 
@@ -22,7 +23,9 @@ class Searcher:
                               space: Dict[str, Any]) -> None:
         if self.metric is None:
             self.metric = metric
-        if mode:
+        # an explicitly-constructed mode wins over TuneConfig's default
+        # ("max") — overwriting would silently invert the optimization
+        if mode and not self._mode_explicit:
             self.mode = mode
         self._space = space
 
