@@ -1,0 +1,62 @@
+"""Baseline #6: allreduce bus bandwidth vs message size (SURVEY.md §6).
+
+Reference analog: NCCL bus-bandwidth sweeps at the `ray.util.collective`
+API level.  Here the op is compiled XLA over the device group; on one chip
+the numbers measure the compiled-collective dispatch floor, on a multi-chip
+slice they measure ICI.  Bus BW uses the standard NCCL convention:
+``2 * (n-1)/n * bytes / time``.
+
+Usage: python benchmarks/collective_bench.py [--devices N]
+Prints one JSON line per message size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import numpy as np
+
+from ray_tpu.util.collective.collective_group.xla_group import \
+    XlaCollectiveGroup
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0,
+                    help="0 = all local devices")
+    ap.add_argument("--sizes", default="1KB,64KB,1MB,16MB,128MB")
+    args = ap.parse_args()
+
+    devs = jax.devices()
+    n = args.devices or len(devs)
+    group = XlaCollectiveGroup(devs[:n])
+    sizes = {"1KB": 1 << 10, "64KB": 1 << 16, "1MB": 1 << 20,
+             "16MB": 1 << 24, "128MB": 1 << 27}
+
+    for name in args.sizes.split(","):
+        nbytes = sizes[name.strip()]
+        elems = nbytes // 4
+        # pre-place on the device group: the benchmark measures the
+        # collective, not host→device upload of the input
+        x = group._stack(np.ones((n, elems), np.float32))
+        out = group.allreduce(x)          # compile + warm
+        jax.device_get(out.ravel()[0])
+        steps = 20 if nbytes <= 1 << 20 else 5
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = group.allreduce(x)
+        jax.device_get(out.ravel()[0])
+        dt = (time.perf_counter() - t0) / steps
+        bus = 2 * (n - 1) / max(n, 1) * nbytes / dt / 1e9 if n > 1 else \
+            nbytes / dt / 1e9
+        print(json.dumps({
+            "metric": "allreduce_bus_bandwidth", "message": name.strip(),
+            "bytes": nbytes, "devices": n, "time_ms": round(dt * 1e3, 3),
+            "value": round(bus, 3), "unit": "GB/s"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Baseline #2: ResNet-50 training throughput (images/s/chip).
+
+Reference analog: Ray Train torchvision ResNet-50/ImageNet.  Synthetic
+224x224 data (the benchmark measures the train step, not disk IO); the
+ingest path (host batches → device) uses the same double-buffered
+device_put that `data.iter_device_batches` uses.
+
+Usage: python benchmarks/resnet_bench.py [--batch N] [--tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import numpy as np
+
+from ray_tpu.models import resnet
+from ray_tpu.parallel import mesh as mesh_lib, spmd
+from ray_tpu.parallel.mesh import MeshConfig
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=0)
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if args.tiny or not on_tpu:
+        cfg, hw, batch = resnet.tiny(), 32, args.batch or 32
+    else:
+        cfg, hw, batch = resnet.resnet50(), 224, args.batch or 128
+
+    mc = MeshConfig(data=1).resolved(1)
+    mesh = mesh_lib.build_mesh(mc, [dev])
+    prog = spmd.build_train_program(
+        loss_fn=lambda p, b: resnet.loss_fn(p, b, cfg),
+        init_params_fn=lambda r: resnet.init_params(r, cfg),
+        mesh=mesh, mesh_config=mc, rules=resnet.RESNET_RULES, batch_rank=1)
+    state = prog.init_fn(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((batch, hw, hw, 3)).astype(np.float32)
+    labels = (np.arange(batch) % cfg.num_classes).astype(np.int32)
+    b = spmd.shard_batch(prog, {"images": images, "labels": labels})
+
+    t0 = time.perf_counter()
+    state, m = prog.step_fn(state, b)
+    float(jax.device_get(m["loss"]))
+    compile_s = time.perf_counter() - t0
+    state, m = prog.step_fn(state, b)
+    float(jax.device_get(m["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, m = prog.step_fn(state, b)
+    float(jax.device_get(m["loss"]))
+    step_s = (time.perf_counter() - t0) / args.steps
+
+    print(json.dumps({
+        "metric": "resnet50_images_per_s_per_chip" if not args.tiny and on_tpu
+                  else "resnet_tiny_images_per_s",
+        "value": round(batch / step_s, 1), "unit": "images/s/chip",
+        "step_ms": round(step_s * 1e3, 2), "batch": batch,
+        "compile_s": round(compile_s, 1),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "loss": round(float(jax.device_get(m["loss"])), 4)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Baselines #1/#3: RLlib PPO CartPole reward-vs-wallclock and IMPALA
+sample throughput (SURVEY.md §6).
+
+Usage:
+  python benchmarks/rllib_bench.py ppo      # reward >= 450 time-to-solve
+  python benchmarks/rllib_bench.py impala   # env frames/s
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import ray_tpu
+from ray_tpu.rllib.algorithms import IMPALAConfig, PPOConfig
+
+
+def bench_ppo() -> None:
+    algo = (PPOConfig().environment("CartPole-v1")
+            .rollouts(num_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=256)
+            .training(train_batch_size=2048, num_sgd_iter=8,
+                      sgd_minibatch_size=256, lr=3e-4)
+            .debugging(seed=0).build())
+    t0 = time.perf_counter()
+    best, solved_at, frames = 0.0, None, 0
+    for i in range(60):
+        r = algo.train()
+        frames = r["timesteps_total"]
+        rew = r.get("episode_reward_mean") or 0.0
+        best = max(best, rew)
+        if solved_at is None and rew >= 450:
+            solved_at = time.perf_counter() - t0
+            break
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "ppo_cartpole", "best_reward": round(best, 1),
+        "time_to_450_s": round(solved_at, 1) if solved_at else None,
+        "wall_s": round(wall, 1), "env_frames": frames,
+        "frames_per_s": round(frames / wall, 1)}))
+
+
+def bench_impala() -> None:
+    algo = (IMPALAConfig().environment("CartPole-v1")
+            .rollouts(num_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=64)
+            .debugging(seed=0).build())
+    t0 = time.perf_counter()
+    frames = 0
+    while time.perf_counter() - t0 < 30:
+        r = algo.train()
+        frames = r["timesteps_total"]
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "impala_cartpole_throughput",
+        "value": round(frames / wall, 1), "unit": "env_frames/s",
+        "reward": round(r.get("episode_reward_mean") or 0.0, 1),
+        "wall_s": round(wall, 1)}))
+
+
+if __name__ == "__main__":
+    import os
+    # logical CPUs: rollout actors + learner oversubscribe small hosts fine
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 1),
+                 ignore_reinit_error=True)
+    which = sys.argv[1] if len(sys.argv) > 1 else "ppo"
+    (bench_ppo if which == "ppo" else bench_impala)()
+    ray_tpu.shutdown()

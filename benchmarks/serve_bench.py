@@ -1,0 +1,96 @@
+"""Baseline #4: Serve BERT-base latency/QPS with replica autoscaling.
+
+Reference analog: `serve_tests` Locust runs against a BERT deployment.
+Drives the real deployment path (controller → router → replica actors)
+with closed-loop concurrent clients; reports p50/p99 and QPS, then scales
+replicas and reports the reaction.
+
+Usage: python benchmarks/serve_bench.py [--tiny] [--requests N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny BERT (CI/CPU); default bert-base")
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    args = ap.parse_args()
+
+    ray_tpu.init(ignore_reinit_error=True)
+
+    preset = "tiny" if args.tiny else "bert-base"
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class Bert:
+        def __init__(self):
+            import functools
+
+            import jax
+            from ray_tpu.models import bert
+            self.cfg = bert.PRESETS[preset]()
+            self.params = bert.init_params(jax.random.key(0), self.cfg)
+            self._fn = jax.jit(functools.partial(bert.classify, cfg=self.cfg))
+
+        def __call__(self, tokens):
+            import numpy as np
+            return np.asarray(
+                self._fn(self.params, np.asarray(tokens, np.int32))).tolist()
+
+    handle = serve.run(Bert.bind(), route_prefix="/bert")
+    vocab = 128 if args.tiny else 30522
+    tok = np.random.randint(0, vocab, (1, args.seq)).tolist()
+    handle.remote(tok).result()  # warm + compile
+
+    lat: list = []
+    lock = threading.Lock()
+    per_worker = args.requests // args.concurrency
+
+    def client():
+        for _ in range(per_worker):
+            t0 = time.perf_counter()
+            handle.remote(tok).result()
+            with lock:
+                lat.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client)
+               for _ in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    arr = np.asarray(sorted(lat))
+    print(json.dumps({
+        "metric": f"serve_bert_{preset}", "requests": len(arr),
+        "qps": round(len(arr) / wall, 1),
+        "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+        "concurrency": args.concurrency, "seq": args.seq}))
+
+    # autoscale reaction: bump to 3 replicas, measure time-to-ready
+    t0 = time.perf_counter()
+    serve.run(Bert.options(num_replicas=3).bind(), route_prefix="/bert")
+    handle.remote(tok).result()
+    print(json.dumps({"metric": "serve_scale_up_1_to_3_s",
+                      "value": round(time.perf_counter() - t0, 2)}))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

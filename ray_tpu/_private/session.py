@@ -70,19 +70,25 @@ class Session:
         except FileNotFoundError:
             pass
         key = os.urandom(32)
-        # write-then-rename so a concurrent reader never sees a partial
-        # file (which would become its HMAC key and fail every handshake)
+        # write-then-link so a concurrent reader never sees a partial file
+        # (which would become its HMAC key and fail every handshake).
+        # O_TRUNC (not O_EXCL): a stale tmp from a killed pid is overwritten
+        # rather than crashing startup forever.
         tmp = p.with_name(f".auth.key.{os.getpid()}")
-        fd = os.open(str(tmp), os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
-        with os.fdopen(fd, "w") as f:
-            f.write(key.hex())
         try:
+            fd = os.open(str(tmp), os.O_CREAT | os.O_TRUNC | os.O_WRONLY,
+                         0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(key.hex())
             os.link(str(tmp), str(p))  # fails if a racer published first
             return key
         except FileExistsError:
             return bytes.fromhex(p.read_text().strip())
         finally:
-            os.unlink(str(tmp))
+            try:
+                os.unlink(str(tmp))
+            except FileNotFoundError:
+                pass
 
     def slab_path(self) -> str:
         """Path of the session's native slab store segment (C++ small-object

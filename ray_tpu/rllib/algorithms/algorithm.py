@@ -42,10 +42,6 @@ class AlgorithmConfig:
         return self
 
     def rollouts(self, **kw):
-        # reference spells it both ways across versions; WorkerSet reads
-        # "num_workers", so alias the newer name onto it
-        if "num_rollout_workers" in kw:
-            kw["num_workers"] = kw.pop("num_rollout_workers")
         self._cfg.update(kw)
         return self
 
@@ -99,8 +95,18 @@ class Algorithm:
         base = self.get_default_config().to_dict()
         if isinstance(config, AlgorithmConfig):
             config = config.to_dict()
-        base.update(config or {})
-        base.update(overrides)
+        # normalize the worker-count alias per user-supplied dict (the
+        # reference spells it both ways across versions; WorkerSet reads
+        # "num_workers"; an explicit num_workers in the SAME dict wins)
+        def _normalize(d):
+            if d and "num_rollout_workers" in d:
+                d = dict(d)
+                d.setdefault("num_workers", d["num_rollout_workers"])
+                del d["num_rollout_workers"]
+            return d
+
+        base.update(_normalize(config) or {})
+        base.update(_normalize(overrides))
         if env is not None:
             base["env"] = env
         if base.get("env") is None:

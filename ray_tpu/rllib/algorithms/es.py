@@ -65,7 +65,7 @@ class ESConfig(AlgorithmConfig):
             "noise_std": 0.1,
             "step_size": 0.02,
             "fcnet_hiddens": (32, 32),
-            "num_rollout_workers": 0,     # rollouts are tasks, not actors
+            "num_workers": 0,             # rollouts are tasks, not actors
         })
 
 
