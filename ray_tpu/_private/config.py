@@ -82,6 +82,10 @@ _FLAG_DEFS = [
     _flag("collective_chunk_bytes", 4 * 1024 * 1024,
           "Chunk size for DCN object-plane fallback collectives."),
     _flag("tpu_topology", "", "Override detected TPU topology (e.g. 'v4-8')."),
+    _flag("tpu_workers_per_node", 1,
+          "Device-holding worker processes per node (concurrent jax inits "
+          "contend for the same chips; raise only with per-worker chip "
+          "partitioning, e.g. TPU_VISIBLE_DEVICES plumbing)."),
     # --- metrics / tracing ---------------------------------------------------
     _flag("metrics_export_period_s", 5.0, "Metrics agent export period."),
     _flag("timeline_enabled", True, "Record profile events for `ray_tpu timeline`."),

@@ -88,6 +88,7 @@ class WorkerState:
         self.pid = pid
         self.proc: Optional[subprocess.Popen] = None
         self.state = "starting"  # starting|idle|busy|actor|dead
+        self.tpu_capable = False # spawned with device access (JAX sees TPU)
         self.task_conn = None    # Connection for pushes
         self.task_conn_lock = threading.Lock()
         self.blocked = False     # task currently parked in get() (CPU released)
@@ -360,28 +361,58 @@ class GcsServer:
                     return node, (pg, i)
         return None, None
 
-    def _idle_worker_on(self, node: NodeState) -> Optional[WorkerState]:
+    def _idle_worker_on(self, node: NodeState,
+                        need_tpu: bool = False) -> Optional[WorkerState]:
+        """Pop an idle worker matching the device requirement.  TPU work
+        only runs on TPU-capable workers (spawned with device access);
+        CPU work prefers plain workers but may ride a TPU-capable one."""
+        skipped = []
+        found = None
+        fallback = None  # tpu-capable worker a CPU task may ride if no
+        # plain worker is idle (but plain ones are preferred)
         while node.idle_workers:
             wid = node.idle_workers.popleft()
             w = self.workers.get(wid)
-            if w is not None and w.state == "idle":
-                return w
-        return None
+            if w is None or w.state != "idle":
+                continue
+            if need_tpu and not w.tpu_capable:
+                skipped.append(wid)
+                continue
+            if not need_tpu and w.tpu_capable:
+                if fallback is None:
+                    fallback = w
+                else:
+                    skipped.append(wid)
+                continue
+            found = w
+            break
+        if found is None:
+            found = fallback
+        elif fallback is not None:
+            skipped.append(fallback.worker_id)
+        node.idle_workers.extendleft(reversed(skipped))
+        return found
 
-    def _spawn_worker(self, node_id: str) -> None:
+    def _spawn_worker(self, node_id: str, tpu: bool = False) -> None:
         """Fork a new worker process for a node (reference: WorkerPool pop/fork)."""
         self._spawn_counter += 1
         env = dict(os.environ)
         env.update(GLOBAL_CONFIG.to_env())
         env["RTPU_SESSION_DIR"] = str(self.session.path)
         env["RTPU_NODE_ID"] = node_id
-        # Workers never grab the TPU: jax must not lock the chip in every
-        # spawned process (the driver owns device access by default; TPU
-        # actors opt in via runtime_env {"env_vars": {"RTPU_TPU_WORKER": "1"}}).
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        # Skip the axon/jax PJRT registration in sitecustomize (3.4s import
-        # tax per process) — CPU workers don't touch the TPU tunnel.
-        env.pop("PALLAS_AXON_POOL_IPS", None)
+        if tpu:
+            # TPU-capable worker: keep device access (jax initializes the
+            # real platform inside the worker) — spawned on demand when
+            # pending work requests TPU resources.
+            env["RTPU_TPU_WORKER"] = "1"
+            env.pop("JAX_PLATFORMS", None)
+        else:
+            # Plain workers never grab the TPU: jax must not lock the chip
+            # in every spawned process.
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            # Skip the axon/jax PJRT registration in sitecustomize (3.4s
+            # import tax per process) — CPU workers don't touch the tunnel.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env, cwd=os.getcwd(),
@@ -389,11 +420,15 @@ class GcsServer:
         )
         w = WorkerState(WorkerID(f"spawn{self._spawn_counter:06d}"), node_id, proc.pid)
         w.proc = proc
+        w.tpu_capable = tpu
         # registered properly once the process connects; keep it for monitor
         self.workers[w.worker_id] = w
 
-    def _count_node_workers(self, node: NodeState, include_starting=True) -> int:
-        """Workers counted against the spawn cap.
+    def _count_node_workers(self, node: NodeState, include_starting=True,
+                            tpu: Optional[bool] = None) -> int:
+        """Workers counted against the spawn cap (optionally filtered by
+        device capability — TPU and plain workers have separate caps, or a
+        cap full of the wrong kind would starve the other forever).
 
         Blocked workers (parked in get(), CPU released) don't count — else
         nested task chains deadlock once the cap's worth of workers are all
@@ -403,6 +438,8 @@ class GcsServer:
         n = 0
         for wid in list(self.workers):
             w = self.workers[wid]
+            if tpu is not None and w.tpu_capable != tpu:
+                continue
             if w.node_id == node.node_id and not w.blocked and w.state in (
                     ("starting",) if include_starting else ()) + ("idle", "busy"):
                 n += 1
@@ -440,15 +477,24 @@ class GcsServer:
                 if node is None:
                     self.pending_tasks.append(spec)
                     continue
-                worker = self._idle_worker_on(node)
+                need_tpu = req.get("TPU", 0) > 0
+                worker = self._idle_worker_on(node, need_tpu)
                 if worker is None:
-                    # spawn if below cap (cap = node CPU count, min 1)
-                    cap = int(max(1, node.resources_total.get("CPU", 1)))
-                    cap = GLOBAL_CONFIG.num_workers_per_node or cap
-                    if self._count_node_workers(node) < cap + len(
-                            [a for a in self.actors.values()
-                             if a.state in (A_PENDING, A_RESTARTING)]):
-                        self._spawn_worker(node.node_id)
+                    if need_tpu:
+                        # TPU workers have their own cap: concurrent jax
+                        # inits would fight over the same chips, so one
+                        # device-holding worker per node (its actor/tasks
+                        # own all the node's declared chips)
+                        if self._count_node_workers(node, tpu=True) <                                 GLOBAL_CONFIG.tpu_workers_per_node:
+                            self._spawn_worker(node.node_id, tpu=True)
+                    else:
+                        # plain cap = node CPU count (min 1)
+                        cap = int(max(1, node.resources_total.get("CPU", 1)))
+                        cap = GLOBAL_CONFIG.num_workers_per_node or cap
+                        if self._count_node_workers(node, tpu=False) < cap + len(
+                                [a for a in self.actors.values()
+                                 if a.state in (A_PENDING, A_RESTARTING)]):
+                            self._spawn_worker(node.node_id, tpu=False)
                     self.pending_tasks.append(spec)
                     continue
                 # dispatch

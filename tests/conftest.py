@@ -20,6 +20,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RTPU_OBJECT_STORE_MEMORY_MB", "256")
+# Drop the TPU tunnel from the whole test session: TPU-capable workers
+# inherit env, and the rig must never grab the real chip (or pay the
+# 3.4s sitecustomize plugin registration per worker).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 

@@ -155,3 +155,35 @@ def test_many_small_tasks(ray_start_regular):
 
     refs = [sq.remote(i) for i in range(50)]
     assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_tpu_task_routing_and_worker_capability():
+    """Tasks requesting TPU resources run on TPU-capable workers (device
+    env preserved); plain tasks run on CPU-pinned workers."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, num_tpus=2)
+    try:
+        @ray_tpu.remote(num_tpus=1, num_cpus=0)
+        def on_tpu_worker():
+            import os
+            return (os.environ.get("RTPU_TPU_WORKER"),
+                    os.environ.get("JAX_PLATFORMS"))
+
+        @ray_tpu.remote
+        def on_cpu_worker():
+            import os
+            return (os.environ.get("RTPU_TPU_WORKER"),
+                    os.environ.get("JAX_PLATFORMS"))
+
+        # plain worker exists first so the preference is observable
+        cpu_flag, cpu_jax = ray_tpu.get(on_cpu_worker.remote(), timeout=60)
+        assert cpu_flag is None
+        assert cpu_jax == "cpu"       # chip never locked by plain workers
+        tpu_flag, tpu_jax = ray_tpu.get(on_tpu_worker.remote(), timeout=60)
+        assert tpu_flag == "1"
+        assert tpu_jax != "cpu"       # device access preserved
+        # with both kinds idle, CPU work prefers the plain worker
+        cpu_flag2, _ = ray_tpu.get(on_cpu_worker.remote(), timeout=60)
+        assert cpu_flag2 is None
+    finally:
+        ray_tpu.shutdown()
