@@ -58,6 +58,9 @@ class NodeState:
         self.resources_avail = dict(resources)
         self.labels = labels or {}
         self.alive = True
+        self.is_remote = False   # owned by a NodeAgent on another host:
+        # the GCS cannot fork workers there, and actor sockets on it are
+        # not reachable inbound (v1: remote nodes run tasks only)
         self.workers: Set[str] = set()
         self.idle_workers: deque = deque()
         self.last_heartbeat = time.monotonic()
@@ -201,11 +204,13 @@ class GcsServer:
     # ------------------------------------------------------------------ nodes
     def add_node_internal(self, node_id: str, resources: Dict[str, float],
                           is_head: bool = False,
-                          labels: Optional[Dict[str, str]] = None) -> str:
+                          labels: Optional[Dict[str, str]] = None,
+                          remote: bool = False) -> str:
         with self.cv:
             res = dict(resources)
             res.setdefault("CPU", float(os.cpu_count() or 4) if is_head else 1.0)
             node = NodeState(node_id, res, labels)
+            node.is_remote = remote
             # node-id resource enables NodeAffinity via plain resource matching
             node.resources_total[f"node:{node_id}"] = 1.0
             node.resources_avail[f"node:{node_id}"] = 1.0
@@ -321,9 +326,14 @@ class GcsServer:
     def _pick_node(self, spec: dict, req: Dict[str, float]) -> Optional[NodeState]:
         strategy = spec.get("scheduling_strategy") or "DEFAULT"
         alive = [n for n in self.nodes.values() if n.alive]
+        if spec.get("is_actor_creation"):
+            # v1: actors need an inbound path to their socket; remote-agent
+            # nodes only run tasks (documented in DESIGN.md)
+            alive = [n for n in alive if not n.is_remote]
         if isinstance(strategy, dict) and strategy.get("type") == "node_affinity":
             node = self.nodes.get(strategy["node_id"])
-            if node is not None and node.alive and node.fits(req):
+            if node is not None and node.alive and node.fits(req) and not (
+                    spec.get("is_actor_creation") and node.is_remote):
                 return node
             if strategy.get("soft"):
                 strategy = "DEFAULT"
@@ -480,6 +490,11 @@ class GcsServer:
                 need_tpu = req.get("TPU", 0) > 0
                 worker = self._idle_worker_on(node, need_tpu)
                 if worker is None:
+                    if node.is_remote:
+                        # the NodeAgent owns that host's worker pool; wait
+                        # for one of its workers to go idle
+                        self.pending_tasks.append(spec)
+                        continue
                     if need_tpu:
                         # TPU workers have their own cap: concurrent jax
                         # inits would fight over the same chips, so one
@@ -673,6 +688,9 @@ class GcsServer:
                 if kind == "attach_task_conn":
                     self._attach_task_conn(msg["worker_id"], conn)
                     return  # this thread becomes the push-channel reader
+                if kind == "agent_attach":
+                    self._attach_agent_conn(msg["node_id"], conn)
+                    return  # thread parks until the agent disconnects
                 try:
                     if client_id is None and "client_id" in msg:
                         client_id = msg["client_id"]
@@ -692,6 +710,24 @@ class GcsServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _attach_agent_conn(self, node_id: str, conn) -> None:
+        """Park on the NodeAgent's control connection; its EOF means the
+        agent (and its host) is gone — remove the node so pinned work
+        fails over instead of queueing against a ghost forever."""
+        logger.info("node agent attached for node %s", node_id[:8])
+        while not self._shutdown:
+            try:
+                conn.recv()
+            except (EOFError, OSError):
+                break
+        if not self._shutdown:
+            logger.warning("node agent for %s disconnected; removing node",
+                           node_id[:8])
+            try:
+                self.remove_node_internal(node_id)
+            except Exception:  # noqa: BLE001
+                logger.exception("agent node removal failed")
 
     def _attach_task_conn(self, worker_id: str, conn) -> None:
         with self.cv:
@@ -1261,7 +1297,10 @@ class GcsServer:
         pg = PgState(msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", ""))
         with self.cv:
             assignment = schedule_bundles(
-                [n for n in self.nodes.values() if n.alive],
+                # v1: remote-agent nodes run plain tasks only — PGs carry
+                # actors/groups that need inbound sockets (DESIGN.md)
+                [n for n in self.nodes.values()
+                 if n.alive and not n.is_remote],
                 pg.bundles, pg.strategy)
             if assignment is not None:
                 for i, node_id in enumerate(assignment):
@@ -1321,7 +1360,8 @@ class GcsServer:
     # --- cluster / state API
     def _h_add_node(self, msg: dict) -> dict:
         nid = self.add_node_internal(NodeID.new(), msg["resources"],
-                                     labels=msg.get("labels"))
+                                     labels=msg.get("labels"),
+                                     remote=bool(msg.get("remote")))
         self._pump()
         return {"node_id": nid}
 

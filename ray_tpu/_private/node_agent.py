@@ -1,0 +1,127 @@
+"""NodeAgent: join this host to a remote head as a worker node.
+
+Reference analog: the raylet — the per-host daemon owning that host's
+worker pool (SURVEY.md §2.1).  The agent dials the head's client-proxy
+port (per-session HMAC auth via RTPU_AUTH_KEY), registers a node with this
+host's resources, and maintains a static pool of worker processes that
+connect back through the same tunnel.  The head schedules tasks onto the
+node like any other; task args/results ride the control plane (a remote
+host cannot mmap the head's /dev/shm — the same transport the remote
+client uses).  v1 scope: tasks only (actor sockets need an inbound path;
+see DESIGN.md).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private import protocol, rtlog
+
+logger = rtlog.get("node-agent")
+
+
+class NodeAgent:
+    def __init__(self, head_host: str, head_port: int, *,
+                 num_cpus: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None):
+        self.head = (head_host, head_port)
+        self.num_workers = int(num_cpus or os.cpu_count() or 1)
+        res = dict(resources or {})
+        res["CPU"] = float(self.num_workers)
+        self._conn = protocol.tunnel_connect(*self.head, "gcs")
+        self._chan = protocol.RpcChannel(self._conn)
+        resp = self._chan.call("add_node", resources=res,
+                               labels={"agent": "1"}, remote=True)
+        self.node_id = resp["node_id"]
+        # dedicate this connection to liveness: the head removes the node
+        # when it drops (kill -9 / host crash / partition)
+        self._chan.send_oneway("agent_attach", node_id=self.node_id)
+        self._procs: List[subprocess.Popen] = []
+        self._stop = threading.Event()
+        logger.info("joined head %s:%s as node %s (%d workers)",
+                    head_host, head_port, self.node_id[:8], self.num_workers)
+
+    # -- worker pool ---------------------------------------------------------
+    def _spawn(self) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["RTPU_PROXY_ADDR"] = f"{self.head[0]}:{self.head[1]}"
+        env["RTPU_NODE_ID"] = self.node_id
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.pop("RTPU_SESSION_DIR", None)
+        return subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def run(self) -> None:
+        """Maintain the pool until stopped; respawn dead workers with
+        exponential backoff (a head outage or startup import error must
+        not become a silent fork loop)."""
+        self._procs = [self._spawn() for _ in range(self.num_workers)]
+        spawn_times = [time.monotonic()] * self.num_workers
+        backoff = [1.0] * self.num_workers
+        while not self._stop.is_set():
+            time.sleep(0.5)
+            for i, p in enumerate(self._procs):
+                if p.poll() is None or self._stop.is_set():
+                    continue
+                lived = time.monotonic() - spawn_times[i]
+                if lived < 5.0:
+                    backoff[i] = min(backoff[i] * 2, 30.0)
+                    logger.warning(
+                        "worker slot %d exited after %.1fs (rc=%s); "
+                        "respawning in %.0fs", i, lived, p.returncode,
+                        backoff[i])
+                    time.sleep(backoff[i])
+                else:
+                    backoff[i] = 1.0
+                self._procs[i] = self._spawn()
+                spawn_times[i] = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for p in self._procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        try:  # fresh conn: the attach conn is dedicated to liveness
+            ch = protocol.RpcChannel(
+                protocol.tunnel_connect(*self.head, "gcs"))
+            ch.call("remove_node", node_id=self.node_id)
+            ch.close()
+        except Exception:  # noqa: BLE001 - head may already be gone
+            pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="ray_tpu node-agent")
+    ap.add_argument("--address", required=True, help="head HOST:PORT "
+                    "(the head's --client-server-port)")
+    ap.add_argument("--num-cpus", type=int, default=0)
+    args = ap.parse_args(argv)
+    host, _, port = args.address.partition(":")
+    protocol.set_authkey_from_env()
+    rtlog.setup("node-agent", None)
+    agent = NodeAgent(host, int(port or 10001),
+                      num_cpus=args.num_cpus or None)
+    signal.signal(signal.SIGTERM, lambda *_: agent.stop())
+    try:
+        agent.run()
+    except KeyboardInterrupt:
+        agent.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,24 @@ def connect_tcp(host: str, port: int) -> Connection:
     return Client(address=(host, port), family="AF_INET", authkey=_AUTHKEY)
 
 
+def tunnel_connect(host: str, port: int, target: str) -> Connection:
+    """Open a proxied connection to a cluster-local socket via the client
+    proxy (single implementation of the {target}→{ok|error} handshake)."""
+    conn = connect_tcp(host, port)
+    conn.send({"target": target})
+    resp = conn.recv()
+    if resp.get("error"):
+        conn.close()
+        raise ConnectionError(f"client proxy: {resp['error']}")
+    return conn
+
+
+def set_authkey_from_env() -> None:
+    key = os.environ.get("RTPU_AUTH_KEY")
+    if key:
+        set_authkey(bytes.fromhex(key))
+
+
 class RpcChannel:
     """Synchronous request/response client over one Connection."""
 

@@ -93,7 +93,16 @@ def prepare(runtime_env: Optional[dict], worker) -> Optional[dict]:
 def ensure_local(uri: str, worker) -> Path:
     """Fetch + extract a kv:// URI into the session cache; idempotent."""
     digest = uri[len(_URI_PREFIX):]
-    cache = Path(worker.session.path) / "runtime_env" / digest
+    if worker.session is not None:
+        root = Path(worker.session.path)
+    else:  # remote worker: no session dir on this host.  Per-user dir:
+        # a world-shared path would let another user pre-seed
+        # content-addressed entries (and breaks on mkdir permissions).
+        import getpass
+        import tempfile
+        root = Path(tempfile.gettempdir()) / f"rtpu_remote_{getpass.getuser()}"
+        root.mkdir(mode=0o700, exist_ok=True)
+    cache = root / "runtime_env" / digest
     if cache.exists():
         return cache
     raw = worker.rpc("kv_get", key=f"runtime_env/{digest}").get("value")

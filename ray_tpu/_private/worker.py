@@ -159,13 +159,7 @@ class Worker:
 
     def _tunnel(self, target: str):
         """Open a proxied connection to a cluster-local unix socket."""
-        conn = protocol.connect_tcp(*self.proxy_addr)
-        conn.send({"target": target})
-        resp = conn.recv()
-        if resp.get("error"):
-            conn.close()
-            raise ConnectionError(f"client proxy: {resp['error']}")
-        return conn
+        return protocol.tunnel_connect(*self.proxy_addr, target)
 
     def open_conn(self, addr: str):
         """Connect to a cluster socket directly or via the client proxy."""
@@ -544,7 +538,7 @@ class Worker:
     # ====================================================== executor (worker)
     def run_worker_loop(self) -> None:
         """Main loop of a spawned worker process."""
-        conn = protocol.connect(self.gcs_path)
+        conn = self.open_conn(self.gcs_path)
         conn.send({"kind": "attach_task_conn", "worker_id": self.worker_id})
         with self._task_conn_lock:
             self._task_conn = conn
@@ -593,7 +587,8 @@ class Worker:
     def _serialize_result(self, value: Any) -> dict:
         wire, refs = serialize_to_bytes(value)
         contained = [str(r.id) for r in refs]
-        if len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
+        if self.is_client or \
+                len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
             return {"loc": "inline", "data": wire, "size": len(wire),
                     "contained": contained}
         # large: straight to shm

@@ -44,15 +44,26 @@ def main() -> None:
     from ray_tpu._private.worker import Worker, set_global_worker
     from ray_tpu._private.config import GLOBAL_CONFIG
 
-    session_dir = os.environ["RTPU_SESSION_DIR"]
     node_id = os.environ["RTPU_NODE_ID"]
-    root, name = os.path.split(session_dir)
-    session = Session(root=root, name=name)
-    from ray_tpu._private import protocol
-    protocol.set_authkey(session.auth_key())
-    rtlog.setup("worker", session.log_dir)
-
-    worker = Worker(session, role="worker", node_id=node_id)
+    proxy = os.environ.get("RTPU_PROXY_ADDR")
+    if proxy:
+        # remote-node worker (spawned by a NodeAgent on another host):
+        # all connections tunnel to the head; no local session/data plane
+        from ray_tpu._private import protocol
+        protocol.set_authkey_from_env()
+        host, _, port = proxy.partition(":")
+        rtlog.setup("worker", None)
+        session = None
+        worker = Worker(None, role="worker", node_id=node_id,
+                        proxy_addr=(host, int(port)))
+    else:
+        session_dir = os.environ["RTPU_SESSION_DIR"]
+        root, name = os.path.split(session_dir)
+        session = Session(root=root, name=name)
+        from ray_tpu._private import protocol
+        protocol.set_authkey(session.auth_key())
+        rtlog.setup("worker", session.log_dir)
+        worker = Worker(session, role="worker", node_id=node_id)
     set_global_worker(worker)
     if GLOBAL_CONFIG.log_to_driver:
         sys.stdout = _LogShipper(worker, "stdout", sys.stdout)

@@ -86,6 +86,14 @@ def cmd_start(args) -> int:
     return 1
 
 
+def cmd_join(args) -> int:
+    import ray_tpu._private.node_agent as na
+    argv = ["--address", args.address]
+    if args.num_cpus:
+        argv += ["--num-cpus", str(args.num_cpus)]
+    return na.main(argv)
+
+
 def cmd_stop(args) -> int:
     from ray_tpu._private.session import Session
     try:
@@ -188,6 +196,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop the latest head node")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("join", help="join a remote head as a worker node "
+                        "(set RTPU_AUTH_KEY to the head session's key)")
+    sp.add_argument("--address", required=True, help="head HOST:PORT")
+    sp.add_argument("--num-cpus", type=int, default=0)
+    sp.set_defaults(fn=cmd_join)
 
     for name, fn in (("status", cmd_status), ("timeline", cmd_timeline),
                      ("memory", cmd_memory), ("metrics", cmd_metrics)):

@@ -1,0 +1,118 @@
+"""Multi-host worker nodes: a NodeAgent joins over TCP and runs tasks
+(reference: raylet joining a head — `ray start --address`; SURVEY.md §2.1).
+
+The agent dials the head's client-proxy port on localhost here; the
+transport is identical for a genuinely remote host (plus RTPU_AUTH_KEY
+sharing)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def remote_node(ray_start_2_cpus):
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util.client import ClientProxyServer
+
+    session = worker_mod.global_worker().session
+    proxy = ClientProxyServer(session, host="127.0.0.1", port=0)
+    port = proxy._listener.address[1]
+    env = dict(os.environ)
+    env["RTPU_AUTH_KEY"] = session.auth_key().hex()
+    env.pop("RTPU_SESSION_DIR", None)
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_agent",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", "2"],
+        env=env, cwd="/root/repo")
+    try:
+        deadline = time.time() + 60
+        node_id = None
+        while time.time() < deadline and node_id is None:
+            for n in state.list_nodes():
+                if n["labels"].get("agent") == "1" and n["alive"]:
+                    node_id = n["node_id"]
+            time.sleep(0.2)
+        assert node_id, "agent node never registered"
+        yield node_id
+    finally:
+        agent.terminate()
+        agent.wait(timeout=30)
+        proxy.stop()
+
+
+def test_tasks_run_on_remote_node(remote_node):
+    pin = NodeAffinitySchedulingStrategy(remote_node)
+
+    @ray_tpu.remote(scheduling_strategy=pin.to_dict()
+                    if hasattr(pin, "to_dict") else pin)
+    def where():
+        import os
+        return os.getpid(), os.environ.get("RTPU_PROXY_ADDR") is not None
+
+    # wait for the agent's workers to come up
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        workers = [w for w in state.list_workers()
+                   if w["node_id"] == remote_node and w["state"] != "dead"]
+        if len(workers) >= 1:
+            break
+        time.sleep(0.2)
+
+    pid, via_proxy = ray_tpu.get(where.remote(), timeout=60)
+    assert via_proxy, "task did not run in a proxied remote worker"
+    assert pid != os.getpid()
+
+    # bigger payloads ride the control plane both ways
+    @ray_tpu.remote(scheduling_strategy=pin.to_dict()
+                    if hasattr(pin, "to_dict") else pin)
+    def crunch(arr):
+        return arr * 2
+
+    big = np.arange(200_000)
+    out = ray_tpu.get(crunch.remote(big), timeout=60)
+    assert int(out.sum()) == 2 * big.sum()
+
+
+def test_remote_node_removed_on_agent_exit(ray_start_2_cpus):
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util.client import ClientProxyServer
+
+    session = worker_mod.global_worker().session
+    proxy = ClientProxyServer(session, host="127.0.0.1", port=0)
+    port = proxy._listener.address[1]
+    env = dict(os.environ)
+    env["RTPU_AUTH_KEY"] = session.auth_key().hex()
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_agent",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", "1"],
+        env=env, cwd="/root/repo")
+    try:
+        deadline = time.time() + 60
+        nid = None
+        while time.time() < deadline and nid is None:
+            for n in state.list_nodes():
+                if n["labels"].get("agent") == "1" and n["alive"]:
+                    nid = n["node_id"]
+            time.sleep(0.2)
+        assert nid
+    finally:
+        agent.terminate()
+        agent.wait(timeout=30)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        alive = [n for n in state.list_nodes()
+                 if n["node_id"] == nid and n["alive"]]
+        if not alive:
+            break
+        time.sleep(0.2)
+    assert not alive, "remote node still alive after agent exit"
+    proxy.stop()
