@@ -939,8 +939,12 @@ class GcsServer:
                 # find the placeholder created at spawn time by pid, else create
                 w = None
                 for cand in self.workers.values():
+                    # node_id must match too: a remote-agent worker can
+                    # collide on pid with a local placeholder (separate
+                    # pid namespaces across hosts)
                     if cand.proc is not None and cand.proc.pid == msg["pid"] \
-                            and cand.state == "starting":
+                            and cand.state == "starting" \
+                            and cand.node_id == node_id:
                         w = cand
                         break
                 if w is None:

@@ -33,6 +33,13 @@ class NodeAgent:
         self.head = (head_host, head_port)
         self.num_workers = int(num_cpus or os.cpu_count() or 1)
         res = dict(resources or {})
+        device_keys = [k for k in res if k in ("TPU", "GPU")]
+        if device_keys:
+            # v1: agent workers are CPU-pinned; advertising device
+            # resources would route device tasks here to hang forever
+            raise ValueError(
+                f"NodeAgent v1 cannot offer device resources {device_keys} "
+                f"(its workers are CPU-only; see DESIGN.md)")
         res["CPU"] = float(self.num_workers)
         self._conn = protocol.tunnel_connect(*self.head, "gcs")
         self._chan = protocol.RpcChannel(self._conn)
@@ -44,8 +51,22 @@ class NodeAgent:
         self._chan.send_oneway("agent_attach", node_id=self.node_id)
         self._procs: List[subprocess.Popen] = []
         self._stop = threading.Event()
+        # watch the liveness conn from OUR side too: a dropped TCP conn
+        # makes the head remove the node; without this the agent would
+        # keep an orphaned pool running, silently detached
+        threading.Thread(target=self._liveness_watch, daemon=True,
+                         name="agent-liveness").start()
         logger.info("joined head %s:%s as node %s (%d workers)",
                     head_host, head_port, self.node_id[:8], self.num_workers)
+
+    def _liveness_watch(self) -> None:
+        try:
+            self._conn.recv()  # the head never sends; EOF = detached
+        except (EOFError, OSError):
+            pass
+        if not self._stop.is_set():
+            logger.error("lost connection to head; shutting down pool")
+            self.stop()
 
     # -- worker pool ---------------------------------------------------------
     def _spawn(self) -> subprocess.Popen:
@@ -77,9 +98,11 @@ class NodeAgent:
                         "worker slot %d exited after %.1fs (rc=%s); "
                         "respawning in %.0fs", i, lived, p.returncode,
                         backoff[i])
-                    time.sleep(backoff[i])
+                    self._stop.wait(backoff[i])
                 else:
                     backoff[i] = 1.0
+                if self._stop.is_set():
+                    break  # stop() during the backoff wait: no respawn
                 self._procs[i] = self._spawn()
                 spawn_times[i] = time.monotonic()
 

@@ -99,9 +99,16 @@ def ensure_local(uri: str, worker) -> Path:
         # a world-shared path would let another user pre-seed
         # content-addressed entries (and breaks on mkdir permissions).
         import getpass
+        import stat as stat_mod
         import tempfile
         root = Path(tempfile.gettempdir()) / f"rtpu_remote_{getpass.getuser()}"
         root.mkdir(mode=0o700, exist_ok=True)
+        st = root.stat()  # reject a pre-seeded foreign dir (mkdir with
+        # exist_ok succeeds silently on an attacker-owned path)
+        if st.st_uid != os.getuid() or stat_mod.S_IMODE(st.st_mode) != 0o700:
+            raise PermissionError(
+                f"{root} exists with wrong owner/mode; refusing to use it "
+                f"as the runtime_env cache")
     cache = root / "runtime_env" / digest
     if cache.exists():
         return cache

@@ -8,16 +8,18 @@ share one implementation.
 module     flagship                    baseline
 ========== =========================== ============================
 gpt2       GPT-2 124M…1.5B             #5 tokens/s/chip (north star)
+llama      Llama-2/3 recipe (RoPE/GQA)  modern decoder flagship
 resnet     ResNet-50 (GN+WS, NHWC)     #2 images/s/chip
 bert       BERT-base encoder           #4 Serve latency/QPS
 moe_transformer  top-k routed MoE      expert-parallel flagship
 ========== =========================== ============================
 """
 
-from ray_tpu.models import bert, gpt2, moe_transformer, resnet  # noqa: F401
+from ray_tpu.models import bert, gpt2, llama, moe_transformer, resnet  # noqa: F401
 
 REGISTRY = {
     "gpt2": gpt2,
+    "llama": llama,
     "resnet": resnet,
     "bert": bert,
     "moe": moe_transformer,

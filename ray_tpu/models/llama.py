@@ -1,0 +1,204 @@
+"""Llama-family decoder (RMSNorm + RoPE + GQA + SwiGLU), TPU-first.
+
+No reference counterpart (Ray ships no models; SURVEY.md §2.5) — included
+so the framework's flagship set covers the modern decoder recipe alongside
+GPT-2.  Same architecture conventions as the public Llama-2/3 papers:
+pre-RMSNorm, rotary position embeddings, grouped-query attention, SwiGLU
+MLP, untied output head.  Layout follows gpt2.py: stacked per-layer params
++ ``lax.scan`` (pipeline-axis ready), bf16 activations / f32 params,
+pluggable attention impls for long-context (ring/Ulysses/flash).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.models._common import normal_init, param_count  # noqa: F401
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_positions: int = 4096
+    n_embd: int = 4096
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 32          # < n_head → grouped-query attention
+    ffn_dim: int = 11008         # SwiGLU hidden
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attn_impl: str = "dense"     # dense | flash | ring | ulysses
+    context_axis: Optional[str] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+def llama2_7b() -> LlamaConfig:
+    return LlamaConfig()
+
+
+def llama3_8b() -> LlamaConfig:
+    return LlamaConfig(vocab_size=128256, n_embd=4096, n_layer=32,
+                       n_head=32, n_kv_head=8, ffn_dim=14336,
+                       rope_theta=500000.0, max_positions=8192)
+
+
+def tiny(vocab: int = 128, seq: int = 64) -> LlamaConfig:
+    return LlamaConfig(vocab_size=vocab, max_positions=seq, n_embd=64,
+                       n_layer=2, n_head=4, n_kv_head=2, ffn_dim=128)
+
+
+PRESETS = {"llama2-7b": llama2_7b, "llama3-8b": llama3_8b, "tiny": tiny}
+
+
+# ------------------------------------------------------------------- params
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    pd = cfg.param_dtype
+    E, L = cfg.n_embd, cfg.n_layer
+    kv_dim = cfg.n_kv_head * cfg.head_dim
+    k = iter(jax.random.split(rng, 4 + 7 * L))
+    scale = 0.02
+    out_scale = 0.02 / math.sqrt(2 * L)
+
+    def stack(shape, s=scale):
+        return jnp.stack([normal_init(next(k), shape, pd, s)
+                          for _ in range(L)])
+
+    blocks = {
+        "attn_norm": {"scale": jnp.ones((L, E), pd)},
+        "wq": {"kernel": stack((E, E))},
+        "wk": {"kernel": stack((E, kv_dim))},
+        "wv": {"kernel": stack((E, kv_dim))},
+        "wo": {"kernel": stack((E, E), out_scale)},
+        "mlp_norm": {"scale": jnp.ones((L, E), pd)},
+        "w_gate": {"kernel": stack((E, cfg.ffn_dim))},
+        "w_up": {"kernel": stack((E, cfg.ffn_dim))},
+        "w_down": {"kernel": stack((cfg.ffn_dim, E), out_scale)},
+    }
+    return {
+        "wte": normal_init(next(k), (cfg.vocab_size, E), pd),
+        "blocks": blocks,
+        "norm_f": {"scale": jnp.ones((E,), pd)},
+        "lm_head": {"kernel": normal_init(next(k), (E, cfg.vocab_size), pd)},
+    }
+
+
+# ------------------------------------------------------------------ forward
+def _rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    y = x32 * lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+    return (y * scale).astype(x.dtype)
+
+
+def _rope(x: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings over (B, T, H, D); rotates pairs (d, d+D/2)."""
+    B, T, H, D = x.shape
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(T, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]  # (1, T, 1, half)
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
+def _gqa_expand(kv: jax.Array, n_head: int) -> jax.Array:
+    """(B, T, n_kv, D) → (B, T, n_head, D) by repeating KV groups."""
+    B, T, n_kv, D = kv.shape
+    if n_kv == n_head:
+        return kv
+    rep = n_head // n_kv
+    return jnp.repeat(kv, rep, axis=2)
+
+
+def _attention(q, k, v, cfg: LlamaConfig):
+    if cfg.attn_impl == "dense":
+        from ray_tpu.models.gpt2 import dense_causal_attention
+        return dense_causal_attention(q, k, v, None)
+    if cfg.attn_impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, True)
+    if cfg.attn_impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention_for_model
+        return ring_attention_for_model(q, k, v, cfg,
+                                        axis_name=cfg.context_axis)
+    if cfg.attn_impl == "ulysses":
+        from ray_tpu.ops.ulysses import ulysses_attention_for_model
+        return ulysses_attention_for_model(q, k, v, cfg,
+                                           axis_name=cfg.context_axis)
+    raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+
+
+def _block(x: jax.Array, lp: Params, cfg: LlamaConfig) -> jax.Array:
+    B, T, E = x.shape
+    H, D, KV = cfg.n_head, cfg.head_dim, cfg.n_kv_head
+    h = _rms_norm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
+    q = (h @ lp["wq"]["kernel"].astype(cfg.dtype)).reshape(B, T, H, D)
+    k = (h @ lp["wk"]["kernel"].astype(cfg.dtype)).reshape(B, T, KV, D)
+    v = (h @ lp["wv"]["kernel"].astype(cfg.dtype)).reshape(B, T, KV, D)
+    q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
+    k, v = _gqa_expand(k, H), _gqa_expand(v, H)
+    a = _attention(q, k, v, cfg).reshape(B, T, E)
+    x = x + a @ lp["wo"]["kernel"].astype(cfg.dtype)
+    h = _rms_norm(x, lp["mlp_norm"]["scale"], cfg.rms_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"]["kernel"].astype(cfg.dtype))
+    up = h @ lp["w_up"]["kernel"].astype(cfg.dtype)
+    return x + (gate * up) @ lp["w_down"]["kernel"].astype(cfg.dtype)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """tokens (B, T) int32 → logits (B, T, vocab) f32."""
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    block = partial(_block, cfg=cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def body(carry, lp):
+        return block(carry, lp), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _rms_norm(x, params["norm_f"]["scale"], cfg.rms_eps)
+    logits = x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: LlamaConfig) -> jax.Array:
+    if "inputs" in batch:
+        inp, tgt = batch["inputs"], batch["targets"]
+    else:
+        inp, tgt = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    logits = forward(params, inp, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0].mean()
+
+
+# Sharding: attention/MLP matrices split fsdp×tensor; RoPE/norms replicated.
+LLAMA_RULES = [
+    (r".*wte$",                P("tensor", "fsdp")),
+    (r".*blocks/w[qku].*kernel$",  P("pipeline", "fsdp", "tensor")),
+    (r".*blocks/wv/kernel$",   P("pipeline", "fsdp", "tensor")),
+    (r".*blocks/wo/kernel$",   P("pipeline", "tensor", "fsdp")),
+    (r".*blocks/w_gate/kernel$", P("pipeline", "fsdp", "tensor")),
+    (r".*blocks/w_up/kernel$", P("pipeline", "fsdp", "tensor")),
+    (r".*blocks/w_down/kernel$", P("pipeline", "tensor", "fsdp")),
+    (r".*norm.*scale$",        P(None)),
+    (r".*lm_head/kernel$",     P("fsdp", "tensor")),
+    (r".*", P(None)),
+]

@@ -149,3 +149,61 @@ def test_moe_transformer_train_step_expert_sharded():
     win_sharding = jax.tree_util.tree_leaves(
         state.params["blocks"]["moe"]["w_in"].sharding.spec)
     assert "expert" in str(state.params["blocks"]["moe"]["w_in"].sharding.spec)
+
+
+# ------------------------------------------------------------------- llama
+
+def test_llama_forward_loss_grads():
+    from ray_tpu.models import llama
+    cfg = llama.tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    toks = jax.random.randint(jax.random.key(1), (2, 17), 0, cfg.vocab_size)
+    logits = llama.forward(params, toks[:, :-1], cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    loss = llama.loss_fn(params, {"tokens": toks}, cfg)
+    assert np.isfinite(float(loss))
+    g = jax.grad(llama.loss_fn)(params, {"tokens": toks}, cfg)
+    assert np.isfinite(float(jnp.abs(g["wte"]).sum()))
+
+
+def test_llama_gqa_and_rope_shapes():
+    from ray_tpu.models import llama
+    cfg = llama.tiny()  # n_head=4, n_kv_head=2 → GQA repeat factor 2
+    assert cfg.n_kv_head < cfg.n_head
+    x = jax.random.normal(jax.random.key(0), (1, 8, 2, 16), jnp.float32)
+    out = llama._gqa_expand(x, 4)
+    assert out.shape == (1, 8, 4, 16)
+    np.testing.assert_array_equal(np.asarray(out[:, :, 0]),
+                                  np.asarray(out[:, :, 1]))
+    # RoPE preserves norm per pair-rotation (orthogonal transform)
+    q = jax.random.normal(jax.random.key(1), (1, 8, 4, 16), jnp.float32)
+    rq = llama._rope(q, 10000.0)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(q), axis=-1),
+                               np.linalg.norm(np.asarray(rq), axis=-1),
+                               rtol=1e-5)
+
+
+def test_llama7b_param_count():
+    from ray_tpu.models import llama
+    cfg = llama.llama2_7b()
+    shapes = jax.eval_shape(lambda r: llama.init_params(r, cfg),
+                            jax.random.key(0))
+    n = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(shapes))
+    assert 6.5e9 < n < 7.1e9, n
+
+
+def test_llama_train_step_sharded():
+    from ray_tpu.models import llama
+    cfg = llama.tiny()
+    mc = MeshConfig(data=2, fsdp=2, tensor=2)
+    mesh = mesh_lib.build_mesh(mc, jax.devices()[:8])
+    prog = spmd.build_train_program(
+        loss_fn=lambda p, b: llama.loss_fn(p, b, cfg),
+        init_params_fn=lambda r: llama.init_params(r, cfg),
+        mesh=mesh, mesh_config=mc, rules=llama.LLAMA_RULES)
+    state = prog.init_fn(jax.random.key(0))
+    toks = np.arange(8 * 17, dtype=np.int32).reshape(8, 17) % cfg.vocab_size
+    batch = spmd.shard_batch(prog, {"inputs": toks[:, :-1],
+                                    "targets": toks[:, 1:]})
+    state, metrics = prog.step_fn(state, batch)
+    assert np.isfinite(float(metrics["loss"]))

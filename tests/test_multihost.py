@@ -18,8 +18,9 @@ from ray_tpu.util import state
 from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
 
 
-@pytest.fixture
-def remote_node(ray_start_2_cpus):
+def _start_agent(num_cpus: int):
+    """Start a proxy + node agent against the current head; returns
+    (proxy, agent_proc, node_id)."""
     from ray_tpu._private import worker as worker_mod
     from ray_tpu.util.client import ClientProxyServer
 
@@ -31,17 +32,23 @@ def remote_node(ray_start_2_cpus):
     env.pop("RTPU_SESSION_DIR", None)
     agent = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu._private.node_agent",
-         "--address", f"127.0.0.1:{port}", "--num-cpus", "2"],
+         "--address", f"127.0.0.1:{port}", "--num-cpus", str(num_cpus)],
         env=env, cwd="/root/repo")
+    deadline = time.time() + 60
+    node_id = None
+    while time.time() < deadline and node_id is None:
+        for n in state.list_nodes():
+            if n["labels"].get("agent") == "1" and n["alive"]:
+                node_id = n["node_id"]
+        time.sleep(0.2)
+    assert node_id, "agent node never registered"
+    return proxy, agent, node_id
+
+
+@pytest.fixture
+def remote_node(ray_start_2_cpus):
+    proxy, agent, node_id = _start_agent(num_cpus=2)
     try:
-        deadline = time.time() + 60
-        node_id = None
-        while time.time() < deadline and node_id is None:
-            for n in state.list_nodes():
-                if n["labels"].get("agent") == "1" and n["alive"]:
-                    node_id = n["node_id"]
-            time.sleep(0.2)
-        assert node_id, "agent node never registered"
         yield node_id
     finally:
         agent.terminate()
@@ -83,30 +90,9 @@ def test_tasks_run_on_remote_node(remote_node):
 
 
 def test_remote_node_removed_on_agent_exit(ray_start_2_cpus):
-    from ray_tpu._private import worker as worker_mod
-    from ray_tpu.util.client import ClientProxyServer
-
-    session = worker_mod.global_worker().session
-    proxy = ClientProxyServer(session, host="127.0.0.1", port=0)
-    port = proxy._listener.address[1]
-    env = dict(os.environ)
-    env["RTPU_AUTH_KEY"] = session.auth_key().hex()
-    agent = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu._private.node_agent",
-         "--address", f"127.0.0.1:{port}", "--num-cpus", "1"],
-        env=env, cwd="/root/repo")
-    try:
-        deadline = time.time() + 60
-        nid = None
-        while time.time() < deadline and nid is None:
-            for n in state.list_nodes():
-                if n["labels"].get("agent") == "1" and n["alive"]:
-                    nid = n["node_id"]
-            time.sleep(0.2)
-        assert nid
-    finally:
-        agent.terminate()
-        agent.wait(timeout=30)
+    proxy, agent, nid = _start_agent(num_cpus=1)
+    agent.terminate()
+    agent.wait(timeout=30)
     deadline = time.time() + 30
     while time.time() < deadline:
         alive = [n for n in state.list_nodes()
