@@ -15,6 +15,7 @@ import inspect
 import os
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import protocol, rtlog
@@ -36,9 +37,18 @@ class ActorServer:
         self.instance = instance
         self.actor_id = spec["actor_id"]
         self.max_concurrency = int(spec.get("max_concurrency") or 1)
-        sock_name = f"a_{self.actor_id[:12]}_{os.getpid()}.sock"
-        self.addr = worker.session.socket_path(sock_name)
-        self._listener = protocol.make_listener(self.addr)
+        if worker.session is None:
+            # remote-agent host: no shared session dir, and a unix socket
+            # would be unreachable from other hosts — listen on an
+            # ephemeral TCP port and advertise this host's address
+            # (RTPU_ADVERTISE_HOST, set by the NodeAgent)
+            self._listener = protocol.make_tcp_actor_listener()
+            host = os.environ.get("RTPU_ADVERTISE_HOST", "127.0.0.1")
+            self.addr = f"tcp://{host}:{self._listener.address[1]}"
+        else:
+            sock_name = f"a_{self.actor_id[:12]}_{os.getpid()}.sock"
+            self.addr = worker.session.socket_path(sock_name)
+            self._listener = protocol.make_listener(self.addr)
         self._queue: "queue.Queue" = queue.Queue()
         self._send_lock = threading.Lock()  # replies come from executor
         # threads AND the asyncio loop; Connection.send isn't thread-safe
@@ -54,11 +64,20 @@ class ActorServer:
 
     # ------------------------------------------------------------- transport
     def _accept_loop(self) -> None:
+        from multiprocessing import AuthenticationError
         while not self._stopped.is_set():
             try:
                 conn = self._listener.accept()
-            except (OSError, EOFError):
-                return
+            except (OSError, EOFError, AuthenticationError):
+                # accept() runs the HMAC handshake, so a half-open probe,
+                # port scan, or bad key surfaces HERE — that is a
+                # per-connection failure, not listener shutdown (TCP
+                # listeners are internet-facing on remote-agent hosts).
+                # Only _shutdown() closing the listener ends the loop.
+                if self._stopped.is_set():
+                    return
+                time.sleep(0.01)  # a dead listener fd must not spin-loop
+                continue
             threading.Thread(target=self._conn_reader, args=(conn,),
                              daemon=True).start()
 

@@ -67,6 +67,13 @@ _FLAG_DEFS = [
     _flag("slab_object_max_bytes", 1024 * 1024,
           "Objects <= this go through the C++ slab store; larger ones get "
           "their own tmpfs segment (zero-copy mmap reads)."),
+    _flag("transfer_chunk_bytes", 4 * 1024 * 1024,
+          "Cross-host object transfers stream in chunks of this size "
+          "(reference: ObjectManager chunked transfer) instead of one "
+          "monolithic control-plane message."),
+    _flag("transfer_max_inflight", 2,
+          "Concurrent chunked pulls per process; further pulls queue "
+          "(reference: PullManager bandwidth admission)."),
     # --- scheduler / workers -------------------------------------------------
     _flag("num_workers_per_node", 0, "Size of worker pool (0 = num_cpus)."),
     _flag("worker_register_timeout_s", 30.0, "Timeout for a spawned worker to register."),

@@ -59,8 +59,8 @@ class NodeState:
         self.labels = labels or {}
         self.alive = True
         self.is_remote = False   # owned by a NodeAgent on another host:
-        # the GCS cannot fork workers there, and actor sockets on it are
-        # not reachable inbound (v1: remote nodes run tasks only)
+        # the GCS cannot fork workers there (the agent owns the pool);
+        # actors there listen on TCP and advertise tcp:// addresses
         self.workers: Set[str] = set()
         self.idle_workers: deque = deque()
         self.last_heartbeat = time.monotonic()
@@ -183,6 +183,7 @@ class GcsServer:
         self.lineage_order: deque = deque(maxlen=20000)
         self.events: List[dict] = []                      # timeline events
         self.dead_clients: Set[str] = set()
+        self._staging: Dict[str, dict] = {}   # in-flight chunked uploads
         self.driver_ids: Set[str] = set()
         self.log_sink = None                              # callable(line)
         self._shutdown = False
@@ -326,14 +327,9 @@ class GcsServer:
     def _pick_node(self, spec: dict, req: Dict[str, float]) -> Optional[NodeState]:
         strategy = spec.get("scheduling_strategy") or "DEFAULT"
         alive = [n for n in self.nodes.values() if n.alive]
-        if spec.get("is_actor_creation"):
-            # v1: actors need an inbound path to their socket; remote-agent
-            # nodes only run tasks (documented in DESIGN.md)
-            alive = [n for n in alive if not n.is_remote]
         if isinstance(strategy, dict) and strategy.get("type") == "node_affinity":
             node = self.nodes.get(strategy["node_id"])
-            if node is not None and node.alive and node.fits(req) and not (
-                    spec.get("is_actor_creation") and node.is_remote):
+            if node is not None and node.alive and node.fits(req):
                 return node
             if strategy.get("soft"):
                 strategy = "DEFAULT"
@@ -585,6 +581,9 @@ class GcsServer:
         """Lock held. Failure handling per SURVEY.md §5.3."""
         if w.state == "dead":
             return
+        logger.info("worker death %s pid=%s node=%s state=%s actor=%s task=%s",
+                    w.worker_id, w.pid, (w.node_id or "")[:8], w.state,
+                    w.actor_id, (w.current_task or {}).get("task_id"))
         w.state = "dead"
         self.dead_clients.add(w.worker_id)
         if self.slab is not None and not self._shutdown:
@@ -664,6 +663,21 @@ class GcsServer:
                         logger.warning("worker %s pid=%s exited", w.worker_id, w.pid)
                         self._handle_worker_death(w)
                 self._pump()
+            # purge chunked uploads abandoned by a dead uploader
+            with self.lock:
+                now = time.time()
+                for oid in [o for o, st in self._staging.items()
+                            if now - st["ts"] > 300]:
+                    st = self._staging.pop(oid)
+                    try:
+                        os.close(st["fd"])
+                    except OSError:
+                        pass
+                    from ray_tpu._private.shm_store import _seg_path
+                    try:
+                        os.unlink(str(_seg_path(oid)))
+                    except OSError:
+                        pass
 
     # -------------------------------------------------------------- rpc server
     def _accept_loop(self) -> None:
@@ -753,11 +767,15 @@ class GcsServer:
                 self._handle_worker_event(worker_id, msg)
             except Exception:
                 logger.exception("worker event failed: %s", msg.get("kind"))
+        logger.debug("task conn EOF for worker %s", worker_id)
         with self.cv:
             w = self.workers.get(worker_id)
             if w is not None and w.proc is None:
-                # in-process "worker" (driver) disconnected
+                # proc-less worker (in-process driver, or remote-agent
+                # worker the head never forked): conn EOF IS the death
+                # signal — there is no local pid to poll
                 self._handle_worker_death(w)
+        self._pump()
 
     # ----------------------------------------------------------- worker events
     def _handle_worker_event(self, worker_id: str, msg: dict) -> None:
@@ -1301,10 +1319,7 @@ class GcsServer:
         pg = PgState(msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", ""))
         with self.cv:
             assignment = schedule_bundles(
-                # v1: remote-agent nodes run plain tasks only — PGs carry
-                # actors/groups that need inbound sockets (DESIGN.md)
-                [n for n in self.nodes.values()
-                 if n.alive and not n.is_remote],
+                [n for n in self.nodes.values() if n.alive],
                 pg.bundles, pg.strategy)
             if assignment is not None:
                 for i, node_id in enumerate(assignment):
@@ -1450,25 +1465,93 @@ class GcsServer:
                             bundles.append(dict(b))
             return {"task_shapes": shapes, "pg_bundles": bundles}
 
-    def _h_fetch_object(self, msg: dict) -> dict:
-        """Object bytes through the control plane — the remote-client data
-        path (a client cannot mmap this machine's /dev/shm)."""
-        oid = msg["object_id"]
+    def _resolve_object_bytes(self, oid: str):
+        """One object-resolution ladder for the cross-host data path:
+        → ("inline", bytes) | ("slab", bytes) | ("shm", Path) | None."""
         with self.lock:
             meta = self.objects.get(oid)
             if meta is None or meta.state != READY:
-                return {"data": None}
+                return None
             loc, data = meta.loc, meta.data
         if loc == "inline":
-            return {"data": data}
+            return ("inline", data)
         if loc == "slab":
-            return {"data": self.slab.get(oid) if self.slab else None}
+            blob = self.slab.get(oid) if self.slab else None
+            return None if blob is None else ("slab", blob)
         self.store.restore(oid)
-        try:
-            from ray_tpu._private.shm_store import _seg_path
-            return {"data": _seg_path(oid).read_bytes()}
-        except FileNotFoundError:
+        from ray_tpu._private.shm_store import _seg_path
+        return ("shm", _seg_path(oid))
+
+    def _h_fetch_object(self, msg: dict) -> dict:
+        """Object bytes through the control plane — the cross-host data
+        path (a remote host cannot mmap this machine's /dev/shm).  Objects
+        above ``transfer_chunk_bytes`` answer ``{"chunked": True, size}``;
+        the caller then streams ``fetch_chunk`` requests (reference:
+        ObjectManager chunked transfer, SURVEY.md §2.1) so the control
+        plane never carries one monolithic multi-hundred-MB message."""
+        chunk = GLOBAL_CONFIG.transfer_chunk_bytes
+        got = self._resolve_object_bytes(msg["object_id"])
+        if got is None:
             return {"data": None}
+        loc, payload = got
+        try:
+            if loc == "shm":
+                size = payload.stat().st_size
+                if size > chunk:
+                    return {"chunked": True, "size": size}
+                return {"data": payload.read_bytes()}
+        except (FileNotFoundError, OSError):
+            return {"data": None}
+        if loc != "inline" and len(payload) > chunk:
+            return {"chunked": True, "size": len(payload)}
+        return {"data": payload}
+
+    def _h_put_chunk(self, msg: dict) -> dict:
+        """One chunk of a large object being uploaded from a remote host
+        (the inbound half of chunked transfer: remote task/actor results
+        and remote ``put``s).  Chunks pwrite straight into the object's
+        tmpfs segment at their offset — the daemon never holds the whole
+        object in its heap (that would defeat the point of chunking).
+        The uploader references the sealed segment with loc="shm"."""
+        oid, off, total = msg["object_id"], msg["offset"], msg["total"]
+        data = msg["data"]
+        if total > self.store.capacity:
+            raise ValueError(
+                f"chunked upload of {total} bytes exceeds store capacity "
+                f"{self.store.capacity}")
+        from ray_tpu._private.shm_store import _seg_path
+        with self.lock:
+            st = self._staging.get(oid)
+            if st is None:
+                fd = os.open(str(_seg_path(oid)),
+                             os.O_CREAT | os.O_RDWR, 0o600)
+                os.ftruncate(fd, max(total, 1))
+                st = {"fd": fd, "got": 0, "ts": time.time()}
+                self._staging[oid] = st
+            os.pwrite(st["fd"], data, off)
+            st["got"] += len(data)
+            st["ts"] = time.time()
+            done = st["got"] >= total
+            if done:
+                os.close(st["fd"])
+                self._staging.pop(oid, None)
+        return {"done": done}
+
+    def _h_fetch_chunk(self, msg: dict) -> dict:
+        """One chunk of a large object (offset/length pread — stateless,
+        so retries and concurrent pullers need no server-side sessions)."""
+        offset, length = msg["offset"], msg["length"]
+        got = self._resolve_object_bytes(msg["object_id"])
+        if got is None:
+            return {"data": None}
+        loc, payload = got
+        if loc == "shm":
+            try:
+                with open(payload, "rb") as f:
+                    return {"data": os.pread(f.fileno(), length, offset)}
+            except (FileNotFoundError, OSError):
+                return {"data": None}
+        return {"data": bytes(memoryview(payload)[offset:offset + length])}
 
     def _h_store_stats(self, msg: dict) -> dict:
         return {"stats": self.store.stats()}

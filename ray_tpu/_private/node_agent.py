@@ -4,17 +4,20 @@ Reference analog: the raylet — the per-host daemon owning that host's
 worker pool (SURVEY.md §2.1).  The agent dials the head's client-proxy
 port (per-session HMAC auth via RTPU_AUTH_KEY), registers a node with this
 host's resources, and maintains a static pool of worker processes that
-connect back through the same tunnel.  The head schedules tasks onto the
-node like any other; task args/results ride the control plane (a remote
-host cannot mmap the head's /dev/shm — the same transport the remote
-client uses).  v1 scope: tasks only (actor sockets need an inbound path;
-see DESIGN.md).
+connect back through the same tunnel.  The head schedules tasks AND
+actors onto the node like any other; task args/results ride the control
+plane (a remote host cannot mmap the head's /dev/shm — the same transport
+the remote client uses).  Actors here listen on ephemeral TCP ports and
+advertise ``tcp://<this-host>:<port>`` addresses; callers dial them
+directly, or relay through the head's client proxy when sibling hosts
+aren't mutually reachable.
 """
 
 from __future__ import annotations
 
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -69,15 +72,30 @@ class NodeAgent:
             self.stop()
 
     # -- worker pool ---------------------------------------------------------
+    def _advertise_host(self) -> str:
+        """This host's address as seen on the route to the head — what
+        actor TCP listeners advertise to cross-host callers."""
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(self.head)  # UDP connect: no packets, just routing
+            return s.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+        finally:
+            s.close()
+
     def _spawn(self) -> subprocess.Popen:
         env = dict(os.environ)
         env["RTPU_PROXY_ADDR"] = f"{self.head[0]}:{self.head[1]}"
         env["RTPU_NODE_ID"] = self.node_id
+        env["RTPU_ADVERTISE_HOST"] = self._advertise_host()
         env.setdefault("JAX_PLATFORMS", "cpu")
         env.pop("RTPU_SESSION_DIR", None)
+        sink = None if os.environ.get("RTPU_AGENT_WORKER_LOG") \
+            else subprocess.DEVNULL  # debug: inherit stderr when set
         return subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            env=env, stdout=sink, stderr=sink)
 
     def run(self) -> None:
         """Maintain the pool until stopped; respawn dead workers with

@@ -55,7 +55,9 @@ def schedule_bundles(nodes: Sequence[object], bundles: List[Dict[str, float]],
         return out
 
     if strategy == "STRICT_PACK":
-        # all bundles on one node; else one ICI domain
+        # all bundles on one node; else one ICI domain, on a minimal
+        # contiguous window of hosts (slice_host order = ICI adjacency
+        # along the slice's host dimension — parallel/topology.py)
         for nid in order:
             local = dict(sim[nid])
             ok = True
@@ -66,10 +68,42 @@ def schedule_bundles(nodes: Sequence[object], bundles: List[Dict[str, float]],
                 _take(local, b)
             if ok:
                 return [nid] * len(bundles)
+        host_idx: Dict[str, int] = {}
+        for n in nodes:
+            try:
+                host_idx[n.node_id] = int(
+                    getattr(n, "labels", {}).get("slice_host", ""))
+            except ValueError:
+                host_idx[n.node_id] = 1 << 30  # unindexed hosts sort last
         for dom_nodes in domains.values():
             if len(dom_nodes) < 2:
                 continue
-            got = pack(sorted(dom_nodes, key=lambda nid: -sum(sim[nid].values())))
+            ordered = sorted(dom_nodes, key=lambda nid: (host_idx[nid], nid))
+            best: Optional[List[str]] = None
+            best_span = len(ordered) + 1
+            for start in range(len(ordered)):
+                local = {nid: dict(sim[nid]) for nid in ordered}
+                out: List[str] = []
+                cur = start
+                for b in bundles:
+                    while cur < len(ordered) and not _fits(local[ordered[cur]], b):
+                        cur += 1
+                    if cur >= len(ordered):
+                        out = []
+                        break
+                    _take(local[ordered[cur]], b)
+                    out.append(ordered[cur])
+                if out:
+                    span = cur - start
+                    if span < best_span:
+                        best, best_span = out, span
+            if best is not None:
+                return best
+            # contiguous windows infeasible (heterogeneous bundles can
+            # defeat the forward-only scan) — any same-domain packing
+            # still satisfies the STRICT_PACK contract
+            got = pack(sorted(dom_nodes,
+                              key=lambda nid: -sum(sim[nid].values())))
             if got is not None:
                 return got
         return None

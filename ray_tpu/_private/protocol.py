@@ -58,8 +58,60 @@ def make_tcp_listener(host: str, port: int) -> Listener:
     return Listener(address=(host, port), family="AF_INET", authkey=_AUTHKEY)
 
 
-def connect_tcp(host: str, port: int) -> Connection:
-    return Client(address=(host, port), family="AF_INET", authkey=_AUTHKEY)
+def connect_tcp(host: str, port: int,
+                timeout: float | None = None) -> Connection:
+    """TCP connect + HMAC handshake.  With ``timeout``, both the TCP
+    connect and the handshake are bounded (SO_RCVTIMEO/SO_SNDTIMEO apply
+    to the raw fd reads multiprocessing.Connection performs — a plain
+    ``Client()`` would block for the OS SYN-retry window, minutes, when
+    dialing an unreachable actor host).  The deadline is lifted once the
+    handshake completes."""
+    if timeout is None:
+        return Client(address=(host, port), family="AF_INET", authkey=_AUTHKEY)
+    import struct
+    from multiprocessing.connection import answer_challenge, deliver_challenge
+    sock = socket.create_connection((host, port), timeout=timeout)
+    tv = struct.pack("ll", int(timeout), int((timeout % 1.0) * 1e6))
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+    sock.settimeout(None)  # blocking fd; the sockopts bound each syscall
+    conn = Connection(sock.detach())
+    try:
+        answer_challenge(conn, _AUTHKEY)
+        deliver_challenge(conn, _AUTHKEY)
+    except BaseException:
+        conn.close()
+        raise
+    # handshake done — restore unbounded blocking I/O for normal traffic
+    s2 = socket.socket(fileno=conn.fileno())
+    zero = struct.pack("ll", 0, 0)
+    s2.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, zero)
+    s2.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, zero)
+    s2.detach()
+    return conn
+
+
+def parse_tcp_addr(addr: str):
+    """'tcp://host:port' → (host, port) or None for unix paths."""
+    if not addr.startswith("tcp://"):
+        return None
+    host, _, port = addr[len("tcp://"):].rpartition(":")
+    return host, int(port)
+
+
+def make_tcp_actor_listener() -> Listener:
+    """Ephemeral-port TCP listener for an actor on a remote-agent host
+    (its unix sockets are unreachable from other hosts)."""
+    return Listener(address=("0.0.0.0", 0), family="AF_INET",
+                    authkey=_AUTHKEY)
+
+
+def connect_addr(addr: str, timeout: float | None = None) -> Connection:
+    """Connect to a unix socket path or a tcp://host:port address."""
+    tcp = parse_tcp_addr(addr)
+    if tcp is not None:
+        return connect_tcp(*tcp, timeout=timeout)
+    return connect(addr)
 
 
 def tunnel_connect(host: str, port: int, target: str) -> Connection:
@@ -145,6 +197,26 @@ class RpcPool:
             chans, self._all = self._all, []
         for ch in chans:
             ch.close()
+
+
+def shutdown_conn(conn: Connection) -> None:
+    """Force-terminate a Connection even while another thread is blocked
+    in recv() on it.  A bare ``close()`` only drops the fd-table entry;
+    the blocked read keeps the kernel socket alive, so the peer never
+    sees FIN and EOF never propagates (a relay that close()s a pair of
+    pumped connections silently leaks the other direction).  shutdown()
+    acts on the socket itself: it interrupts blocked reads and sends FIN.
+    """
+    try:
+        s = socket.socket(fileno=conn.fileno())
+    except OSError:
+        return
+    try:
+        s.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    finally:
+        s.detach()  # fd ownership stays with the Connection
 
 
 def hostname() -> str:

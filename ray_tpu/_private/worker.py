@@ -129,6 +129,10 @@ class Worker:
         self._local_lock = threading.Lock()
         self._actor_channels: Dict[str, "_ActorChannel"] = {}
         self._actor_chan_lock = threading.Lock()
+        self._pulls: Dict[str, dict] = {}       # in-flight chunked pulls
+        self._pull_lock = threading.Lock()
+        self._pull_sem = threading.Semaphore(
+            max(1, GLOBAL_CONFIG.transfer_max_inflight))
         self.ctx = _TaskContext()
         self._task_conn = None
         self._task_conn_lock = threading.Lock()
@@ -162,10 +166,27 @@ class Worker:
         return protocol.tunnel_connect(*self.proxy_addr, target)
 
     def open_conn(self, addr: str):
-        """Connect to a cluster socket directly or via the client proxy."""
+        """Connect to a cluster socket directly or via the client proxy.
+
+        ``tcp://host:port`` addresses (actors on remote-agent hosts) are
+        dialed directly with a bounded connect+handshake — an unreachable
+        host must fail in seconds, not the OS SYN-retry window.  Proxied
+        processes fall back to the head proxy dialing out on their behalf
+        (hub-spoke topologies where sibling hosts can't reach each
+        other); head-side callers have no such relay — an agent behind
+        NAT can run tasks but its actors are only callable from hosts
+        that can route to it (documented in DESIGN.md)."""
+        tcp = protocol.parse_tcp_addr(addr)
         if self.is_client:
+            if tcp is not None:
+                try:
+                    return protocol.connect_addr(addr, timeout=3.0)
+                except (OSError, ConnectionError):
+                    pass
             return self._tunnel(addr)
-        return protocol.connect(addr)
+        if tcp is not None:
+            return protocol.connect_addr(addr, timeout=3.0)
+        return protocol.connect_addr(addr)
 
     def _send_event(self, msg: dict) -> None:
         with self._task_conn_lock:
@@ -206,8 +227,15 @@ class Worker:
         contained = [str(r.id) for r in refs]
         slab = self.slab
         tiny = len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes or \
-            self.is_client  # client data plane = control plane (proxied)
-        if slab is not None and len(wire) <= GLOBAL_CONFIG.slab_object_max_bytes \
+            (self.is_client and
+             len(wire) <= GLOBAL_CONFIG.transfer_chunk_bytes)
+        if self.is_client and not tiny:
+            # client data plane = control plane (proxied): stream large
+            # puts to the head's store in chunks, then register them
+            self._upload_wire(str(oid), wire)
+            self.rpc("put_object", object_id=str(oid), loc="shm",
+                     size=len(wire), contained=contained, node_id=self.node_id)
+        elif slab is not None and len(wire) <= GLOBAL_CONFIG.slab_object_max_bytes \
                 and slab.put(str(oid), wire):
             self.rpc("put_object", object_id=str(oid), loc="slab",
                      size=len(wire), contained=contained, node_id=self.node_id)
@@ -228,10 +256,7 @@ class Worker:
         if meta["loc"] == "inline":
             return deserialize_from(memoryview(meta["data"]))
         if self.is_client and meta["loc"] in ("slab", "shm", "spilled"):
-            data = self.rpc("fetch_object", object_id=oid).get("data")
-            if data is None:
-                raise FileNotFoundError(oid)  # lost → reconstruction retry
-            return deserialize_from(memoryview(data))
+            return deserialize_from(self._fetch_remote_wire(oid))
         if meta["loc"] == "slab":
             slab = self.slab
             data = slab.get(oid) if slab is not None else None
@@ -242,6 +267,60 @@ class Worker:
             return deserialize_from(memoryview(data))
         mapped = ShmObjectStore.map_readonly(oid)
         return deserialize_from(mapped.buf)
+
+    def _fetch_remote_wire(self, oid: str) -> memoryview:
+        """Pull one object's wire bytes over the control plane (the
+        cross-host data path).  Large objects stream in
+        ``transfer_chunk_bytes`` pieces; concurrent pulls of the SAME
+        object coalesce onto one in-flight transfer (reference:
+        PullManager dedup), and ``transfer_max_inflight`` bounds how many
+        chunked pulls run at once (bandwidth admission)."""
+        with self._pull_lock:
+            inflight = self._pulls.get(oid)
+            if inflight is None:
+                inflight = {"ev": threading.Event(), "wire": None, "err": None}
+                self._pulls[oid] = inflight
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            inflight["ev"].wait()
+            if inflight["err"] is not None:
+                raise inflight["err"]
+            return memoryview(inflight["wire"])
+        try:
+            wire = self._pull_object(oid)
+            inflight["wire"] = wire
+            return memoryview(wire)
+        except BaseException as e:
+            inflight["err"] = e
+            raise
+        finally:
+            with self._pull_lock:
+                self._pulls.pop(oid, None)
+            inflight["ev"].set()
+
+    def _pull_object(self, oid: str):
+        resp = self.rpc("fetch_object", object_id=oid)
+        data = resp.get("data")
+        if data is not None:
+            return data
+        if not resp.get("chunked"):
+            raise FileNotFoundError(oid)  # lost → reconstruction retry
+        size = resp["size"]
+        chunk = GLOBAL_CONFIG.transfer_chunk_bytes
+        buf = bytearray(size)
+        with self._pull_sem:
+            off = 0
+            while off < size:
+                r = self.rpc("fetch_chunk", object_id=oid, offset=off,
+                             length=min(chunk, size - off))
+                piece = r.get("data")
+                if not piece:
+                    raise FileNotFoundError(oid)
+                buf[off:off + len(piece)] = piece
+                off += len(piece)
+        return buf
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         oids = [str(r.id) for r in refs]
@@ -587,12 +666,18 @@ class Worker:
     def _serialize_result(self, value: Any) -> dict:
         wire, refs = serialize_to_bytes(value)
         contained = [str(r.id) for r in refs]
-        if self.is_client or \
-                len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
+        if self.is_client:
+            # no local data plane: small results inline on the control
+            # plane; large ones stream to the head's store in chunks
+            if len(wire) <= GLOBAL_CONFIG.transfer_chunk_bytes:
+                return {"loc": "inline", "data": wire, "size": len(wire),
+                        "contained": contained}
+            return {"loc": "upload", "wire": wire, "size": len(wire),
+                    "contained": contained}
+        if len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
             return {"loc": "inline", "data": wire, "size": len(wire),
                     "contained": contained}
         # large: straight to shm
-        oid_placeholder = None  # filled by caller
         return {"loc": "shm", "wire": wire, "size": len(wire),
                 "contained": contained}
 
@@ -611,8 +696,29 @@ class Worker:
             if res["loc"] == "shm":
                 res["loc"] = self._write_wire(oid, res.pop("wire"),
                                               overwrite=True)
+            elif res["loc"] == "upload":
+                self._upload_wire(oid, res.pop("wire"))
+                res["loc"] = "shm"  # now lives in the head's tmpfs plane
             out.append(res)
         return out
+
+    def _upload_wire(self, oid: str, wire: bytes) -> None:
+        """Stream large wire bytes to the head's store in chunks (the
+        outbound half of cross-host transfer — reference: ObjectManager
+        push; SURVEY.md §5.8 object plane)."""
+        chunk = GLOBAL_CONFIG.transfer_chunk_bytes
+        mv = memoryview(wire)
+        total = len(wire)
+        off = 0
+        while True:
+            piece = bytes(mv[off:off + chunk])
+            resp = self.rpc("put_chunk", object_id=oid, offset=off,
+                            total=total, data=piece)
+            off += len(piece)
+            if off >= total:
+                if not resp.get("done"):
+                    raise RuntimeError(f"chunked upload of {oid} incomplete")
+                return
 
     def _apply_runtime_env(self, spec: dict):
         from ray_tpu._private import runtime_env as renv
@@ -704,7 +810,17 @@ class _ActorChannel:
             info = self.worker.rpc("get_actor_info", actor_id=self.actor_id,
                                    timeout=max(0.1, deadline - time.monotonic()))
             if info["state"] == "ALIVE":
-                break
+                try:
+                    self._conn = self.worker.open_conn(info["addr"])
+                    break
+                except (OSError, ConnectionError):
+                    # stale address: the actor died but the control plane
+                    # hasn't flipped its state yet — keep polling until
+                    # RESTARTING/DEAD shows up or the deadline passes
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+                    continue
             if info["state"] == "DEAD":
                 cerr = info.get("creation_error")
                 if cerr is not None:
@@ -715,7 +831,6 @@ class _ActorChannel:
                 raise exc.GetTimeoutError(
                     f"actor {self.actor_id} not ready after {timeout}s")
             time.sleep(0.05)
-        self._conn = self.worker.open_conn(info["addr"])
         self._incarnation = info["incarnation"]
         threading.Thread(target=self._read_loop, args=(self._conn,),
                          name=f"actor-ch-{self.actor_id[:6]}", daemon=True).start()

@@ -96,6 +96,16 @@ def detect_local_topology() -> Optional[SliceSpec]:
     return slice_spec(f"{gen}-{len(devs)}")
 
 
-def ici_domain_label(slice_name: str, slice_idx: int = 0) -> Dict[str, str]:
-    """Node labels marking co-membership in one ICI domain (for STRICT_PACK)."""
-    return {"ici_domain": f"{slice_name}/{slice_idx}"}
+def ici_domain_label(slice_name: str, slice_idx: int = 0,
+                     host_index: Optional[int] = None) -> Dict[str, str]:
+    """Node labels marking co-membership in one ICI domain (for STRICT_PACK).
+
+    ``host_index`` is the host's position along the slice's host dimension;
+    the PG scheduler uses it to keep multi-host reservations on ICI-adjacent
+    hosts (a contiguous window) instead of arbitrary members of the domain.
+    """
+    labels = {"ici_domain": f"{slice_name}/{slice_idx}",
+              "slice_topology": slice_name}
+    if host_index is not None:
+        labels["slice_host"] = str(host_index)
+    return labels

@@ -46,6 +46,13 @@ class ClientProxyServer:
         import os
         if target == "gcs":
             return self.session.socket_path("gcs.sock")
+        # tcp://host:port: dial-out relay to an actor on a remote-agent
+        # host (hub-spoke: clients that can't reach sibling hosts route
+        # through the head).  The handshake already HMAC-authenticated the
+        # caller against the session secret — an authed principal can run
+        # arbitrary tasks anyway, so relaying adds no privilege.
+        if protocol.parse_tcp_addr(target) is not None:
+            return target
         # actor sockets live in the session socket dir; refuse anything
         # else — realpath first so ../ traversal cannot escape it
         path = os.path.realpath(str(target))
@@ -62,7 +69,7 @@ class ClientProxyServer:
                 client_conn.send({"error": "invalid target"})
                 client_conn.close()
                 return
-            upstream = protocol.connect(path)
+            upstream = protocol.connect_addr(path)
             client_conn.send({"ok": True})
         except (EOFError, OSError, FileNotFoundError) as e:
             try:
@@ -72,17 +79,35 @@ class ClientProxyServer:
             client_conn.close()
             return
 
+        # Teardown protocol for the conn pair.  The FIRST pump to exit
+        # only shutdown()s both sockets: that interrupts the sibling's
+        # blocked recv() AND sends FIN to both far ends (a bare close()
+        # would do neither while a read is in flight — the kernel socket
+        # stays alive and death detection upstream never fires).  The
+        # SECOND pump then close()s the fds — only once no thread can
+        # touch them again, so a recycled fd number can never belong to
+        # some unrelated new connection when we act on it.
+        lock = threading.Lock()
+        state = {"finished": False}
+
         def pump(src, dst):
             while True:
                 try:
                     dst.send(src.recv())
                 except (EOFError, OSError, ValueError):
                     break
-            for c in (src, dst):
-                try:
-                    c.close()
-                except OSError:
-                    pass
+            with lock:
+                first = not state["finished"]
+                state["finished"] = True
+            if first:
+                protocol.shutdown_conn(src)
+                protocol.shutdown_conn(dst)
+            else:
+                for c in (src, dst):
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
 
         t = threading.Thread(target=pump, args=(client_conn, upstream),
                              daemon=True)

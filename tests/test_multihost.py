@@ -89,6 +89,113 @@ def test_tasks_run_on_remote_node(remote_node):
     assert int(out.sum()) == 2 * big.sum()
 
 
+def test_actor_on_remote_node(remote_node):
+    pin = NodeAffinitySchedulingStrategy(remote_node)
+
+    @ray_tpu.remote(scheduling_strategy=pin.to_dict()
+                    if hasattr(pin, "to_dict") else pin)
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+            self.pid = os.getpid()
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+        def where(self):
+            return self.pid, os.environ.get("RTPU_PROXY_ADDR") is not None
+
+    c = Counter.remote(10)
+    pid, via_proxy = ray_tpu.get(c.where.remote(), timeout=90)
+    assert via_proxy, "actor did not run in a proxied remote worker"
+    assert pid != os.getpid()
+    # ordered pipelined calls over the tcp:// channel
+    refs = [c.add.remote(1) for _ in range(20)]
+    assert ray_tpu.get(refs[-1], timeout=60) == 30
+    assert ray_tpu.get(refs, timeout=60) == list(range(11, 31))
+    # numpy payloads through the control plane both ways
+    @ray_tpu.remote(scheduling_strategy=pin.to_dict()
+                    if hasattr(pin, "to_dict") else pin)
+    class Holder:
+        def __init__(self):
+            self.arr = None
+
+        def set(self, a):
+            self.arr = a
+            return a.shape
+
+        def total(self):
+            return float(self.arr.sum())
+
+    h = Holder.remote()
+    big = np.arange(100_000).astype(np.float64)
+    assert ray_tpu.get(h.set.remote(big), timeout=60) == big.shape
+    assert ray_tpu.get(h.total.remote(), timeout=60) == float(big.sum())
+
+
+def test_remote_actor_restart(remote_node):
+    pin = NodeAffinitySchedulingStrategy(remote_node)
+
+    # no max_task_retries: an in-flight die() would be resubmitted to the
+    # restarted incarnation and kill it again (at-least-once semantics)
+    @ray_tpu.remote(max_restarts=1,
+                    scheduling_strategy=pin.to_dict()
+                    if hasattr(pin, "to_dict") else pin)
+    class Flaky:
+        def pid(self):
+            return os.getpid()
+
+        def die(self):
+            os._exit(1)
+
+    f = Flaky.remote()
+    pid1 = ray_tpu.get(f.pid.remote(), timeout=90)
+    f.die.remote()
+    # restarted actor (possibly on any node) answers again
+    deadline = time.time() + 90
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(f.pid.remote(), timeout=30)
+            break
+        except ray_tpu.exceptions.RayActorError:
+            time.sleep(0.5)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_chunked_object_transfer(ray_start_2_cpus, monkeypatch):
+    """Large args/returns stream in chunks over the control plane
+    (reference: ObjectManager chunked transfer)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    monkeypatch.setattr(GLOBAL_CONFIG, "transfer_chunk_bytes", 64 * 1024)
+    monkeypatch.setenv("RTPU_TRANSFER_CHUNK_BYTES", str(64 * 1024))
+    proxy, agent, node_id = _start_agent(num_cpus=1)
+    try:
+        pin = NodeAffinitySchedulingStrategy(node_id)
+
+        @ray_tpu.remote(scheduling_strategy=pin.to_dict()
+                        if hasattr(pin, "to_dict") else pin)
+        def crunch(a):
+            return a * 2  # big in, big out: chunked both directions
+
+        big = np.arange(300_000, dtype=np.float64)  # 2.4MB → ~37 chunks
+        ref = crunch.remote(big)
+        out = ray_tpu.get(ref, timeout=90)
+        np.testing.assert_array_equal(out, big * 2)
+        # the big return lives in the head's store; a local task can read
+        # it via the normal zero-copy path
+        @ray_tpu.remote
+        def total(a):
+            return float(a.sum())
+
+        assert ray_tpu.get(total.remote(ref), timeout=60) == float((big * 2).sum())
+    finally:
+        agent.terminate()
+        agent.wait(timeout=30)
+        proxy.stop()
+
+
 def test_remote_node_removed_on_agent_exit(ray_start_2_cpus):
     proxy, agent, nid = _start_agent(num_cpus=1)
     agent.terminate()

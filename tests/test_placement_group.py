@@ -68,6 +68,27 @@ def test_strict_pack_single_ici_domain(ray_start_cluster):
     assert len(domains) == 1  # all bundles inside one ICI domain
 
 
+def test_strict_pack_prefers_adjacent_hosts(ray_start_cluster):
+    """STRICT_PACK lands on a minimal CONTIGUOUS window of slice hosts
+    (slice_host label order = ICI adjacency), not arbitrary domain
+    members."""
+    from ray_tpu.parallel.topology import ici_domain_label
+    cluster = ray_start_cluster
+    nodes = []
+    for i, tpus in enumerate([4, 1, 4, 4]):   # host 1 is mostly busy
+        nodes.append(cluster.add_node(
+            num_cpus=1, num_tpus=tpus,
+            labels=ici_domain_label("v4-16", 0, host_index=i)))
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="STRICT_PACK")
+    assert pg.wait(timeout_seconds=10)
+    table = placement_group_table()
+    assigned = table[pg.id]["assignment"]
+    info = {n["node_id"]: n for n in ray_tpu.nodes()}
+    idxs = sorted(int(info[a]["labels"]["slice_host"]) for a in assigned)
+    # hosts 2,3 form the only adjacent window with 4 chips each
+    assert idxs == [2, 3], idxs
+
+
 def test_pg_removal_frees_resources(ray_start_regular):
     pg = placement_group([{"CPU": 4}], strategy="PACK")
     assert pg.wait(timeout_seconds=5)
