@@ -1,0 +1,98 @@
+"""Sweep flagship GPT-2 train-step configs on the attached chip.
+
+Measures step time for combinations of remat policy, attention impl, and
+chunked CE, so ``bench.py`` can pin the fastest configuration.  Each
+variant runs in-process sequentially; results print one JSON line each to
+stdout (diagnostics to stderr).
+
+Usage: python benchmarks/sweep_flagship.py [--steps 10] [--batch 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def run_variant(name: str, cfg, batch: int, seq: int, steps: int):
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import mesh as mesh_lib, spmd
+    from ray_tpu.parallel.mesh import MeshConfig
+
+    dev = jax.devices()[0]
+    mc = MeshConfig(data=1).resolved(1)
+    mesh = mesh_lib.build_mesh(mc, [dev])
+    prog = spmd.build_train_program(
+        loss_fn=lambda p, b: gpt2.loss_fn(p, b, cfg),
+        init_params_fn=lambda rng: gpt2.init_params(rng, cfg),
+        mesh=mesh, mesh_config=mc)
+    state = prog.init_fn(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    b = spmd.shard_batch(prog, {"inputs": toks[:, :-1],
+                                "targets": toks[:, 1:]})
+    t0 = time.perf_counter()
+    try:
+        state, m = prog.step_fn(state, b)
+        float(jax.device_get(m["loss"]))
+    except Exception as e:  # OOM etc. — report and move on
+        print(json.dumps({"variant": name, "error": str(e)[:200]}))
+        return
+    compile_s = time.perf_counter() - t0
+    state, m = prog.step_fn(state, b)
+    float(jax.device_get(m["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = prog.step_fn(state, b)
+    loss = float(jax.device_get(m["loss"]))
+    step_s = (time.perf_counter() - t0) / steps
+    tok_s = batch * seq / step_s
+    print(json.dumps({"variant": name, "step_ms": round(step_s * 1e3, 2),
+                      "tokens_per_s": round(tok_s, 1),
+                      "compile_s": round(compile_s, 1),
+                      "loss": round(loss, 4)}), flush=True)
+    del state, prog
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--only", type=str, default=None,
+                    help="comma-separated variant names")
+    args = ap.parse_args()
+
+    from ray_tpu.models import gpt2
+
+    base = gpt2.gpt2_small()
+
+    def mk(**kw):
+        return gpt2.GPT2Config(**{**base.__dict__, **kw})
+
+    variants = {
+        "dense_full": mk(),
+        "dense_dots": mk(remat_policy="dots"),
+        "flash_full": mk(attn_impl="flash"),
+        "flash_dots": mk(attn_impl="flash", remat_policy="dots"),
+        "dense_dots_ce8": mk(remat_policy="dots", loss_chunks=8),
+        "flash_dots_ce8": mk(attn_impl="flash", remat_policy="dots",
+                             loss_chunks=8),
+        "dense_full_ce8": mk(loss_chunks=8),
+        "dense_noremat_ce8": mk(remat=False, loss_chunks=8),
+    }
+    picked = (args.only.split(",") if args.only else list(variants))
+    for name in picked:
+        run_variant(name, variants[name], args.batch, args.seq, args.steps)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

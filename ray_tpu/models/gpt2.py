@@ -49,6 +49,11 @@ class GPT2Config:
     # HBM allows).  Ignored when remat=False.
     remat_policy: str = "full"  # full | dots
     attn_impl: str = "dense"   # dense | flash | blockwise | ring | ulysses
+    # >0: compute the LM-head matmul + cross entropy in this many sequence
+    # chunks under jax.checkpoint, so the (B, T, vocab) f32 logits never
+    # materialize (peak activation drops by ~B*T*V*4/chunks bytes; the
+    # chunk logits are recomputed in the backward).  0 = single fused CE.
+    loss_chunks: int = 0
     context_axis: Optional[str] = None  # mesh axis for SP/CP ("context")
     pipeline_axis: Optional[str] = None  # mesh axis for PP ("pipeline")
     num_microbatches: int = 0  # 0 = auto (4x stages, divisor of batch)
@@ -192,9 +197,9 @@ def _block(x: jax.Array, lp: Params, cfg: GPT2Config,
     return x + h
 
 
-def forward(params: Params, tokens: jax.Array,
-            cfg: GPT2Config) -> jax.Array:
-    """tokens (B, T) int32 → logits (B, T, vocab) in f32."""
+def forward_hidden(params: Params, tokens: jax.Array,
+                   cfg: GPT2Config) -> jax.Array:
+    """tokens (B, T) int32 → final-LN hidden states (B, T, E) in cfg.dtype."""
     B, T = tokens.shape
     attn = _resolve_attn(cfg)
     x = params["wte"].astype(cfg.dtype)[tokens]
@@ -249,8 +254,43 @@ def forward(params: Params, tokens: jax.Array,
     else:
         x, _ = lax.scan(scan_body, x, params["blocks"])
     x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return x
+
+
+def forward(params: Params, tokens: jax.Array,
+            cfg: GPT2Config) -> jax.Array:
+    """tokens (B, T) int32 → logits (B, T, vocab) in f32."""
+    x = forward_hidden(params, tokens, cfg)
     logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(cfg.dtype))
     return logits.astype(jnp.float32)
+
+
+def _chunked_ce(x: jax.Array, wte: jax.Array, tgt: jax.Array,
+                n_chunks: int) -> jax.Array:
+    """Mean next-token NLL with the LM head applied per sequence chunk.
+
+    Each chunk's (B, T/c, V) logits live only inside one checkpointed scan
+    step (recomputed in the backward) — the full-sequence logits tensor
+    never exists in HBM.
+    """
+    B, T, E = x.shape
+    if T % n_chunks:
+        raise ValueError(f"seq len {T} not divisible by loss_chunks "
+                         f"{n_chunks}")
+    tc_len = T // n_chunks
+    xc = x.reshape(B, n_chunks, tc_len, E).swapaxes(0, 1)
+    tc = tgt.reshape(B, n_chunks, tc_len).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(acc, chunk):
+        xcb, tcb = chunk
+        logits = jnp.einsum("bte,ve->btv", xcb, wte).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        correct = jnp.take_along_axis(logits, tcb[..., None], -1)[..., 0]
+        return acc + (lse - correct).sum(), None
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xc, tc))
+    return total / (B * T)
 
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array],
@@ -261,6 +301,10 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
         inp, tgt = batch["inputs"], batch["targets"]
     else:
         inp, tgt = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    if cfg.loss_chunks:
+        x = forward_hidden(params, inp, cfg)
+        return _chunked_ce(x, params["wte"].astype(cfg.dtype), tgt,
+                           cfg.loss_chunks)
     logits = forward(params, inp, cfg)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]

@@ -1,4 +1,4 @@
-"""Pallas flash attention (TPU kernel) with a recompute backward.
+"""Pallas flash attention (TPU kernel) with a fused Pallas backward.
 
 Greenfield TPU component (SURVEY.md §5.7): tiled online-softmax attention
 that never materializes the T×T score matrix in HBM.  Each grid step owns
@@ -7,12 +7,16 @@ MXU with running (m, l, acc) accumulators — the classic flash schedule,
 expressed the Pallas way (grid + BlockSpecs; see
 /opt/skills/guides/pallas_guide.md).
 
-Differentiation: the forward runs the Pallas kernel; the backward
-recomputes attention with the pure-JAX blockwise implementation
-(``ray_tpu.ops.attention.blockwise_attention``) and differentiates that —
-numerically identical softmax, O(T·block) memory, no hand-written bwd
-kernel to maintain.  On non-TPU backends the kernel runs in interpret mode
-(CI exercises the same code path).
+Differentiation: the forward kernel additionally emits the per-row
+logsumexp (lane-replicated to a 128-wide minor dim — Mosaic's tiling
+needs ≥(8,128) blocks, so row stats ride a broadcast lane axis, same
+trick as jax.experimental.pallas.ops.tpu.flash_attention); the backward
+is two Pallas kernels (dQ gridded over q-blocks, dK/dV gridded over
+k-blocks) that recompute probabilities from the saved logsumexp and
+compute delta = rowsum(dO·O) in-kernel from the saved output — O(T·block)
+memory, no (B,H,T,T) temporaries, all matmuls on the MXU in the storage
+dtype.  On non-TPU backends the kernels run in interpret mode (CI
+exercises the same code paths).
 """
 
 from __future__ import annotations
@@ -26,13 +30,22 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-from ray_tpu.ops.attention import NEG_INF, blockwise_attention
+from ray_tpu.ops.attention import NEG_INF
 
 DEFAULT_BLOCK = 128
+LANES = 128  # minor-dim replication for row statistics (Mosaic tiling)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                  seq_len: int, causal: bool, scale: float):
+def _expand_rows(stat: jax.Array, n: int) -> jax.Array:
+    """(rows, LANES) lane-replicated stats → (rows, n) for elementwise use
+    against an (rows, n) score tile."""
+    if n % LANES == 0:
+        return jnp.tile(stat, (1, n // LANES))
+    return jnp.broadcast_to(stat[:, :1], (stat.shape[0], n))
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+                  block_k: int, seq_len: int, causal: bool, scale: float):
     qi = pl.program_id(1)
     # Keep q/k/v in their storage dtype (bf16) for the MXU — f32 inputs
     # would quarter matmul throughput; accumulation stays f32 via
@@ -67,26 +80,127 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
     # Causal: block row qi only attends K blocks 0..qi (block_q == block_k).
     nblocks = seq_len // block_k
     upper = jnp.minimum(qi + 1, nblocks) if causal else nblocks
-    acc, _, l = lax.fori_loop(0, upper, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    acc, m, l = lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse = m + jnp.log(l)                              # (block_q,)
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, LANES))
 
 
-def _flash_forward(q, k, v, *, causal: bool, block_size: int,
-                   interpret: Optional[bool]) -> jax.Array:
-    B, T, H, D = q.shape
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
+                   block_q: int, block_k: int, seq_len: int, causal: bool,
+                   scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0]                                      # (block_q, D)
+    do = do_ref[0]
+    lse = lse_ref[0]                                  # (block_q, LANES) f32
+    # delta_i = rowsum(dO_i · O_i), computed here from the saved output —
+    # no separate lane-replicated delta tensor in HBM.
+    delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                    axis=-1)                          # (block_q,)
+    D = q.shape[-1]
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - _expand_rows(lse, block_k))   # (block_q, block_k)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    nblocks = seq_len // block_k
+    upper = jnp.minimum(qi + 1, nblocks) if causal else nblocks
+    dq = lax.fori_loop(0, upper, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
+                    dv_ref, *, block_q: int, block_k: int, seq_len: int,
+                    causal: bool, scale: float):
+    kj = pl.program_id(1)
+    k = k_ref[0]                                      # (block_k, D)
+    v = v_ref[0]
+    D = k.shape[-1]
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]
+        o = o_ref[0, pl.ds(i * block_q, block_q), :]
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)                      # (block_q,)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - _expand_rows(lse, block_k))   # (block_q, block_k)
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    nblocks = seq_len // block_q
+    # Causal: k block kj is only seen by q blocks i ≥ kj (block_q==block_k).
+    lower = jnp.minimum(kj, nblocks) if causal else 0
+    dk, dv = lax.fori_loop(
+        lower, nblocks, body,
+        (jnp.zeros((block_k, D), jnp.float32),
+         jnp.zeros((block_k, D), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flatten(x):
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _unflatten(x, B, H):
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _resolve(block_size, T, interpret):
     bs = min(block_size, T)
     if T % bs:
         raise ValueError(f"seq len {T} not divisible by block {bs}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    return bs, interpret
+
+
+def _flash_forward_lse(q, k, v, *, causal: bool, block_size: int,
+                       interpret: Optional[bool]):
+    B, T, H, D = q.shape
+    bs, interpret = _resolve(block_size, T, interpret)
     scale = 1.0 / math.sqrt(D)
     # (B,T,H,D) -> (B*H, T, D): one grid row per (batch, head).
-    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    qf, kf, vf = _flatten(q), _flatten(k), _flatten(v)
     kernel = functools.partial(_flash_kernel, block_q=bs, block_k=bs,
                                seq_len=T, causal=causal, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // bs),
         in_specs=[
@@ -94,11 +208,57 @@ def _flash_forward(q, k, v, *, causal: bool, block_size: int,
             pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bs, D), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bs, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bs, LANES), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, LANES), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return _unflatten(out, B, H), lse
+
+
+def _flash_backward(q, k, v, out, lse, g, *, causal: bool, block_size: int,
+                    interpret: Optional[bool]):
+    B, T, H, D = q.shape
+    bs, interpret = _resolve(block_size, T, interpret)
+    scale = 1.0 / math.sqrt(D)
+    qf, kf, vf = _flatten(q), _flatten(k), _flatten(v)
+    of = _flatten(out)
+    dof = _flatten(g.astype(q.dtype))
+
+    common = dict(block_q=bs, block_k=bs, seq_len=T, causal=causal,
+                  scale=scale)
+    qspec = pl.BlockSpec((1, bs, D), lambda bh, i: (bh, i, 0))
+    fullspec = pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0))
+    lsespec = pl.BlockSpec((1, bs, LANES), lambda bh, i: (bh, i, 0))
+    lsefull = pl.BlockSpec((1, T, LANES), lambda bh, i: (bh, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B * H, T // bs),
+        in_specs=[qspec, fullspec, fullspec, qspec, qspec, lsespec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, of, dof, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(B * H, T // bs),
+        in_specs=[fullspec, qspec, qspec, fullspec, fullspec, lsefull],
+        out_specs=[qspec, qspec],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, T, D), v.dtype)],
+        interpret=interpret,
+    )(qf, kf, vf, of, dof, lse)
+
+    return (_unflatten(dq, B, H).astype(q.dtype),
+            _unflatten(dk, B, H).astype(k.dtype),
+            _unflatten(dv, B, H).astype(v.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -106,23 +266,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_size: int = DEFAULT_BLOCK,
                     interpret: Optional[bool] = None) -> jax.Array:
     """(B,T,H,D)×3 → (B,T,H,D) tiled attention; differentiable."""
-    return _flash_forward(q, k, v, causal=causal, block_size=block_size,
-                          interpret=interpret)
+    out, _ = _flash_forward_lse(q, k, v, causal=causal,
+                                block_size=block_size, interpret=interpret)
+    return out
 
 
 def _fwd(q, k, v, causal, block_size, interpret):
-    out = _flash_forward(q, k, v, causal=causal, block_size=block_size,
-                         interpret=interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward_lse(q, k, v, causal=causal,
+                                  block_size=block_size, interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_size, interpret, res, g):
-    q, k, v = res
-    # Recompute-and-differentiate through the blockwise flash (remat-style):
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, block_size=block_size), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal=causal,
+                           block_size=block_size, interpret=interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)

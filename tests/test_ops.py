@@ -68,11 +68,24 @@ def test_flash_pallas_matches_dense(qkv, causal):
     _allclose(out, ref)
 
 
-def test_flash_grads(qkv):
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_grads(qkv, causal):
     q, k, v = qkv
-    g = jax.grad(lambda q: flash_attention(q, k, v, True, 16).sum())(q)
-    gd = jax.grad(lambda q: dense_attention(q, k, v, causal=True).sum())(q)
-    _allclose(g, gd, tol=1e-4)
+    # Non-uniform cotangent exercises the full dQ/dK/dV backward kernels.
+    w = jnp.linspace(0.5, 1.5, T)[None, :, None, None]
+
+    def loss(fn):
+        return lambda q_, k_, v_: (fn(q_, k_, v_) * w).sum()
+
+    gq, gk, gv = jax.grad(
+        loss(lambda a, b, c: flash_attention(a, b, c, causal, 16)),
+        argnums=(0, 1, 2))(q, k, v)
+    dq, dk, dv = jax.grad(
+        loss(lambda a, b, c: dense_attention(a, b, c, causal=causal)),
+        argnums=(0, 1, 2))(q, k, v)
+    _allclose(gq, dq, tol=1e-4)
+    _allclose(gk, dk, tol=1e-4)
+    _allclose(gv, dv, tol=1e-4)
 
 
 @pytest.mark.parametrize("causal", [True, False])

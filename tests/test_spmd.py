@@ -54,6 +54,22 @@ def test_gpt2_forward_shapes_and_loss():
     assert 0 < float(loss) < 2 * np.log(cfg.vocab_size)
 
 
+def test_gpt2_chunked_ce_matches_full():
+    cfg = gpt2.tiny(vocab=128, seq=64)
+    cfgc = gpt2.GPT2Config(**{**cfg.__dict__, "loss_chunks": 4})
+    params = gpt2.init_params(jax.random.key(0), cfg)
+    toks = np.random.default_rng(0).integers(0, 128, (2, 65)).astype(np.int32)
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+    l0, g0 = jax.value_and_grad(lambda p: gpt2.loss_fn(p, batch, cfg))(params)
+    l1, g1 = jax.value_and_grad(lambda p: gpt2.loss_fn(p, batch, cfgc))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        # bf16 activations + different reduction order → small noise
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-2)
+
+
 @pytest.mark.parametrize("mc", [
     MeshConfig(data=8),
     MeshConfig(data=2, tensor=4),
