@@ -134,13 +134,67 @@ def write_to(buf: memoryview, pickled: bytes, buffers: List[pickle.PickleBuffer]
     return off
 
 
-def serialize_to_bytes(value: Any) -> Tuple[bytes, List[Any]]:
-    """One-shot: full wire-format bytes (for inline objects / socket transport)."""
-    pickled, buffers, refs = serialize(value)
-    size = serialized_size(pickled, buffers)
-    out = bytearray(size)
+def to_wire_bytes(pickled: bytes,
+                  buffers: List[pickle.PickleBuffer]) -> bytearray:
+    """Assemble the wire layout in memory (for inline/slab objects)."""
+    out = bytearray(serialized_size(pickled, buffers))
     write_to(memoryview(out), pickled, buffers)
-    return bytes(out), refs
+    return out
+
+
+def serialize_to_bytes(value: Any) -> Tuple[bytearray, List[Any]]:
+    """One-shot: full wire-format bytes (for inline objects / socket
+    transport).  Returns a bytearray — callers only need a bytes-like;
+    an extra ``bytes()`` copy would double the cost of every large
+    transfer."""
+    pickled, buffers, refs = serialize(value)
+    return to_wire_bytes(pickled, buffers), refs
+
+
+def write_value_to_fd(fd: int, pickled: bytes,
+                      buffers: List[pickle.PickleBuffer]) -> int:
+    """Stream the wire layout straight to ``fd`` with writev — for the
+    tmpfs segment plane, where write() beats mmap-and-memcpy ~2x (fresh
+    pages fault once in the kernel instead of once per user-space touch).
+    Returns bytes written.  One data copy total: buffers → page cache."""
+    import os
+    views = [_raw_view(b) for b in buffers]
+    head_len = _HDR.size + _ENT.size * len(views)
+    off = _align(head_len + len(pickled))
+    entries = []
+    for v in views:
+        entries.append((off, v.nbytes))
+        off = _align(off + v.nbytes)
+    head = bytearray(_align(head_len + len(pickled)))
+    _HDR.pack_into(head, 0, _MAGIC, len(pickled), len(views))
+    pos = _HDR.size
+    for e in entries:
+        _ENT.pack_into(head, pos, *e)
+        pos += _ENT.size
+    head[head_len:head_len + len(pickled)] = pickled
+
+    iov: List[memoryview] = [memoryview(head)]
+    cursor = len(head)
+    for (boff, blen), v in zip(entries, views):
+        if boff > cursor:                     # alignment gap
+            iov.append(memoryview(bytes(boff - cursor)))
+            cursor = boff
+        iov.append(v)
+        cursor += blen
+    if off > cursor:
+        iov.append(memoryview(bytes(off - cursor)))
+
+    total = 0
+    while iov:
+        n = os.writev(fd, iov[:1024])
+        total += n
+        # drop fully-written segments; re-slice a partial one
+        while iov and n >= iov[0].nbytes:
+            n -= iov[0].nbytes
+            iov.pop(0)
+        if iov and n:
+            iov[0] = iov[0][n:]
+    return total
 
 
 def deserialize_from(buf: memoryview) -> Any:

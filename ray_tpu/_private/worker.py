@@ -81,14 +81,34 @@ def shm_write_wire(oid: str, wire: bytes, overwrite: bool = False) -> None:
     flags = os.O_CREAT | os.O_RDWR | (0 if overwrite else os.O_EXCL)
     fd = os.open(path, flags, 0o600)
     try:
+        # exact final size up front: an overwrite (reconstruction) may be
+        # SMALLER than the old segment — stale tail bytes would corrupt
+        # size accounting (store.adopt) and reads
         os.ftruncate(fd, max(len(wire), 1))
-        mm = mmap.mmap(fd, max(len(wire), 1))
+        # write() over mmap-and-memcpy: fresh tmpfs pages fault once
+        # in-kernel instead of once per user-space touch (~2x)
+        mv = memoryview(wire)
+        while mv.nbytes:
+            mv = mv[os.write(fd, mv):]
     finally:
         os.close(fd)
+
+
+def shm_write_value(oid: str, pickled: bytes, buffers, *,
+                    overwrite: bool = False) -> int:
+    """Serialize straight into the object's shm segment with writev —
+    the single-copy write path for large objects (buffers → page cache,
+    no intermediate wire bytearray).  Returns the segment size."""
+    from ray_tpu._private.serialization import write_value_to_fd
+    path = f"/dev/shm/rtpu_{oid}"
+    flags = os.O_CREAT | os.O_WRONLY | (0 if overwrite else os.O_EXCL)
+    fd = os.open(path, flags, 0o600)
     try:
-        mm[:len(wire)] = wire
+        if overwrite:
+            os.ftruncate(fd, 0)
+        return write_value_to_fd(fd, pickled, buffers)
     finally:
-        mm.close()
+        os.close(fd)
 
 
 class _TaskContext(threading.local):
@@ -222,30 +242,42 @@ class Worker:
 
     # ------------------------------------------------------------ put / get
     def put(self, value: Any, _owner_kind: str = KIND_PUT) -> ObjectRef:
+        from ray_tpu._private.serialization import (serialize,
+                                                    serialized_size,
+                                                    to_wire_bytes)
         oid = ObjectID.make(self.worker_id, _owner_kind, self._put_seq())
-        wire, refs = serialize_to_bytes(value)
+        pickled, buffers, refs = serialize(value)
+        size = serialized_size(pickled, buffers)
         contained = [str(r.id) for r in refs]
         slab = self.slab
-        tiny = len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes or \
-            (self.is_client and
-             len(wire) <= GLOBAL_CONFIG.transfer_chunk_bytes)
+        tiny = size <= GLOBAL_CONFIG.inline_object_max_bytes or \
+            (self.is_client and size <= GLOBAL_CONFIG.transfer_chunk_bytes)
+        wire_cache = []
+
+        def wire():  # assemble at most once across the branch chain
+            if not wire_cache:
+                wire_cache.append(to_wire_bytes(pickled, buffers))
+            return wire_cache[0]
+
         if self.is_client and not tiny:
             # client data plane = control plane (proxied): stream large
             # puts to the head's store in chunks, then register them
-            self._upload_wire(str(oid), wire)
+            self._upload_wire(str(oid), wire())
             self.rpc("put_object", object_id=str(oid), loc="shm",
-                     size=len(wire), contained=contained, node_id=self.node_id)
-        elif slab is not None and len(wire) <= GLOBAL_CONFIG.slab_object_max_bytes \
-                and slab.put(str(oid), wire):
+                     size=size, contained=contained, node_id=self.node_id)
+        elif slab is not None and size <= GLOBAL_CONFIG.slab_object_max_bytes \
+                and slab.put(str(oid), wire()):
             self.rpc("put_object", object_id=str(oid), loc="slab",
-                     size=len(wire), contained=contained, node_id=self.node_id)
+                     size=size, contained=contained, node_id=self.node_id)
         elif tiny:
             # no slab, or slab full/out of slots: tiny objects ride the RPC
-            self.rpc("put_object", object_id=str(oid), loc="inline", data=wire,
-                     size=len(wire), contained=contained, node_id=self.node_id)
+            self.rpc("put_object", object_id=str(oid), loc="inline",
+                     data=wire(),
+                     size=size, contained=contained, node_id=self.node_id)
         else:
-            shm_write_wire(str(oid), wire)
-            self.rpc("put_object", object_id=str(oid), loc="shm", size=len(wire),
+            # single-copy path: buffers stream straight into the segment
+            shm_write_value(str(oid), pickled, buffers)
+            self.rpc("put_object", object_id=str(oid), loc="shm", size=size,
                      contained=contained, node_id=self.node_id)
         return ObjectRef(str(oid), worker=self)
 
@@ -528,6 +560,7 @@ class Worker:
     def create_actor(self, cls: Any, args: tuple, kwargs: dict, *,
                      num_cpus: float = 1, num_tpus: float = 0,
                      resources: Optional[dict] = None,
+                     hold_resources: bool = True,
                      max_restarts: int = 0, max_task_retries: int = 0,
                      max_concurrency: int = 1, name: Optional[str] = None,
                      namespace: str = "default", detached: bool = False,
@@ -550,6 +583,7 @@ class Worker:
         spec = {
             "task_id": task_id, "actor_id": actor_id,
             "is_actor_creation": True,
+            "hold_resources": hold_resources,
             "class_blob_id": class_blob_id,
             "class_name": getattr(cls, "__name__", "Actor"),
             "name": name, "namespace": namespace, "detached": detached,
@@ -664,21 +698,26 @@ class Worker:
                 ctypes.py_object(exc.TaskCancelledError))
 
     def _serialize_result(self, value: Any) -> dict:
-        wire, refs = serialize_to_bytes(value)
+        from ray_tpu._private.serialization import (serialize,
+                                                    serialized_size,
+                                                    to_wire_bytes)
+        pickled, buffers, refs = serialize(value)
+        size = serialized_size(pickled, buffers)
         contained = [str(r.id) for r in refs]
         if self.is_client:
             # no local data plane: small results inline on the control
             # plane; large ones stream to the head's store in chunks
-            if len(wire) <= GLOBAL_CONFIG.transfer_chunk_bytes:
-                return {"loc": "inline", "data": wire, "size": len(wire),
-                        "contained": contained}
-            return {"loc": "upload", "wire": wire, "size": len(wire),
-                    "contained": contained}
-        if len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
-            return {"loc": "inline", "data": wire, "size": len(wire),
-                    "contained": contained}
-        # large: straight to shm
-        return {"loc": "shm", "wire": wire, "size": len(wire),
+            if size <= GLOBAL_CONFIG.transfer_chunk_bytes:
+                return {"loc": "inline", "data": to_wire_bytes(pickled, buffers),
+                        "size": size, "contained": contained}
+            return {"loc": "upload", "wire": to_wire_bytes(pickled, buffers),
+                    "size": size, "contained": contained}
+        if size <= GLOBAL_CONFIG.inline_object_max_bytes:
+            return {"loc": "inline", "data": to_wire_bytes(pickled, buffers),
+                    "size": size, "contained": contained}
+        # large: straight to the data plane, serialized in _store_results
+        # (slab for mid-size, single-copy writev segment for big)
+        return {"loc": "shm", "parts": (pickled, buffers), "size": size,
                 "contained": contained}
 
     def _store_results(self, return_ids: List[str], value: Any,
@@ -694,8 +733,15 @@ class Worker:
         for oid, v in zip(return_ids, values):
             res = self._serialize_result(v)
             if res["loc"] == "shm":
-                res["loc"] = self._write_wire(oid, res.pop("wire"),
-                                              overwrite=True)
+                pickled, buffers = res.pop("parts")
+                slab = self.slab
+                if slab is not None and \
+                        res["size"] <= GLOBAL_CONFIG.slab_object_max_bytes:
+                    from ray_tpu._private.serialization import to_wire_bytes
+                    res["loc"] = self._write_wire(
+                        oid, to_wire_bytes(pickled, buffers), overwrite=True)
+                else:
+                    shm_write_value(oid, pickled, buffers, overwrite=True)
             elif res["loc"] == "upload":
                 self._upload_wire(oid, res.pop("wire"))
                 res["loc"] = "shm"  # now lives in the head's tmpfs plane

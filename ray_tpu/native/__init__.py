@@ -185,9 +185,12 @@ class SlabStore:
     _COPY_UNDER_LOCK_MAX = 65536
 
     # -- object ops ----------------------------------------------------------
-    def put(self, object_id: str, data: bytes) -> bool:
-        """Store bytes. False if full/exists/out of slots."""
+    def put(self, object_id: str, data) -> bool:
+        """Store bytes-like. False if full/exists/out of slots."""
         enc = object_id.encode()
+        if isinstance(data, (bytearray, memoryview)):
+            # ctypes c_char_p args need bytes; slab objects are small
+            data = bytes(data)
         with self._oplock:
             if self._closed:
                 return False
