@@ -1,0 +1,196 @@
+"""Release-style stress workloads (SURVEY.md §4 — ``release/nightly_tests``
+parity: many_tasks, many_actors, many_pgs, object-store stress, chaos).
+
+Each workload prints one JSON line with its throughput and whether it
+completed; the whole suite is the scaled-to-one-host analog of the
+reference's nightly release harness (their numbers come from multi-node
+clusters, so absolute values differ; the contract is completion + a
+tracked rate).
+
+Usage: python benchmarks/release_suite.py [--scale 1.0] [--only name,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def emit(name: str, seconds: float, count: int, unit: str, ok: bool = True,
+         **extra) -> None:
+    print(json.dumps({"workload": name, "ok": ok,
+                      "rate": round(count / seconds, 1), "unit": unit,
+                      "seconds": round(seconds, 2), **extra}), flush=True)
+
+
+def many_tasks(scale: float) -> None:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    n = int(2000 * scale)
+    t0 = time.perf_counter()
+    out = ray_tpu.get([noop.remote(i) for i in range(n)], timeout=600)
+    assert out == list(range(n))
+    emit("many_tasks", time.perf_counter() - t0, n, "tasks/s")
+
+
+def many_actors(scale: float) -> None:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def ping(self):
+            return self.i
+
+    n = int(40 * scale)
+    t0 = time.perf_counter()
+    actors = [A.remote(i) for i in range(n)]
+    out = ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    assert out == list(range(n))
+    create_s = time.perf_counter() - t0
+    # sustained call throughput across the actor fleet
+    t0 = time.perf_counter()
+    calls = [a.ping.remote() for _ in range(10) for a in actors]
+    ray_tpu.get(calls, timeout=600)
+    call_s = time.perf_counter() - t0
+    for a in actors:
+        ray_tpu.kill(a)
+    emit("many_actors", create_s, n, "actors_created/s",
+         calls_per_s=round(len(calls) / call_s, 1))
+
+
+def many_pgs(scale: float) -> None:
+    import ray_tpu
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    n = int(60 * scale)
+    t0 = time.perf_counter()
+    pgs = []
+    for _ in range(n):
+        pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+        assert pg.wait(timeout_seconds=60)
+        pgs.append(pg)
+    created = time.perf_counter() - t0
+    for pg in pgs:
+        remove_placement_group(pg)
+    emit("many_pgs", created, n, "pgs/s")
+
+
+def object_store_stress(scale: float) -> None:
+    import ray_tpu
+
+    n = int(40 * scale)
+    mb = 8
+    arr = np.random.default_rng(0).standard_normal(mb * 1024 * 1024 // 8)
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(arr) for _ in range(n)]
+    # read back a sample through workers (zero-copy map + reduce)
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    outs = ray_tpu.get([total.remote(r) for r in refs[:10]], timeout=600)
+    assert all(abs(o - arr.sum()) < 1e-6 for o in outs)
+    dt = time.perf_counter() - t0
+    emit("object_store_stress", dt, n * mb, "MB_put/s")
+    del refs
+
+
+def actor_churn_chaos(scale: float) -> None:
+    """Kill workers at random under a task+actor workload; assert liveness
+    (the release chaos-test pattern, node-killer scaled to worker-killer)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(max_retries=-1)
+    def work(i):
+        time.sleep(0.01)
+        return i
+
+    @ray_tpu.remote(max_restarts=-1, max_task_retries=-1)
+    class Survivor:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    s = Survivor.remote()
+    stop = threading.Event()
+    kills = [0]
+
+    def killer():
+        while not stop.is_set():
+            time.sleep(0.25)
+            workers = [w for w in state.list_workers()
+                       if w["state"] == "busy" and w.get("pid")]
+            if workers:
+                try:
+                    os.kill(random.choice(workers)["pid"], signal.SIGKILL)
+                    kills[0] += 1
+                except (OSError, KeyError):
+                    pass
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    n = int(300 * scale)
+    t0 = time.perf_counter()
+    out = ray_tpu.get([work.remote(i) for i in range(n)], timeout=900)
+    bumps = ray_tpu.get([s.bump.remote() for _ in range(20)], timeout=900)
+    stop.set()
+    kt.join(timeout=5)
+    assert out == list(range(n)) and bumps[-1] >= 1
+    emit("actor_churn_chaos", time.perf_counter() - t0, n, "tasks/s",
+         kills=kills[0])
+
+
+WORKLOADS = {
+    "many_tasks": many_tasks,
+    "many_actors": many_actors,
+    "many_pgs": many_pgs,
+    "object_store_stress": object_store_stress,
+    "actor_churn_chaos": actor_churn_chaos,
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--only", type=str, default=None)
+    args = ap.parse_args()
+
+    import ray_tpu
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+    names = args.only.split(",") if args.only else list(WORKLOADS)
+    failed = []
+    for name in names:
+        try:
+            WORKLOADS[name](args.scale)
+        except Exception as e:  # noqa: BLE001 - report, keep going
+            emit(name, 1.0, 0, "failed", ok=False, error=str(e)[:200])
+            failed.append(name)
+    ray_tpu.shutdown()
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -917,7 +917,14 @@ class GcsServer:
                 w.state = "actor"
                 w.actor_id = a.actor_id
                 w.current_task = None
-                # actor creation keeps its resources until death — do NOT release
+                if a.spec.get("hold_resources", True):
+                    # explicit num_cpus/num_tpus/resources are held for
+                    # the actor's lifetime (released in _actor_worker_died)
+                    pass
+                else:
+                    # reference default-actor semantics: 1 CPU for
+                    # creation scheduling, 0 held while alive
+                    self._release_task_resources(a.spec)
             else:
                 spec = w.current_task
                 w.current_task = None

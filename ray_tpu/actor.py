@@ -17,7 +17,10 @@ from ray_tpu._private import worker as _worker
 from ray_tpu.util.scheduling_strategies import strategy_to_spec
 
 _ACTOR_DEFAULTS = dict(
-    num_cpus=1, num_tpus=0, resources=None, max_restarts=0,
+    # num_cpus=None means the reference's default actor semantics: 1 CPU
+    # for creation SCHEDULING, 0 held while alive.  Explicit num_cpus /
+    # num_tpus / resources are held for the actor's lifetime.
+    num_cpus=None, num_tpus=0, resources=None, max_restarts=0,
     max_task_retries=0, max_concurrency=1, name=None, namespace="default",
     lifetime=None, get_if_exists=False, scheduling_strategy=None,
     runtime_env=None)
@@ -97,9 +100,13 @@ class ActorClass:
     def remote(self, *args: Any, **kwargs: Any) -> ActorHandle:
         o = self._options
         w = _worker.global_worker()
+        hold = (o["num_cpus"] is not None or bool(o["num_tpus"])
+                or bool(o["resources"]))
         info = w.create_actor(
             self._cls, args, kwargs,
-            num_cpus=o["num_cpus"], num_tpus=o["num_tpus"],
+            hold_resources=hold,
+            num_cpus=1 if o["num_cpus"] is None else o["num_cpus"],
+            num_tpus=o["num_tpus"],
             resources=o["resources"], max_restarts=o["max_restarts"],
             max_task_retries=o["max_task_retries"],
             max_concurrency=o["max_concurrency"],
