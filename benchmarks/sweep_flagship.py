@@ -34,26 +34,28 @@ def run_variant(name: str, cfg, batch: int, seq: int, steps: int):
         loss_fn=lambda p, b: gpt2.loss_fn(p, b, cfg),
         init_params_fn=lambda rng: gpt2.init_params(rng, cfg),
         mesh=mesh, mesh_config=mc)
-    state = prog.init_fn(jax.random.key(0))
-    rng = np.random.default_rng(0)
-    toks = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
-    b = spmd.shard_batch(prog, {"inputs": toks[:, :-1],
-                                "targets": toks[:, 1:]})
-    t0 = time.perf_counter()
     try:
+        state = prog.init_fn(jax.random.key(0))
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, cfg.vocab_size,
+                            (batch, seq + 1)).astype(np.int32)
+        b = spmd.shard_batch(prog, {"inputs": toks[:, :-1],
+                                    "targets": toks[:, 1:]})
+        t0 = time.perf_counter()
         state, m = prog.step_fn(state, b)
         float(jax.device_get(m["loss"]))
-    except Exception as e:  # OOM etc. — report and move on
-        print(json.dumps({"variant": name, "error": str(e)[:200]}))
-        return
-    compile_s = time.perf_counter() - t0
-    state, m = prog.step_fn(state, b)
-    float(jax.device_get(m["loss"]))
-    t0 = time.perf_counter()
-    for _ in range(steps):
+        compile_s = time.perf_counter() - t0
         state, m = prog.step_fn(state, b)
-    loss = float(jax.device_get(m["loss"]))
-    step_s = (time.perf_counter() - t0) / steps
+        float(jax.device_get(m["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = prog.step_fn(state, b)
+        loss = float(jax.device_get(m["loss"]))
+        step_s = (time.perf_counter() - t0) / steps
+    except Exception as e:  # OOM anywhere — report and move to next variant
+        print(json.dumps({"variant": name, "error": str(e)[:200]}),
+              flush=True)
+        return
     tok_s = batch * seq / step_s
     print(json.dumps({"variant": name, "step_ms": round(step_s * 1e3, 2),
                       "tokens_per_s": round(tok_s, 1),
@@ -90,6 +92,10 @@ def main():
         "dense_noremat_ce8": mk(remat=False, loss_chunks=8),
     }
     picked = (args.only.split(",") if args.only else list(variants))
+    unknown = [n for n in picked if n not in variants]
+    if unknown:
+        raise SystemExit(f"unknown variant(s) {unknown}; "
+                         f"valid: {sorted(variants)}")
     for name in picked:
         run_variant(name, variants[name], args.batch, args.seq, args.steps)
 

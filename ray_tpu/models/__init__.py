@@ -12,10 +12,13 @@ llama      Llama-2/3 recipe (RoPE/GQA)  modern decoder flagship
 resnet     ResNet-50 (GN+WS, NHWC)     #2 images/s/chip
 bert       BERT-base encoder           #4 Serve latency/QPS
 moe_transformer  top-k routed MoE      expert-parallel flagship
+vit        ViT-B/16                    vision classification
+t5         t5.1.1-base enc-dec         seq2seq
 ========== =========================== ============================
 """
 
-from ray_tpu.models import bert, gpt2, llama, moe_transformer, resnet  # noqa: F401
+from ray_tpu.models import (bert, gpt2, llama, moe_transformer,  # noqa: F401
+                            resnet, t5, vit)
 
 REGISTRY = {
     "gpt2": gpt2,
@@ -23,6 +26,8 @@ REGISTRY = {
     "resnet": resnet,
     "bert": bert,
     "moe": moe_transformer,
+    "vit": vit,
+    "t5": t5,
 }
 
 

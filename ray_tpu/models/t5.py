@@ -1,0 +1,250 @@
+"""T5 encoder-decoder family (t5.1.1 recipe) — seq2seq on TPU.
+
+Reference contrast: the reference ships no models; encoder-decoder
+workloads run HuggingFace-on-torch inside its Train workers.  TPU-first
+design notes (T5 1.1):
+
+- RMSNorm (no bias, pre-LN), gated-GELU feed-forward, no biases in any
+  projection, untied LM head — the t5.1.1 improvements.
+- Relative position BUCKETS shared across layers (one (heads, q, k) bias
+  tensor per stack, computed once per shape and added to every layer's
+  attention logits — T5's weight-sharing scheme).
+- Encoder and decoder are each stacked-layer ``lax.scan`` stacks (one
+  block compile each); the decoder carries self-attention (causal +
+  relative bias) and cross-attention (no bias) per layer.
+- bf16 activations / f32 params; f32 norms and softmax.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models._common import normal_init as _init
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    n_embd: int = 768            # d_model
+    d_ff: int = 2048             # t5.1.1-base
+    n_layer: int = 12            # per stack
+    n_head: int = 12
+    head_dim: int = 64
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+
+def t5_base() -> T5Config:    # ~250M
+    return T5Config()
+
+
+def t5_large() -> T5Config:   # ~780M
+    return T5Config(n_embd=1024, d_ff=2816, n_layer=24, n_head=16)
+
+
+def tiny(vocab: int = 256) -> T5Config:
+    return T5Config(vocab_size=vocab, n_embd=64, d_ff=128, n_layer=2,
+                    n_head=4, head_dim=16, rel_buckets=8,
+                    rel_max_distance=32)
+
+
+PRESETS = {"t5-base": t5_base, "t5-large": t5_large, "tiny": tiny}
+
+
+# ------------------------------------------------------------------- params
+def _stack_params(k, cfg: T5Config, cross: bool) -> Params:
+    pd = cfg.param_dtype
+    E, L, H, D, F = (cfg.n_embd, cfg.n_layer, cfg.n_head, cfg.head_dim,
+                     cfg.d_ff)
+
+    def stack(shape, scale=None):
+        s = 0.02 if scale is None else scale
+        return jnp.stack([_init(next(k), shape, pd, s) for _ in range(L)])
+
+    p = {
+        "ln_attn": {"scale": jnp.ones((L, E), pd)},
+        "attn_q": stack((E, H * D), (E * D) ** -0.5),
+        "attn_k": stack((E, H * D), E ** -0.5),
+        "attn_v": stack((E, H * D), E ** -0.5),
+        "attn_o": stack((H * D, E), (H * D) ** -0.5),
+        "ln_mlp": {"scale": jnp.ones((L, E), pd)},
+        "wi_0": stack((E, F), E ** -0.5),   # gated gelu: gate
+        "wi_1": stack((E, F), E ** -0.5),   # gated gelu: value
+        "wo": stack((F, E), F ** -0.5),
+    }
+    if cross:
+        p["ln_cross"] = {"scale": jnp.ones((L, E), pd)}
+        p["cross_q"] = stack((E, H * D), (E * D) ** -0.5)
+        p["cross_k"] = stack((E, H * D), E ** -0.5)
+        p["cross_v"] = stack((E, H * D), E ** -0.5)
+        p["cross_o"] = stack((H * D, E), (H * D) ** -0.5)
+    return p
+
+
+def init_params(rng: jax.Array, cfg: T5Config) -> Params:
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(rng, 8 + 24 * cfg.n_layer))
+    return {
+        "shared_embed": _init(next(k), (cfg.vocab_size, cfg.n_embd), pd,
+                              1.0),
+        "enc_rel_bias": _init(next(k), (cfg.rel_buckets, cfg.n_head), pd),
+        "dec_rel_bias": _init(next(k), (cfg.rel_buckets, cfg.n_head), pd),
+        "encoder": _stack_params(k, cfg, cross=False),
+        "decoder": _stack_params(k, cfg, cross=True),
+        "enc_ln_f": {"scale": jnp.ones((cfg.n_embd,), pd)},
+        "dec_ln_f": {"scale": jnp.ones((cfg.n_embd,), pd)},
+        "lm_head": _init(next(k), (cfg.n_embd, cfg.vocab_size), pd,
+                         cfg.n_embd ** -0.5),
+    }
+
+
+# ------------------------------------------------------------------ forward
+def _rms_norm(x, scale, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    var = (x32 * x32).mean(-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def _relative_buckets(rel: jax.Array, num_buckets: int, max_dist: int,
+                      bidirectional: bool) -> jax.Array:
+    """T5's log-bucketed relative positions (reference recipe)."""
+    ret = jnp.zeros_like(rel)
+    n = -rel
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / math.log(max_dist / max_exact) * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, large)
+
+
+def _rel_bias(table: jax.Array, q_len: int, k_len: int, cfg: T5Config,
+              bidirectional: bool) -> jax.Array:
+    """(buckets, H) table → (1, H, q, k) bias, f32."""
+    ctx = jnp.arange(q_len)[:, None]
+    mem = jnp.arange(k_len)[None, :]
+    buckets = _relative_buckets(mem - ctx, cfg.rel_buckets,
+                                cfg.rel_max_distance, bidirectional)
+    bias = table.astype(jnp.float32)[buckets]        # (q, k, H)
+    return bias.transpose(2, 0, 1)[None]
+
+
+def _attn(q, k, v, bias, cfg: T5Config):
+    """(B,T,H*D)×3 + (1|B,H,q,k) bias → (B,q,H*D).  T5 does NOT scale
+    logits by sqrt(D) (folded into init)."""
+    B, Tq = q.shape[:2]
+    Tk = k.shape[1]
+    H, D = cfg.n_head, cfg.head_dim
+    q = q.reshape(B, Tq, H, D)
+    k = k.reshape(B, Tk, H, D)
+    v = v.reshape(B, Tk, H, D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias
+    probs = jax.nn.softmax(logits, -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, Tq, H * D)
+
+
+def _ff(x, lp, cfg: T5Config):
+    h = _rms_norm(x, lp["ln_mlp"]["scale"])
+    gate = jax.nn.gelu(h @ lp["wi_0"].astype(cfg.dtype), approximate=True)
+    up = h @ lp["wi_1"].astype(cfg.dtype)
+    return x + (gate * up) @ lp["wo"].astype(cfg.dtype)
+
+
+def _enc_block(x, lp, bias, cfg: T5Config):
+    h = _rms_norm(x, lp["ln_attn"]["scale"])
+    a = _attn(h @ lp["attn_q"].astype(cfg.dtype),
+              h @ lp["attn_k"].astype(cfg.dtype),
+              h @ lp["attn_v"].astype(cfg.dtype), bias, cfg)
+    x = x + a @ lp["attn_o"].astype(cfg.dtype)
+    return _ff(x, lp, cfg)
+
+
+def _dec_block(x, lp, enc, self_bias, cfg: T5Config):
+    h = _rms_norm(x, lp["ln_attn"]["scale"])
+    a = _attn(h @ lp["attn_q"].astype(cfg.dtype),
+              h @ lp["attn_k"].astype(cfg.dtype),
+              h @ lp["attn_v"].astype(cfg.dtype), self_bias, cfg)
+    x = x + a @ lp["attn_o"].astype(cfg.dtype)
+    h = _rms_norm(x, lp["ln_cross"]["scale"])
+    a = _attn(h @ lp["cross_q"].astype(cfg.dtype),
+              enc @ lp["cross_k"].astype(cfg.dtype),
+              enc @ lp["cross_v"].astype(cfg.dtype), None, cfg)
+    x = x + a @ lp["cross_o"].astype(cfg.dtype)
+    return _ff(x, lp, cfg)
+
+
+def encode(params: Params, input_ids: jax.Array, cfg: T5Config) -> jax.Array:
+    x = params["shared_embed"].astype(cfg.dtype)[input_ids]
+    T = input_ids.shape[1]
+    bias = _rel_bias(params["enc_rel_bias"], T, T, cfg, bidirectional=True)
+    block = partial(_enc_block, bias=bias, cfg=cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    x, _ = lax.scan(lambda c, lp: (block(c, lp), None), x,
+                    params["encoder"])
+    return _rms_norm(x, params["enc_ln_f"]["scale"])
+
+
+def decode(params: Params, decoder_ids: jax.Array, enc: jax.Array,
+           cfg: T5Config) -> jax.Array:
+    x = params["shared_embed"].astype(cfg.dtype)[decoder_ids]
+    T = decoder_ids.shape[1]
+    bias = _rel_bias(params["dec_rel_bias"], T, T, cfg, bidirectional=False)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    bias = jnp.where(causal[None, None], bias, jnp.float32(-1e9))
+    block = partial(_dec_block, enc=enc, self_bias=bias, cfg=cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    x, _ = lax.scan(lambda c, lp: (block(c, lp), None), x,
+                    params["decoder"])
+    x = _rms_norm(x, params["dec_ln_f"]["scale"])
+    return jnp.einsum("bte,ev->btv",
+                      x, params["lm_head"].astype(cfg.dtype)
+                      ).astype(jnp.float32)
+
+
+def forward(params: Params, input_ids: jax.Array, decoder_ids: jax.Array,
+            cfg: T5Config) -> jax.Array:
+    """(B,S) encoder ids + (B,T) decoder ids → (B,T,vocab) f32 logits."""
+    return decode(params, decoder_ids, encode(params, input_ids, cfg), cfg)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: T5Config) -> jax.Array:
+    """batch: {"inputs": (B,S), "decoder_inputs": (B,T), "targets": (B,T)}
+    → mean teacher-forced CE."""
+    logits = forward(params, batch["inputs"], batch["decoder_inputs"], cfg)
+    logp = jax.nn.log_softmax(logits, -1)
+    return -jnp.take_along_axis(
+        logp, batch["targets"][..., None], -1).mean()
+
+
+def param_count_analytic(cfg: T5Config) -> int:
+    E, L, HD, F = (cfg.n_embd, cfg.n_layer, cfg.n_head * cfg.head_dim,
+                   cfg.d_ff)
+    enc_layer = 3 * E * HD + HD * E + 2 * E * F + F * E + 2 * E
+    dec_layer = enc_layer + 3 * E * HD + HD * E + E
+    shared = cfg.vocab_size * E * 2 + 2 * cfg.rel_buckets * cfg.n_head + 2 * E
+    return shared + L * (enc_layer + dec_layer)

@@ -1,0 +1,187 @@
+"""Vision Transformer (ViT-B/16 flagship) — image classification on TPU.
+
+Reference contrast: the reference ships no models; its vision benchmarks
+run torchvision inside Train workers (reference: ``python/ray/train/``
+examples).  TPU-first design notes:
+
+- Patch embedding is a reshape + ONE matmul (``bhwc→b(hw)(ppc)`` then
+  ``(ppc,E)``), not a conv — identical math for non-overlapping patches
+  and it lands directly on the MXU with no im2col.
+- Stacked per-layer params + ``lax.scan`` over blocks (one block compile),
+  pre-LN transformer, learned position embeddings, CLS token readout —
+  the ViT paper recipe.
+- bf16 activations / f32 params, f32 layer norms and softmax; remat knob.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models._common import normal_init as _init
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def vit_b16() -> ViTConfig:    # 86M
+    return ViTConfig()
+
+
+def vit_l16() -> ViTConfig:    # 307M
+    return ViTConfig(n_embd=1024, n_layer=24, n_head=16)
+
+
+def tiny(image_size: int = 32, patch_size: int = 8,
+         num_classes: int = 10) -> ViTConfig:
+    return ViTConfig(image_size=image_size, patch_size=patch_size,
+                     num_classes=num_classes, n_embd=64, n_layer=2, n_head=4)
+
+
+PRESETS = {"vit-b16": vit_b16, "vit-l16": vit_l16, "tiny": tiny}
+
+
+def init_params(rng: jax.Array, cfg: ViTConfig) -> Params:
+    pd = cfg.param_dtype
+    E, L, H = cfg.n_embd, cfg.n_layer, cfg.n_head
+    P, C = cfg.patch_size, 3
+    M = cfg.mlp_ratio * E
+    k = iter(jax.random.split(rng, 10 + 4 * L))
+
+    def stack(shape, scale=0.02):
+        return jnp.stack([_init(next(k), shape, pd, scale) for _ in range(L)])
+
+    blocks = {
+        "ln_1": {"scale": jnp.ones((L, E), pd), "bias": jnp.zeros((L, E), pd)},
+        "attn_qkv": {"kernel": stack((E, 3, E)),
+                     "bias": jnp.zeros((L, 3, E), pd)},
+        "attn_out": {"kernel": stack((E, E), 0.02 / math.sqrt(2 * L)),
+                     "bias": jnp.zeros((L, E), pd)},
+        "ln_2": {"scale": jnp.ones((L, E), pd), "bias": jnp.zeros((L, E), pd)},
+        "mlp_in": {"kernel": stack((E, M)), "bias": jnp.zeros((L, M), pd)},
+        "mlp_out": {"kernel": stack((M, E), 0.02 / math.sqrt(2 * L)),
+                    "bias": jnp.zeros((L, E), pd)},
+    }
+    return {
+        "patch_embed": {"kernel": _init(next(k), (P * P * C, E), pd),
+                        "bias": jnp.zeros((E,), pd)},
+        "cls_token": jnp.zeros((1, 1, E), pd),
+        "pos_embed": _init(next(k), (cfg.num_patches + 1, E), pd, 0.02),
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.ones((E,), pd), "bias": jnp.zeros((E,), pd)},
+        "head": {"kernel": jnp.zeros((E, cfg.num_classes), pd),
+                 "bias": jnp.zeros((cfg.num_classes,), pd)},
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _attention(q, k, v):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x, lp, cfg: ViTConfig):
+    B, T, E = x.shape
+    H, D = cfg.n_head, cfg.head_dim
+    h = _layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"])
+    qkv = jnp.einsum("bte,eck->btck",
+                     h, lp["attn_qkv"]["kernel"].astype(cfg.dtype))
+    qkv = qkv + lp["attn_qkv"]["bias"].astype(cfg.dtype)
+    q, k, v = [qkv[:, :, i, :].reshape(B, T, H, D) for i in range(3)]
+    a = _attention(q, k, v).reshape(B, T, E)
+    x = x + (a @ lp["attn_out"]["kernel"].astype(cfg.dtype)
+             + lp["attn_out"]["bias"].astype(cfg.dtype))
+    h = _layer_norm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"])
+    h = h @ lp["mlp_in"]["kernel"].astype(cfg.dtype) \
+        + lp["mlp_in"]["bias"].astype(cfg.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ lp["mlp_out"]["kernel"].astype(cfg.dtype) \
+        + lp["mlp_out"]["bias"].astype(cfg.dtype)
+    return x + h
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """(B, H, W, C) → (B, num_patches, patch*patch*C): pure reshape —
+    non-overlapping conv == matmul over flattened patches."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def forward(params: Params, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """images (B, H, W, C) float → logits (B, num_classes) f32."""
+    B = images.shape[0]
+    x = patchify(images.astype(cfg.dtype), cfg.patch_size)
+    x = x @ params["patch_embed"]["kernel"].astype(cfg.dtype) \
+        + params["patch_embed"]["bias"].astype(cfg.dtype)
+    cls = jnp.broadcast_to(params["cls_token"].astype(cfg.dtype),
+                           (B, 1, cfg.n_embd))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)[None]
+
+    block = partial(_block, cfg=cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def body(carry, lp):
+        return block(carry, lp), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _layer_norm(x[:, 0], params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = x @ params["head"]["kernel"].astype(cfg.dtype) \
+        + params["head"]["bias"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: ViTConfig) -> jax.Array:
+    """batch: {"images": (B,H,W,C), "labels": (B,) int32} → mean CE."""
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits, -1)
+    return -jnp.take_along_axis(
+        logp, batch["labels"][:, None], -1).mean()
+
+
+def param_count_analytic(cfg: ViTConfig) -> int:
+    E, L, M = cfg.n_embd, cfg.n_layer, cfg.mlp_ratio * cfg.n_embd
+    per_layer = 4 * E * E + 4 * E + 2 * E * M + E + M + 4 * E
+    stem = (cfg.patch_size ** 2 * 3 + 1) * E + (cfg.num_patches + 1) * E + E
+    head = (E + 1) * cfg.num_classes + 2 * E
+    return stem + L * per_layer + head

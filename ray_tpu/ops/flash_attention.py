@@ -44,7 +44,7 @@ def _expand_rows(stat: jax.Array, n: int) -> jax.Array:
     return jnp.broadcast_to(stat[:, :1], (stat.shape[0], n))
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *lse_out, block_q: int,
                   block_k: int, seq_len: int, causal: bool, scale: float):
     qi = pl.program_id(1)
     # Keep q/k/v in their storage dtype (bf16) for the MXU — f32 inputs
@@ -83,8 +83,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     acc, m, l = lax.fori_loop(0, upper, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse = m + jnp.log(l)                              # (block_q,)
-    lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, LANES))
+    if lse_out:                                       # vjp forward only
+        lse = m + jnp.log(l)                          # (block_q,)
+        lse_out[0][0] = jnp.broadcast_to(lse[:, None], (block_q, LANES))
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
@@ -192,7 +193,10 @@ def _resolve(block_size, T, interpret):
 
 
 def _flash_forward_lse(q, k, v, *, causal: bool, block_size: int,
-                       interpret: Optional[bool]):
+                       interpret: Optional[bool], want_lse: bool = True):
+    """``want_lse=False`` (the primal / inference path) skips computing
+    and writing the lane-replicated lse tensor — it is only a residual
+    for the fused backward, and Pallas cannot DCE a declared output."""
     B, T, H, D = q.shape
     bs, interpret = _resolve(block_size, T, interpret)
     scale = 1.0 / math.sqrt(D)
@@ -200,7 +204,14 @@ def _flash_forward_lse(q, k, v, *, causal: bool, block_size: int,
     qf, kf, vf = _flatten(q), _flatten(k), _flatten(v)
     kernel = functools.partial(_flash_kernel, block_q=bs, block_k=bs,
                                seq_len=T, causal=causal, scale=scale)
-    out, lse = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, bs, D), lambda bh, qi: (bh, qi, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, T, D), q.dtype)]
+    if want_lse:
+        out_specs.append(
+            pl.BlockSpec((1, bs, LANES), lambda bh, qi: (bh, qi, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, T, LANES), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=(B * H, T // bs),
         in_specs=[
@@ -208,16 +219,11 @@ def _flash_forward_lse(q, k, v, *, causal: bool, block_size: int,
             pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, bs, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bs, LANES), lambda bh, qi: (bh, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, T, LANES), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(qf, kf, vf)
+    out, lse = res if want_lse else (res[0], None)
     return _unflatten(out, B, H), lse
 
 
@@ -267,7 +273,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     interpret: Optional[bool] = None) -> jax.Array:
     """(B,T,H,D)×3 → (B,T,H,D) tiled attention; differentiable."""
     out, _ = _flash_forward_lse(q, k, v, causal=causal,
-                                block_size=block_size, interpret=interpret)
+                                block_size=block_size, interpret=interpret,
+                                want_lse=False)
     return out
 
 

@@ -6,20 +6,24 @@ learner is a jitted XLA program (PPO: all SGD epochs in one jit; IMPALA:
 V-trace update) that on TPU hardware runs on the chip.
 """
 
-from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
-from ray_tpu.rllib.env import RandomEnv, VectorEnv, register_env
+from ray_tpu.rllib.sample_batch import (MultiAgentBatch, SampleBatch,
+                                        concat_samples)
+from ray_tpu.rllib.env import (MultiAgentEnv, RandomEnv, VectorEnv,
+                               make_multi_agent, register_env)
 from ray_tpu.rllib.policy import Policy, compute_gae
 from ray_tpu.rllib.evaluation import (
     RolloutWorker, WorkerSet, collect_metrics, synchronous_parallel_sample)
+from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
 from ray_tpu.rllib.algorithms import (
     Algorithm, AlgorithmConfig, DQN, DQNConfig, IMPALA, IMPALAConfig, PPO,
     PPOConfig)
 from ray_tpu.rllib.algorithms.impala import vtrace
 
 __all__ = [
-    "SampleBatch", "concat_samples", "RandomEnv", "VectorEnv",
-    "register_env", "Policy", "compute_gae", "RolloutWorker", "WorkerSet",
-    "collect_metrics", "synchronous_parallel_sample", "Algorithm",
-    "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA", "IMPALAConfig",
-    "DQN", "DQNConfig", "vtrace",
+    "SampleBatch", "MultiAgentBatch", "concat_samples", "RandomEnv",
+    "VectorEnv", "register_env", "MultiAgentEnv", "make_multi_agent",
+    "Policy", "compute_gae", "RolloutWorker", "MultiAgentRolloutWorker",
+    "WorkerSet", "collect_metrics", "synchronous_parallel_sample",
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
+    "IMPALAConfig", "DQN", "DQNConfig", "vtrace",
 ]

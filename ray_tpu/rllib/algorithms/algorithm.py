@@ -61,6 +61,23 @@ class AlgorithmConfig:
         self._cfg.update(kw)
         return self
 
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None,
+                    **kw) -> "AlgorithmConfig":
+        """Reference: ``AlgorithmConfig.multi_agent(policies=...,
+        policy_mapping_fn=...)``.  ``policies`` may be a set/list of ids
+        (all-default policies) or {pid: (cls, obs_space, act_space,
+        config)} specs."""
+        ma = dict(self._cfg.get("multiagent") or {})
+        if policies is not None:
+            if isinstance(policies, (set, list, tuple)):
+                policies = {pid: None for pid in policies}
+            ma["policies"] = dict(policies)
+        if policy_mapping_fn is not None:
+            ma["policy_mapping_fn"] = policy_mapping_fn
+        ma.update(kw)
+        self._cfg["multiagent"] = ma
+        return self
+
     def framework(self, *_a, **_kw):  # jax-only; accepted for API parity
         return self
 
@@ -86,6 +103,10 @@ class Algorithm:
     ``training_step``."""
 
     _default_config_cls = AlgorithmConfig
+    # Algorithms that can consume a MultiAgentBatch opt in; everything
+    # else must fail loudly at build time, not with an obscure TypeError
+    # deep inside training_step.
+    _supports_multi_agent = False
 
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
@@ -111,6 +132,10 @@ class Algorithm:
             base["env"] = env
         if base.get("env") is None:
             raise ValueError("no env specified")
+        if base.get("multiagent") and not self._supports_multi_agent:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support multi-agent "
+                f"training (PPO does); remove the multi_agent(...) config")
         self.config = base
         self.iteration = 0
         self._timesteps_total = 0

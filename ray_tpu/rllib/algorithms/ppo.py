@@ -38,25 +38,36 @@ class PPOConfig(AlgorithmConfig):
 
 class PPO(Algorithm):
     _default_config_cls = PPOConfig
+    _supports_multi_agent = True
 
     def setup(self, config: Dict[str, Any]) -> None:
         cfg = config
-        policy = self.workers.local_worker.policy
+        lw = self.workers.local_worker
+        self._ma = hasattr(lw, "policies")
+        if self._ma:
+            # one learner (update fn + optimizer state + adaptive KL) per
+            # policy in the map (reference: multi-agent train_one_step)
+            self._learners = {
+                pid: self._build_learner(pol, cfg)
+                for pid, pol in lw.policies.items()}
+        else:
+            self._learners = {"default_policy":
+                              self._build_learner(lw.policy, cfg)}
+        self._kl_target = float(cfg["kl_target"])
+        self._key = jax.random.key(cfg.get("seed") or 0)
+
+    def _build_learner(self, policy, cfg) -> Dict[str, Any]:
         apply_fn = policy.apply_fn
         dist = policy.dist_class
-        self._optimizer = optax.chain(
+        optimizer = optax.chain(
             optax.clip_by_global_norm(cfg["grad_clip"]),
             optax.adam(cfg["lr"]))
-        self._opt_state = self._optimizer.init(policy.params)
-        self._kl_coeff = float(cfg["kl_coeff"])
         clip = cfg["clip_param"]
         vf_clip = cfg["vf_clip_param"]
         vf_coeff = cfg["vf_loss_coeff"]
         ent_coeff = cfg["entropy_coeff"]
-        kl_target = cfg["kl_target"]
         num_epochs = int(cfg["num_sgd_iter"])
         mb_size = int(cfg["sgd_minibatch_size"])
-        optimizer = self._optimizer
 
         def loss_fn(params, mb, kl_coeff):
             inputs, values = apply_fn(params, mb[OBS])
@@ -112,34 +123,53 @@ class PPO(Algorithm):
                 "kl": kl, "entropy": entropy, "vf_loss": vf_loss,
                 "policy_loss": pi_loss}
 
-        self._update = jax.jit(update)
-        self._key = jax.random.key(cfg.get("seed") or 0)
-        self._kl_target = kl_target
+        return {"policy": policy, "update": jax.jit(update),
+                "opt_state": optimizer.init(policy.params),
+                "kl_coeff": float(cfg["kl_coeff"])}
 
-    def training_step(self) -> Dict[str, Any]:
-        batch = synchronous_parallel_sample(self.workers)
-        policy = self.workers.local_worker.policy
+    def _update_one(self, learner: Dict[str, Any],
+                    batch: SampleBatch) -> Dict[str, float]:
+        policy = learner["policy"]
         device_batch = {k: jnp.asarray(batch[k]) for k in
                         (OBS, ACTIONS, ACTION_LOGP, ACTION_DIST_INPUTS,
                          ADVANTAGES, VALUE_TARGETS, VF_PREDS)}
         self._key, sub = jax.random.split(self._key)
-        policy.params, self._opt_state, info = self._update(
-            policy.params, self._opt_state, device_batch,
-            self._kl_coeff, sub)
+        policy.params, learner["opt_state"], info = learner["update"](
+            policy.params, learner["opt_state"], device_batch,
+            learner["kl_coeff"], sub)
         info = {k: float(v) for k, v in info.items()}
         # Adaptive KL penalty (reference: ``update_kl``).
         if info["kl"] > 2.0 * self._kl_target:
-            self._kl_coeff *= 1.5
+            learner["kl_coeff"] *= 1.5
         elif info["kl"] < 0.5 * self._kl_target:
-            self._kl_coeff *= 0.5
-        info["kl_coeff"] = self._kl_coeff
+            learner["kl_coeff"] *= 0.5
+        info["kl_coeff"] = learner["kl_coeff"]
+        return info
+
+    def training_step(self) -> Dict[str, Any]:
+        batch = synchronous_parallel_sample(self.workers)
+        if self._ma:
+            info: Dict[str, Any] = {}
+            for pid, sb in batch.policy_batches.items():
+                if sb.count:
+                    info[pid] = self._update_one(self._learners[pid], sb)
+        else:
+            info = self._update_one(self._learners["default_policy"], batch)
         info["num_env_steps_trained"] = batch.count
         self.workers.sync_weights()
         return info
 
     def get_extra_state(self):
-        return {"kl_coeff": self._kl_coeff}
+        return {"kl_coeff": {pid: l["kl_coeff"]
+                             for pid, l in self._learners.items()}}
 
     def set_extra_state(self, state):
-        if state:
-            self._kl_coeff = state["kl_coeff"]
+        if state and "kl_coeff" in state:
+            kc = state["kl_coeff"]
+            if isinstance(kc, dict):
+                for pid, v in kc.items():
+                    if pid in self._learners:
+                        self._learners[pid]["kl_coeff"] = v
+            else:  # pre-multi-agent checkpoints
+                for l in self._learners.values():
+                    l["kl_coeff"] = kc

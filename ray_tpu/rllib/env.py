@@ -156,3 +156,68 @@ class VectorEnv:
         return (np.stack(obs), np.stack(finals),
                 np.asarray(rews, np.float32),
                 np.asarray(terms), np.asarray(truncs))
+
+
+# ---------------------------------------------------------------- multi-agent
+class MultiAgentEnv:
+    """Multi-agent env API (reference: ``rllib/env/multi_agent_env.py``).
+
+    ``reset() -> (obs_dict, info_dict)``; ``step(action_dict) ->
+    (obs, rewards, terminateds, truncateds, infos)`` — all keyed by agent
+    id; ``terminateds``/``truncateds`` additionally carry ``"__all__"``.
+    Agents that are done stop appearing in subsequent dicts.
+    """
+
+    agents: list
+    observation_space: Any = None   # per-agent space (homogeneous default)
+    action_space: Any = None
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
+def make_multi_agent(env_name_or_creator):
+    """Lift a single-agent env into an N-agent ``MultiAgentEnv`` of
+    independent copies (reference: ``ray.rllib.env.make_multi_agent``).
+    ``env_config["num_agents"]`` picks N (default 2)."""
+
+    class _IndependentMultiAgent(MultiAgentEnv):
+        def __init__(self, config: Optional[dict] = None):
+            config = dict(config or {})
+            self.num_agents = int(config.pop("num_agents", 2))
+            if isinstance(env_name_or_creator, str):
+                mk = lambda: create_env(env_name_or_creator, config)  # noqa: E731
+            else:
+                mk = lambda: env_name_or_creator(config)  # noqa: E731
+            self.envs = [mk() for _ in range(self.num_agents)]
+            self.agents = [f"agent_{i}" for i in range(self.num_agents)]
+            self.observation_space = self.envs[0].observation_space
+            self.action_space = self.envs[0].action_space
+            self._done = [False] * self.num_agents
+
+        def reset(self, seed: Optional[int] = None):
+            obs, infos = {}, {}
+            for i, (aid, e) in enumerate(zip(self.agents, self.envs)):
+                o, inf = e.reset(seed=None if seed is None else seed + i)
+                obs[aid], infos[aid] = o, inf
+            self._done = [False] * self.num_agents
+            return obs, infos
+
+        def step(self, action_dict: Dict[str, Any]):
+            obs, rews, terms, truncs, infos = {}, {}, {}, {}, {}
+            for i, (aid, e) in enumerate(zip(self.agents, self.envs)):
+                if self._done[i] or aid not in action_dict:
+                    continue
+                o, r, term, trunc, inf = e.step(action_dict[aid])
+                obs[aid], rews[aid], infos[aid] = o, float(r), inf
+                terms[aid], truncs[aid] = bool(term), bool(trunc)
+                if term or trunc:
+                    self._done[i] = True
+            terms["__all__"] = all(self._done)
+            truncs["__all__"] = False
+            return obs, rews, terms, truncs, infos
+
+    return _IndependentMultiAgent

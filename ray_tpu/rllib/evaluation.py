@@ -130,9 +130,13 @@ class WorkerSet:
 
     def __init__(self, config: Dict[str, Any]):
         self.config = config
-        self.local_worker = RolloutWorker(config, worker_index=0)
+        worker_cls = RolloutWorker
+        if config.get("multiagent"):
+            from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
+            worker_cls = MultiAgentRolloutWorker
+        self.local_worker = worker_cls(config, worker_index=0)
         num_workers = int(config.get("num_workers", 0))
-        remote_cls = ray_tpu.remote(RolloutWorker).options(
+        remote_cls = ray_tpu.remote(worker_cls).options(
             num_cpus=config.get("num_cpus_per_worker", 1))
         self.remote_workers: List = [
             remote_cls.remote(config, worker_index=i + 1)
@@ -160,6 +164,9 @@ def synchronous_parallel_sample(worker_set: WorkerSet) -> SampleBatch:
             [w.sample.remote() for w in worker_set.remote_workers])
     else:
         batches = [worker_set.local_worker.sample()]
+    from ray_tpu.rllib.sample_batch import MultiAgentBatch
+    if isinstance(batches[0], MultiAgentBatch):
+        return MultiAgentBatch.concat_samples(batches)
     return concat_samples(batches)
 
 

@@ -98,3 +98,39 @@ class SampleBatch(dict):
 
 def concat_samples(batches: Sequence[SampleBatch]) -> SampleBatch:
     return SampleBatch.concat_samples(batches)
+
+
+class MultiAgentBatch:
+    """Per-policy batches from one multi-agent sampling round (reference:
+    ``rllib/policy/sample_batch.py::MultiAgentBatch``)."""
+
+    def __init__(self, policy_batches: Dict[str, SampleBatch],
+                 env_steps: int):
+        self.policy_batches = dict(policy_batches)
+        self._env_steps = int(env_steps)
+
+    def env_steps(self) -> int:
+        return self._env_steps
+
+    @property
+    def count(self) -> int:
+        return self._env_steps
+
+    def agent_steps(self) -> int:
+        return sum(b.count for b in self.policy_batches.values())
+
+    @staticmethod
+    def concat_samples(batches: Sequence["MultiAgentBatch"]) -> "MultiAgentBatch":
+        per_policy: Dict[str, List[SampleBatch]] = {}
+        steps = 0
+        for b in batches:
+            steps += b.env_steps()
+            for pid, sb in b.policy_batches.items():
+                per_policy.setdefault(pid, []).append(sb)
+        return MultiAgentBatch(
+            {pid: SampleBatch.concat_samples(v)
+             for pid, v in per_policy.items()}, steps)
+
+    def __repr__(self) -> str:
+        return (f"MultiAgentBatch(env_steps={self._env_steps}, "
+                f"{ {p: b.count for p, b in self.policy_batches.items()} })")
