@@ -44,6 +44,12 @@ def _platform_peak(device) -> float:
 
 
 def main() -> None:
+    import os
+
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    if GLOBAL_CONFIG.xla_cache_dir:
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                              GLOBAL_CONFIG.xla_cache_dir)
     import jax
     import numpy as np
 

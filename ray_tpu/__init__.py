@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import atexit
 import os
+import sys
 import threading
 import time
 from typing import Any, List, Optional, Sequence, Tuple, Union
@@ -74,6 +75,19 @@ def init(address: Optional[str] = None, *,
             raise RuntimeError("ray_tpu.init() called twice "
                                "(pass ignore_reinit_error=True to allow)")
         GLOBAL_CONFIG.apply_system_config(_system_config)
+        if GLOBAL_CONFIG.xla_cache_dir:
+            # persistent XLA compile cache for the driver process too;
+            # effective even if jax is already imported (config knob),
+            # harmless when no TPU is attached
+            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                                  GLOBAL_CONFIG.xla_cache_dir)
+            if "jax" in sys.modules:
+                try:
+                    sys.modules["jax"].config.update(
+                        "jax_compilation_cache_dir",
+                        GLOBAL_CONFIG.xla_cache_dir)
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
         from ray_tpu._private.gcs import GcsServer
 
         if address is None or address == "local":

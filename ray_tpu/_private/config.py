@@ -93,6 +93,11 @@ _FLAG_DEFS = [
           "Device-holding worker processes per node (concurrent jax inits "
           "contend for the same chips; raise only with per-worker chip "
           "partitioning, e.g. TPU_VISIBLE_DEVICES plumbing)."),
+    _flag("xla_cache_dir", "/tmp/rtpu_xla_cache",
+          "Persistent XLA compilation cache shared across sessions and "
+          "worker restarts (SURVEY.md §7.3: big-model compiles take "
+          "minutes; Serve replica restarts and trainer elastic restarts "
+          "must not pay them again).  '' disables."),
     # --- metrics / tracing ---------------------------------------------------
     _flag("metrics_export_period_s", 5.0, "Metrics agent export period."),
     _flag("timeline_enabled", True, "Record profile events for `ray_tpu timeline`."),

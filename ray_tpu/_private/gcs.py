@@ -412,6 +412,11 @@ class GcsServer:
             # pending work requests TPU resources.
             env["RTPU_TPU_WORKER"] = "1"
             env.pop("JAX_PLATFORMS", None)
+            if GLOBAL_CONFIG.xla_cache_dir:
+                # persistent compile cache: replica/trainer restarts must
+                # not re-pay multi-minute XLA compiles (SURVEY.md §7.3)
+                env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                               GLOBAL_CONFIG.xla_cache_dir)
         else:
             # Plain workers never grab the TPU: jax must not lock the chip
             # in every spawned process.

@@ -1,12 +1,13 @@
 """Dashboard-lite: a JSON/Prometheus HTTP endpoint over cluster state.
 
 Reference: ``python/ray/dashboard/`` (SURVEY.md §2.3) — aiohttp server +
-React UI.  This build keeps the *API surface* (REST endpoints over live
-cluster state, Prometheus metrics, a minimal HTML index) without the
-TypeScript client; everything is stdlib ``http.server`` on a thread.
+React UI.  This build keeps the API surface (REST endpoints over live
+cluster state, Prometheus metrics) and serves a single-file vanilla-JS
+UI (``_index.py``) instead of a TypeScript build; everything is stdlib
+``http.server`` on a thread.
 
 Endpoints:
-  GET /                    — minimal HTML summary page
+  GET /                    — live UI (summary tiles + tabbed tables)
   GET /api/cluster_summary — nodes/resources/tasks/actors/objects rollup
   GET /api/nodes|actors|tasks|objects|workers|placement_groups
   GET /api/timeline        — Chrome trace JSON
@@ -62,21 +63,8 @@ class _Handler(BaseHTTPRequestHandler):
                 import ray_tpu
                 self._json(ray_tpu.timeline())
             elif self.path == "/":
-                s = state.cluster_summary()
-                html = (
-                    "<html><head><title>ray_tpu dashboard</title></head>"
-                    "<body><h1>ray_tpu</h1>"
-                    f"<p>nodes: {s['nodes']}</p>"
-                    f"<p>resources: {s['resources_available']} / "
-                    f"{s['resources_total']}</p>"
-                    f"<p>tasks: {s['tasks']}</p>"
-                    f"<p>actors: {s['actors']}</p>"
-                    f"<p>objects: {s['objects']['count']} "
-                    f"({s['objects']['total_bytes']} bytes)</p>"
-                    "<p>API: /api/cluster_summary /api/nodes /api/actors "
-                    "/api/tasks /api/objects /api/timeline /metrics</p>"
-                    "</body></html>")
-                self._send(200, html.encode(), "text/html")
+                from ray_tpu.dashboard._index import INDEX_HTML
+                self._send(200, INDEX_HTML.encode(), "text/html")
             else:
                 self._send(404, b"not found", "text/plain")
         except Exception as e:  # noqa: BLE001

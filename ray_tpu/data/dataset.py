@@ -271,6 +271,82 @@ class Dataset:
             return BlockAccessor(b).num_rows()
         return sum(ray_tpu.get([_count.remote(r) for r in self._iter_refs()]))
 
+    # global aggregates (reference: Dataset.sum/min/max/mean/std/unique).
+    # Each block reduces to a tiny partial INSIDE its task; only O(blocks)
+    # scalars (or unique sets) cross the object store, never whole columns.
+    def _partials(self, on: str) -> List[Optional[tuple]]:
+        @ray_tpu.remote
+        def _part(b: Block):
+            v = np.asarray(b[on], dtype=np.float64) \
+                if np.asarray(b[on]).dtype.kind in "fiub" \
+                else np.asarray(b[on])
+            if v.size == 0:
+                return None
+            return (float(v.sum()), float((v.astype(np.float64) ** 2).sum()),
+                    v.min(), v.max(), int(v.size))
+        return [p for p in ray_tpu.get(
+            [_part.remote(r) for r in self._iter_refs()]) if p is not None]
+
+    def sum(self, on: str):
+        parts = self._partials(on)
+        return sum(p[0] for p in parts) if parts else None
+
+    def min(self, on: str):
+        parts = self._partials(on)
+        return min(p[2] for p in parts) if parts else None
+
+    def max(self, on: str):
+        parts = self._partials(on)
+        return max(p[3] for p in parts) if parts else None
+
+    def mean(self, on: str):
+        parts = self._partials(on)
+        n = sum(p[4] for p in parts)
+        return float(sum(p[0] for p in parts) / n) if n else None
+
+    def std(self, on: str, ddof: int = 1):
+        parts = self._partials(on)
+        n = sum(p[4] for p in parts)
+        if n <= ddof:
+            return None
+        s1 = sum(p[0] for p in parts)
+        s2 = sum(p[1] for p in parts)
+        return float(np.sqrt(max(0.0, (s2 - s1 * s1 / n) / (n - ddof))))
+
+    def unique(self, on: str) -> List[Any]:
+        @ray_tpu.remote
+        def _uniq(b: Block) -> np.ndarray:
+            return np.unique(np.asarray(b[on]))
+        parts = [p for p in ray_tpu.get(
+            [_uniq.remote(r) for r in self._iter_refs()]) if p.size]
+        if not parts:
+            return []
+        return np.unique(np.concatenate(parts)).tolist()
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Row-wise Bernoulli sample (reference: Dataset.random_sample).
+
+        Per-block randomness derives from (seed, block content signature)
+        so equal-sized blocks draw independent masks; blocks with
+        byte-identical content share a mask (deterministic by design)."""
+        base = seed if seed is not None else np.random.SeedSequence().entropy
+
+        def apply(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            if n == 0:
+                return block
+            import zlib
+            sig = zlib.crc32(n.to_bytes(8, "little") + b"".join(
+                np.ascontiguousarray(np.asarray(v)[:1]).tobytes() +
+                np.ascontiguousarray(np.asarray(v)[-1:]).tobytes()
+                for v in block.values()))
+            rng = np.random.default_rng([int(base) % (2 ** 63), sig])
+            mask = rng.random(n) < fraction
+            return {k: np.asarray(v)[mask] for k, v in block.items()}
+        return self._with_stage(MapStage(apply, "RandomSample"))
+
     def schema(self) -> Optional[Dict[str, Any]]:
         for ref in self._iter_refs():
             block = ray_tpu.get(ref)

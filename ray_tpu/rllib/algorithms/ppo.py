@@ -46,10 +46,16 @@ class PPO(Algorithm):
         self._ma = hasattr(lw, "policies")
         if self._ma:
             # one learner (update fn + optimizer state + adaptive KL) per
-            # policy in the map (reference: multi-agent train_one_step)
-            self._learners = {
-                pid: self._build_learner(pol, cfg)
-                for pid, pol in lw.policies.items()}
+            # policy in the map (reference: multi-agent train_one_step);
+            # a per-policy config in the spec tuple overrides the shared
+            # algorithm config for THAT policy's learner (lr, clip, ...)
+            from ray_tpu.rllib.multi_agent import _policy_spec
+            specs = cfg["multiagent"]["policies"]
+            self._learners = {}
+            for pid, pol in lw.policies.items():
+                pconf = _policy_spec(specs.get(pid))[3]
+                self._learners[pid] = self._build_learner(
+                    pol, {**cfg, **pconf})
         else:
             self._learners = {"default_policy":
                               self._build_learner(lw.policy, cfg)}

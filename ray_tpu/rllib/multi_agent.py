@@ -138,8 +138,13 @@ class MultiAgentRolloutWorker:
                         out[fin[0]].append(fin[1])
             self._ep_len += 1
             if terms.get("__all__") or truncs.get("__all__"):
+                # a global TRUNCATION (time limit) must bootstrap V(s') for
+                # agents without their own terminal flag, same convention
+                # as the single-agent worker; terminated=0-bootstrap only
+                # on a true global terminal
+                all_terminal = bool(terms.get("__all__"))
                 for aid in list(self._buf):
-                    fin = self._finalize_agent(aid, terminated=True)
+                    fin = self._finalize_agent(aid, terminated=all_terminal)
                     if fin:
                         out[fin[0]].append(fin[1])
                 self._completed.append((self._ep_reward, self._ep_len))

@@ -228,3 +228,15 @@ class TestTrainIntegration:
             datasets={"train": rd.range(20, override_num_blocks=4)})
         res = trainer.fit()
         assert res.error is None
+
+
+def test_global_aggregates_and_sample(ray_start_regular):
+    ds = rd.from_items([{"x": i, "y": i % 3} for i in range(100)]) \
+             .repartition(4)
+    assert ds.sum("x") == sum(range(100))
+    assert ds.min("x") == 0 and ds.max("x") == 99
+    assert abs(ds.mean("x") - 49.5) < 1e-9
+    assert abs(ds.std("x") - np.std(np.arange(100), ddof=1)) < 1e-9
+    assert sorted(ds.unique("y")) == [0, 1, 2]
+    n = ds.random_sample(0.5, seed=0).count()
+    assert 20 < n < 80, n
