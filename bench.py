@@ -47,9 +47,7 @@ def main() -> None:
     import os
 
     from ray_tpu._private.config import GLOBAL_CONFIG
-    if GLOBAL_CONFIG.xla_cache_dir:
-        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                              GLOBAL_CONFIG.xla_cache_dir)
+    GLOBAL_CONFIG.apply_xla_cache_env(os.environ)
     import jax
     import numpy as np
 

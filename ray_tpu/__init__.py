@@ -75,19 +75,17 @@ def init(address: Optional[str] = None, *,
             raise RuntimeError("ray_tpu.init() called twice "
                                "(pass ignore_reinit_error=True to allow)")
         GLOBAL_CONFIG.apply_system_config(_system_config)
-        if GLOBAL_CONFIG.xla_cache_dir:
-            # persistent XLA compile cache for the driver process too;
-            # effective even if jax is already imported (config knob),
-            # harmless when no TPU is attached
-            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                                  GLOBAL_CONFIG.xla_cache_dir)
-            if "jax" in sys.modules:
-                try:
-                    sys.modules["jax"].config.update(
-                        "jax_compilation_cache_dir",
-                        GLOBAL_CONFIG.xla_cache_dir)
-                except Exception:  # noqa: BLE001 - best effort
-                    pass
+        # persistent XLA compile cache for the driver process too;
+        # effective even if jax is already imported (config knob),
+        # harmless when no TPU is attached
+        GLOBAL_CONFIG.apply_xla_cache_env(os.environ)
+        if GLOBAL_CONFIG.xla_cache_dir and "jax" in sys.modules:
+            try:
+                sys.modules["jax"].config.update(
+                    "jax_compilation_cache_dir",
+                    GLOBAL_CONFIG.xla_cache_dir)
+            except Exception:  # noqa: BLE001 - best effort
+                pass
         from ray_tpu._private.gcs import GcsServer
 
         if address is None or address == "local":

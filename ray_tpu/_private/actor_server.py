@@ -184,6 +184,27 @@ class ActorServer:
         return_ids: List[str] = msg["return_ids"]
         num_returns = msg["num_returns"]
         w = self.worker
+        if msg.get("_resubmitted") and return_ids:
+            # A resubmitted call may have COMPLETED on the previous
+            # incarnation (results seal with the GCS before the inline
+            # reply; death can race the reply).  The caller's own dedup
+            # can miss seal events still in flight at disconnect time —
+            # by the time the restarted actor executes, the GCS has
+            # drained them, so this check is authoritative.  Prevents
+            # re-executing finished methods on stateful actors.
+            try:
+                metas = w.rpc("peek_meta",
+                              object_ids=return_ids).get("metas", {})
+                if all(m and m.get("state") in ("ready", "error")
+                       for m in metas.values()):
+                    with self._send_lock:
+                        conn.send({"call_id": msg["call_id"],
+                                   "return_ids": return_ids,
+                                   "inline_results": [None] * len(return_ids),
+                                   "ok": True})
+                    return
+            except (OSError, EOFError):
+                pass  # control plane hiccup: at-least-once fallback
         try:
             args, kwargs = w._unpack_args(msg)
             method_name = msg["method"]

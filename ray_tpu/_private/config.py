@@ -78,6 +78,10 @@ _FLAG_DEFS = [
     _flag("num_workers_per_node", 0, "Size of worker pool (0 = num_cpus)."),
     _flag("worker_register_timeout_s", 30.0, "Timeout for a spawned worker to register."),
     _flag("worker_lease_cache", True, "Reuse leased idle workers for same-shape tasks."),
+    _flag("worker_pipeline_depth", 4,
+          "Same-shape tasks queued on a busy worker's lease (scheduler-"
+          "side; dispatched back-to-back on task completion without a "
+          "pump scan).  0 disables (reference: lease reuse)."),
     _flag("scheduler_spread_threshold", 0.5,
           "Hybrid policy: prefer local until local load exceeds this fraction."),
     _flag("health_check_period_s", 1.0, "Control-plane node health check period."),
@@ -139,6 +143,13 @@ class RayTpuConfig:
     def snapshot(self) -> Dict[str, Any]:
         """Full resolved view (for propagation to child processes / debugging)."""
         return {name: getattr(self, name) for name in _DEFS}
+
+    def apply_xla_cache_env(self, env: Dict[str, str]) -> None:
+        """Point a process (driver, spawned worker, bench) at the
+        persistent XLA compile cache — the single place that knows the
+        env-var spelling."""
+        if self.xla_cache_dir:
+            env.setdefault("JAX_COMPILATION_CACHE_DIR", self.xla_cache_dir)
 
     def to_env(self) -> Dict[str, str]:
         """Encode the resolved config as RTPU_* env vars for child processes."""

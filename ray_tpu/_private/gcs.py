@@ -96,6 +96,11 @@ class WorkerState:
         self.task_conn_lock = threading.Lock()
         self.blocked = False     # task currently parked in get() (CPU released)
         self.current_task: Optional[dict] = None
+        # Lease pipelining (reference: lease reuse / worker lease caching):
+        # same-shape tasks queue on the busy worker and ride its resource
+        # lease; the worker's own task loop executes them in order, so the
+        # per-task scheduler round trip overlaps with execution.
+        self.pipeline: deque = deque()
         self.actor_id: Optional[str] = None
         self.actor_addr: Optional[str] = None
 
@@ -172,6 +177,11 @@ class GcsServer:
         self.objects: Dict[str, ObjMeta] = {}
         self.client_refs: Dict[str, Dict[str, int]] = defaultdict(dict)
         self.pending_tasks: deque = deque()
+        self.dep_waiting: Dict[str, List[dict]] = {}
+        # oid → waiter records for blocked get/wait RPCs: seals wake the
+        # exact waiters instead of notify_all-storming every blocked call
+        # into an O(oids) rescan (that was quadratic in batch gets)
+        self._object_waiters: Dict[str, List[dict]] = {}
         self.infeasible_tasks: List[dict] = []
         self.running: Dict[str, Tuple[str, dict]] = {}   # task_id -> (worker, spec)
         self.actors: Dict[str, ActorState] = {}
@@ -262,6 +272,8 @@ class GcsServer:
         meta.size = size
         meta.node_id = node_id
         meta.contained = contained
+        self._promote_dep_waiters(oid)
+        self._notify_object_waiters(oid)
         if lineage_task:
             meta.lineage_task = lineage_task
         for c in contained:
@@ -274,6 +286,8 @@ class GcsServer:
         meta.state = ERROR
         meta.loc = "inline"
         meta.data = err_bytes
+        self._promote_dep_waiters(oid, errored=True)
+        self._notify_object_waiters(oid)
         self.cv.notify_all()
 
     def _mark_object_lost(self, oid: str, meta: ObjMeta) -> None:
@@ -291,6 +305,10 @@ class GcsServer:
             meta.state = ERROR
             meta.loc = "inline"
             meta.data = serialize_to_bytes(e)[0]
+            # terminal transition outside _seal_error: wake dep-parked
+            # specs and object waiters here too
+            self._promote_dep_waiters(oid, errored=True)
+            self._notify_object_waiters(oid)
 
     def _decref(self, oid: str, n: int = 1) -> None:
         meta = self.objects.get(oid)
@@ -367,6 +385,29 @@ class GcsServer:
                     return node, (pg, i)
         return None, None
 
+    def _piggyback_worker(self, node: NodeState, req: Dict[str, float],
+                          need_tpu: bool) -> Optional[WorkerState]:
+        """A busy worker on ``node`` whose running lease matches ``req``
+        and whose pipeline has room (lock held)."""
+        depth = GLOBAL_CONFIG.worker_pipeline_depth
+        if depth <= 0:
+            return None
+        for wid in node.workers:
+            w = self.workers.get(wid)
+            if (w is None or w.state != "busy" or w.blocked
+                    or w.actor_id is not None
+                    or w.tpu_capable != need_tpu
+                    or len(w.pipeline) >= depth):
+                continue
+            cur = w.current_task
+            if (cur is None or cur.get("is_actor_creation")
+                    or cur.get("_pg_claim") is not None):
+                continue
+            if cur.get("_req") != req:
+                continue
+            return w
+        return None
+
     def _idle_worker_on(self, node: NodeState,
                         need_tpu: bool = False) -> Optional[WorkerState]:
         """Pop an idle worker matching the device requirement.  TPU work
@@ -412,11 +453,9 @@ class GcsServer:
             # pending work requests TPU resources.
             env["RTPU_TPU_WORKER"] = "1"
             env.pop("JAX_PLATFORMS", None)
-            if GLOBAL_CONFIG.xla_cache_dir:
-                # persistent compile cache: replica/trainer restarts must
-                # not re-pay multi-minute XLA compiles (SURVEY.md §7.3)
-                env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                               GLOBAL_CONFIG.xla_cache_dir)
+            # persistent compile cache: replica/trainer restarts must
+            # not re-pay multi-minute XLA compiles (SURVEY.md §7.3)
+            GLOBAL_CONFIG.apply_xla_cache_env(env)
         else:
             # Plain workers never grab the TPU: jax must not lock the chip
             # in every spawned process.
@@ -461,11 +500,54 @@ class GcsServer:
         with self.cv:
             self._pump_locked()
 
+    # Consecutive unplaceable specs tolerated per scan before giving up
+    # until the next pump.  Without a cutoff, a deep backlog makes every
+    # pump O(backlog) and the scheduler O(n^2) under pipelined one-way
+    # submission (reference analog: ClusterTaskManager keeps separate
+    # schedule/dispatch/waiting queues instead of rescanning one list).
+    _PUMP_MISS_CAP = 32
+
+    def _park_on_deps(self, spec: dict) -> None:
+        """Lock held.  Move a dep-waiting spec off the scan queue; it is
+        promoted back by _promote_dep_waiters when its deps seal."""
+        waits = set()
+        for dep in spec.get("deps", ()):
+            m = self.objects.get(dep)
+            if m is None or m.state == PENDING:
+                waits.add(dep)
+        if not waits:
+            self.pending_tasks.append(spec)   # raced: deps arrived already
+            return
+        spec["_waiting_deps"] = waits
+        for dep in waits:
+            self.dep_waiting.setdefault(dep, []).append(spec)
+
+    def _promote_dep_waiters(self, oid: str, errored: bool = False) -> None:
+        """Lock held.  A dep sealed (ok or error): wake parked specs."""
+        specs = self.dep_waiting.pop(oid, None)
+        if not specs:
+            return
+        for spec in specs:
+            waits = spec.get("_waiting_deps")
+            if waits is not None:
+                waits.discard(oid)
+            if spec.get("cancelled") or spec.get("_dep_failed"):
+                continue
+            if errored:
+                spec["_dep_failed"] = True
+                self._fail_task_with_dep_error(spec, oid)
+            elif not waits:
+                spec.pop("_waiting_deps", None)
+                self.pending_tasks.append(spec)
+
     def _pump_locked(self) -> None:
         progressed = True
         while progressed:
             progressed = False
+            misses = 0
             for _ in range(len(self.pending_tasks)):
+                if misses >= self._PUMP_MISS_CAP:
+                    break
                 spec = self.pending_tasks.popleft()
                 if spec.get("cancelled"):
                     continue
@@ -476,7 +558,7 @@ class GcsServer:
                     progressed = True
                     continue
                 if status == "waiting":
-                    self.pending_tasks.append(spec)
+                    self._park_on_deps(spec)
                     continue
                 req = self._task_resources(spec)
                 st = spec.get("scheduling_strategy")
@@ -487,22 +569,24 @@ class GcsServer:
                     node = self._pick_node(spec, req)
                 if node is None:
                     self.pending_tasks.append(spec)
+                    misses += 1
                     continue
                 need_tpu = req.get("TPU", 0) > 0
                 worker = self._idle_worker_on(node, need_tpu)
                 if worker is None:
+                    spawned = False
                     if node.is_remote:
                         # the NodeAgent owns that host's worker pool; wait
                         # for one of its workers to go idle
-                        self.pending_tasks.append(spec)
-                        continue
-                    if need_tpu:
+                        pass
+                    elif need_tpu:
                         # TPU workers have their own cap: concurrent jax
                         # inits would fight over the same chips, so one
                         # device-holding worker per node (its actor/tasks
                         # own all the node's declared chips)
                         if self._count_node_workers(node, tpu=True) <                                 GLOBAL_CONFIG.tpu_workers_per_node:
                             self._spawn_worker(node.node_id, tpu=True)
+                            spawned = True
                     else:
                         # plain cap = node CPU count (min 1)
                         cap = int(max(1, node.resources_total.get("CPU", 1)))
@@ -511,7 +595,27 @@ class GcsServer:
                                 [a for a in self.actors.values()
                                  if a.state in (A_PENDING, A_RESTARTING)]):
                             self._spawn_worker(node.node_id, tpu=False)
+                            spawned = True
+                    # lease piggyback is the LAST resort: only once the
+                    # pool is at its cap AND nothing is mid-spawn — queuing
+                    # onto a busy worker while capacity exists (or is
+                    # coming up) would serialize work the scheduler should
+                    # parallelize (e.g. concurrent long-running trials)
+                    starting = any(
+                        ws.state == "starting"
+                        and ws.tpu_capable == need_tpu
+                        and ws.node_id == node.node_id
+                        for ws in self.workers.values())
+                    if not spawned and not starting and pg_claim is None \
+                            and not spec.get("is_actor_creation"):
+                        tgt = self._piggyback_worker(node, req, need_tpu)
+                        if tgt is not None:
+                            tgt.pipeline.append(spec)
+                            progressed = True
+                            misses = 0
+                            continue
                     self.pending_tasks.append(spec)
+                    misses += 1
                     continue
                 # dispatch
                 if pg_claim is not None:
@@ -534,6 +638,7 @@ class GcsServer:
                     self.pending_tasks.append(spec)
                     continue
                 progressed = True
+                misses = 0
             self.cv.notify_all()
 
     def _release_task_resources(self, spec: dict) -> None:
@@ -601,6 +706,12 @@ class GcsServer:
             self._decref(oid, n)
         spec = w.current_task
         w.current_task = None
+        # queued (never-started) pipeline tasks just reschedule — no retry
+        # budget consumed
+        while w.pipeline:
+            qspec = w.pipeline.popleft()
+            if not qspec.get("cancelled"):
+                self.pending_tasks.appendleft(qspec)
         if w.actor_id is not None:
             self._actor_worker_died(w.actor_id)
         elif spec is not None and spec.get("is_actor_creation"):
@@ -655,8 +766,17 @@ class GcsServer:
                 self.named_actors.pop((a.namespace, a.name), None)
 
     def _monitor_loop(self) -> None:
+        last_pump = 0.0
         while not self._shutdown:
             time.sleep(0.1)
+            # unconditional periodic pump: the _PUMP_MISS_CAP scan cutoff
+            # plus queue rotation means a placeable spec deep behind
+            # unplaceable ones is only reached across several pumps — and
+            # with nothing running there may be no event to trigger one
+            now = time.monotonic()
+            if now - last_pump > 0.5 and self.pending_tasks:
+                last_pump = now
+                self._pump()
             dead: List[WorkerState] = []
             with self.lock:
                 for w in self.workers.values():
@@ -811,6 +931,11 @@ class GcsServer:
                 w = self.workers.get(worker_id)
                 if w is not None and w.current_task is not None:
                     w.blocked = True
+                    # a blocked worker can't drain its pipeline (and its
+                    # queued tasks could even be what it blocks ON) —
+                    # give them back to the scheduler
+                    while w.pipeline:
+                        self.pending_tasks.appendleft(w.pipeline.pop())
                     spec = w.current_task
                     cpu = (spec.get("_req") or {}).get("CPU", 0)
                     if cpu and not spec.get("_cpu_released"):
@@ -865,6 +990,18 @@ class GcsServer:
             if spec is None or spec["task_id"] != msg["task_id"]:
                 return
             self.running.pop(spec["task_id"], None)
+            # lease handoff: a queued same-shape task inherits this task's
+            # resource claim instead of release-then-reacquire (and skips
+            # the pump scan entirely — the worker stays saturated)
+            nxt = None
+            while w.pipeline:
+                cand = w.pipeline.popleft()
+                if not cand.get("cancelled"):
+                    nxt = cand
+                    break
+            if nxt is not None and "_req" in spec:
+                nxt["_req"] = spec.pop("_req")
+                nxt["_node"] = spec.pop("_node")
             self._release_task_resources(spec)
             w.current_task = None
             w.blocked = False
@@ -899,8 +1036,20 @@ class GcsServer:
                     for oid in spec["return_ids"]:
                         self._seal_error(oid, msg["error"])
                     self._release_deps(spec)
-            # worker back to pool
-            if w.state == "busy":
+            # next leased task, or worker back to pool
+            if nxt is not None and w.state == "busy":
+                w.current_task = nxt
+                self.running[nxt["task_id"]] = (worker_id, nxt)
+                if not w.push({"kind": "execute_task", "spec": nxt}):
+                    # worker died between done and handoff: the task never
+                    # STARTED — reschedule it without consuming its retry
+                    # budget (same invariant as the queued pipeline)
+                    self.running.pop(nxt["task_id"], None)
+                    w.current_task = None
+                    self._release_task_resources(nxt)
+                    self.pending_tasks.appendleft(nxt)
+                    self._handle_worker_death(w)
+            elif w.state == "busy":
                 w.state = "idle"
                 node = self.nodes.get(w.node_id)
                 if node is not None and node.alive:
@@ -1013,60 +1162,124 @@ class GcsServer:
         self._pump()  # a pending task may have been waiting on this object
         return {}
 
+    def _h_peek_meta(self, msg: dict) -> dict:
+        """Non-blocking state snapshot (actor-channel reconnect dedup:
+        'did this call's returns already seal?')."""
+        with self.lock:
+            out = {}
+            for oid in msg["object_ids"]:
+                m = self.objects.get(oid)
+                out[oid] = None if m is None else {"state": m.state}
+            return {"metas": out}
+
+    def _notify_object_waiters(self, oid: str) -> None:
+        """Lock held: an object reached a terminal state — wake the exact
+        get/wait RPCs blocked on it."""
+        lst = self._object_waiters.pop(oid, None)
+        if not lst:
+            return
+        for waiter in lst:
+            if oid in waiter["left"]:
+                waiter["left"].discard(oid)
+                waiter["done"] = waiter.get("done", 0) + 1
+                need = waiter.get("need")
+                if (need is None and not waiter["left"]) or \
+                        (need is not None and waiter["done"] >= need):
+                    waiter["ev"].set()
+
+    def _unregister_waiter(self, waiter: dict) -> None:
+        """Lock held: drop a waiter's remaining registry entries."""
+        for oid in list(waiter["left"]):
+            lst = self._object_waiters.get(oid)
+            if lst is not None:
+                try:
+                    lst.remove(waiter)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self._object_waiters[oid]
+
+    def _scan_pending(self, oids, verify_fs: bool) -> List[str]:
+        """Lock held: returns the oids still PENDING.  With ``verify_fs``,
+        READY objects are checked against the filesystem (the truth, not
+        our bookkeeping — a segment can vanish under us) and lost ones are
+        routed to reconstruction.  Pending objects whose owner died with
+        no lineage are sealed with OwnerDiedError here."""
+        missing_lost = []
+        pending = []
+        for oid in oids:
+            meta = self.objects.get(oid)
+            if meta is None or meta.state == PENDING:
+                pending.append(oid)
+            elif verify_fs and meta.state == READY and \
+                    meta.loc in ("shm", "spilled"):
+                self.store.restore(oid)
+                if not ShmObjectStore.exists_in_shm(oid):
+                    missing_lost.append((oid, meta))
+            elif verify_fs and meta.state == READY and meta.loc == "slab":
+                if self.slab is None or not self.slab.exists(oid):
+                    missing_lost.append((oid, meta))
+        for oid, meta in missing_lost:
+            # purge stale store bookkeeping first: the segment is gone,
+            # but _sealed/_used may still account for it, which would
+            # corrupt capacity tracking and crash later evictions
+            self.store.delete_object(oid)
+            self._mark_object_lost(oid, meta)
+            if meta.state == PENDING:
+                pending.append(oid)
+        if missing_lost:
+            self._pump_locked()
+        for oid in pending:
+            if oid[:16] in self.dead_clients:
+                meta = self._get_or_create_meta(oid)
+                if meta.state == PENDING and not (
+                        meta.lineage_task and meta.lineage_task in self.lineage):
+                    self._mark_object_lost(oid, meta)
+        return [oid for oid in pending
+                if (m := self.objects.get(oid)) is None or m.state == PENDING]
+
     def _h_get_meta(self, msg: dict) -> dict:
         deadline = None if msg.get("timeout") is None \
             else time.monotonic() + msg["timeout"]
         oids = msg["object_ids"]
+        ev = threading.Event()
+        waiter = {"left": set(), "ev": ev, "need": None}
         with self.cv:
-            verify_fs = True
-            while True:
-                missing_lost = []
-                pending = []
-                for oid in oids:
-                    meta = self.objects.get(oid)
-                    if meta is None or meta.state == PENDING:
-                        pending.append(oid)
-                    elif verify_fs and meta.state == READY and \
-                            meta.loc in ("shm", "spilled"):
-                        # the filesystem is the truth, not our bookkeeping:
-                        # a segment can vanish under us (node loss, eviction
-                        # races, operator cleanup) → reconstruction path.
-                        # Checked once per get_meta call, not on every cv
-                        # wakeup — the worker retries on FileNotFoundError,
-                        # which covers races after this point.
-                        self.store.restore(oid)
-                        if not ShmObjectStore.exists_in_shm(oid):
-                            missing_lost.append((oid, meta))
-                    elif verify_fs and meta.state == READY and \
-                            meta.loc == "slab":
-                        # same truth rule for the native slab plane
-                        if self.slab is None or not self.slab.exists(oid):
-                            missing_lost.append((oid, meta))
-                verify_fs = False
-                for oid, meta in missing_lost:
-                    # purge stale store bookkeeping first: the segment is
-                    # gone, but _sealed/_used may still account for it, which
-                    # would corrupt capacity tracking and crash later
-                    # evictions (os.replace on a nonexistent path)
-                    self.store.delete_object(oid)
-                    self._mark_object_lost(oid, meta)
-                if missing_lost:
-                    self._pump_locked()
-                    continue
-                if not pending:
-                    break
-                # owner-death check for pending objects
-                for oid in pending:
-                    if oid[:16] in self.dead_clients:
-                        meta = self._get_or_create_meta(oid)
-                        if meta.state == PENDING and not (
-                                meta.lineage_task and meta.lineage_task in self.lineage):
-                            self._mark_object_lost(oid, meta)
-                remaining = None if deadline is None else deadline - time.monotonic()
+            pending = self._scan_pending(oids, verify_fs=True)
+            if pending:
+                waiter["left"].update(pending)
+                for oid in waiter["left"]:
+                    self._object_waiters.setdefault(oid, []).append(waiter)
+        try:
+            while waiter["left"]:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
+                    with self.cv:  # seals mutate the set concurrently
+                        left = sorted(waiter["left"])[:3]
                     raise exc.GetTimeoutError(
-                        f"get() timed out waiting for {pending[:3]}...")
-                self.cv.wait(timeout=min(1.0, remaining) if remaining else 1.0)
+                        f"get() timed out waiting for {left}...")
+                ev.wait(timeout=min(1.0, remaining)
+                        if remaining is not None else 1.0)
+                ev.clear()
+                if not waiter["left"]:
+                    break
+                with self.cv:
+                    # periodic sweep for state changes with no seal event
+                    # (owner death, lost segments under reconstruction)
+                    self._scan_pending(list(waiter["left"]),
+                                       verify_fs=False)
+                    for oid in list(waiter["left"]):
+                        m = self.objects.get(oid)
+                        if m is not None and m.state != PENDING:
+                            waiter["left"].discard(oid)
+                            lst = self._object_waiters.get(oid)
+                            if lst is not None and waiter in lst:
+                                lst.remove(waiter)
+        finally:
+            with self.cv:
+                self._unregister_waiter(waiter)
+        with self.cv:
             out = {}
             for oid in oids:
                 meta = self.objects[oid]
@@ -1080,20 +1293,40 @@ class GcsServer:
         num_returns = msg["num_returns"]
         deadline = None if msg.get("timeout") is None \
             else time.monotonic() + msg["timeout"]
+        ev = threading.Event()
+        waiter = None
+
+        def ready_now():
+            return [o for o in oids
+                    if (m := self.objects.get(o)) is not None
+                    and m.state != PENDING]
+
         with self.cv:
-            while True:
-                ready = [o for o in oids
-                         if (m := self.objects.get(o)) is not None
-                         and m.state != PENDING]
-                if len(ready) >= num_returns:
-                    break
-                remaining = None if deadline is None else deadline - time.monotonic()
+            ready = ready_now()
+            if len(ready) < num_returns:
+                pend = [o for o in oids if o not in set(ready)]
+                waiter = {"left": set(pend), "ev": ev,
+                          "need": num_returns - len(ready), "done": 0}
+                for oid in waiter["left"]:
+                    self._object_waiters.setdefault(oid, []).append(waiter)
+        try:
+            while len(ready) < num_returns:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     break
-                self.cv.wait(timeout=min(0.5, remaining) if remaining else 0.5)
-            ready_set = set(ready[:num_returns])
-            return {"ready": [o for o in oids if o in ready_set],
-                    "not_ready": [o for o in oids if o not in ready_set]}
+                ev.wait(timeout=min(0.5, remaining)
+                        if remaining is not None else 0.5)
+                ev.clear()
+                with self.cv:
+                    ready = ready_now()
+        finally:
+            if waiter is not None:
+                with self.cv:
+                    self._unregister_waiter(waiter)
+        ready_set = set(ready[:num_returns])
+        return {"ready": [o for o in oids if o in ready_set],
+                "not_ready": [o for o in oids if o not in ready_set]}
 
     def _h_add_ref(self, msg: dict) -> dict:
         with self.cv:
@@ -1155,25 +1388,51 @@ class GcsServer:
     # --- tasks
     def _h_submit_task(self, msg: dict) -> dict:
         spec = msg["spec"]
-        with self.cv:
-            refs = self.client_refs[spec["owner"]]
-            for oid in spec["return_ids"]:
-                meta = self._get_or_create_meta(oid)
-                meta.refcount += 1
-                refs[oid] = refs.get(oid, 0) + 1
-            # pin args (top-level refs) and borrows (refs nested in values)
-            # until the task reaches a terminal state
-            for dep in list(spec.get("deps", ())) + list(spec.get("borrows", ())):
-                meta = self._get_or_create_meta(dep)
-                meta.refcount += 1
-            self.pending_tasks.append(spec)
-        self._pump()
+        try:
+            with self.cv:
+                refs = self.client_refs[spec["owner"]]
+                for oid in spec["return_ids"]:
+                    meta = self._get_or_create_meta(oid)
+                    meta.refcount += 1
+                    refs[oid] = refs.get(oid, 0) + 1
+                # pin args (top-level refs) and borrows (refs nested in
+                # values) until the task reaches a terminal state
+                for dep in list(spec.get("deps", ())) + list(spec.get("borrows", ())):
+                    meta = self._get_or_create_meta(dep)
+                    meta.refcount += 1
+                self.pending_tasks.append(spec)
+        except Exception as e:  # noqa: BLE001 - submit is one-way: a lost
+            # error would strand the caller's get() forever; seal the
+            # returns with it instead
+            with self.cv:
+                self._fail_task(spec, e)
+            raise
+        # Pump only when this task could plausibly dispatch NOW: under a
+        # pipelined submit flood with all workers busy, pumping per submit
+        # is pure scan overhead — the next task_done pumps the backlog.
+        if len(self.pending_tasks) < 8 or \
+                any(n.idle_workers for n in self.nodes.values()):
+            self._pump()
         return {}
+
+    def _iter_queued_specs(self):
+        """Lock held: every not-yet-dispatched spec — the scan queue plus
+        dep-parked specs (each parked spec yielded once)."""
+        yield from self.pending_tasks
+        for w in self.workers.values():
+            yield from w.pipeline
+        seen = set()
+        for specs in self.dep_waiting.values():
+            for spec in specs:
+                sid = id(spec)
+                if sid not in seen:
+                    seen.add(sid)
+                    yield spec
 
     def _h_find_task_of_object(self, msg: dict) -> dict:
         oid = msg["object_id"]
         with self.lock:
-            for spec in self.pending_tasks:
+            for spec in self._iter_queued_specs():
                 if oid in spec["return_ids"]:
                     return {"task_id": spec["task_id"]}
             for wid, spec in self.running.values():
@@ -1187,7 +1446,7 @@ class GcsServer:
     def _h_cancel_task(self, msg: dict) -> dict:
         tid = msg["task_id"]
         with self.cv:
-            for spec in self.pending_tasks:
+            for spec in self._iter_queued_specs():
                 if spec["task_id"] == tid:
                     spec["cancelled"] = True
                     self._fail_task(spec, exc.TaskCancelledError(tid))
@@ -1278,7 +1537,7 @@ class GcsServer:
         with self.cv:
             if a.state in (A_PENDING, A_RESTARTING) and msg.get("no_restart", True):
                 # not yet running anywhere: cancel the pending creation
-                for spec in self.pending_tasks:
+                for spec in self._iter_queued_specs():
                     if spec.get("actor_id") == a.actor_id:
                         spec["cancelled"] = True
                 a.state = A_DEAD
@@ -1442,6 +1701,15 @@ class GcsServer:
             for spec in self.pending_tasks:
                 out.append({"task_id": spec["task_id"], "name": spec.get("name"),
                             "state": "PENDING_SCHEDULING", "worker_id": None})
+            seen = {id(sp) for sp in self.pending_tasks}
+            for specs in self.dep_waiting.values():
+                for spec in specs:
+                    if id(spec) not in seen:
+                        seen.add(id(spec))
+                        out.append({"task_id": spec["task_id"],
+                                    "name": spec.get("name"),
+                                    "state": "PENDING_ARGS",
+                                    "worker_id": None})
             return {"tasks": out}
 
     def _h_list_objects(self, msg: dict) -> dict:

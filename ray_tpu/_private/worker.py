@@ -145,12 +145,20 @@ class Worker:
         self._call_seq = _counter()
         self._fn_cache: Dict[str, Any] = {}
         self._exported: set = set()
+        import weakref
+        self._fn_id_cache: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
         self._local_values: "OrderedDict[str, bytes]" = OrderedDict()
         self._local_lock = threading.Lock()
         self._actor_channels: Dict[str, "_ActorChannel"] = {}
         self._actor_chan_lock = threading.Lock()
         self._pulls: Dict[str, dict] = {}       # in-flight chunked pulls
         self._pull_lock = threading.Lock()
+        # return-oid → (actor_id, call_id) for in-flight actor calls: a
+        # result observed through ANY path (inline reply, GCS get) marks
+        # the call complete, so a racing disconnect can't resubmit an
+        # already-executed method (see _ActorChannel._on_disconnect)
+        self._inflight_calls: Dict[str, Tuple[str, str]] = {}
         self._pull_sem = threading.Semaphore(
             max(1, GLOBAL_CONFIG.transfer_max_inflight))
         self.ctx = _TaskContext()
@@ -368,6 +376,13 @@ class Worker:
                     missing.append(oid)
         if missing:
             metas.update(self._blocking_get_meta(missing, deadline))
+        # any meta observed at a terminal state completes its actor call
+        # (the inline reply may have died with the actor; see
+        # _mark_call_done)
+        if self._inflight_calls:
+            for oid, meta in metas.items():
+                if meta.get("state") in ("ready", "error"):
+                    self._mark_call_done(oid)
         out = []
         for oid in oids:
             for attempt in range(3):
@@ -438,11 +453,25 @@ class Worker:
 
     # --------------------------------------------------------------- export
     def export_callable(self, obj: Any) -> str:
+        # Per-object fn_id cache: re-pickling the function on EVERY submit
+        # dominated the task hot path (sha1-of-cloudpickle per call).  The
+        # reference pins a RemoteFunction's pickle at first submission —
+        # later closure-cell mutations intentionally do not re-export.
+        try:
+            cached = self._fn_id_cache.get(obj)
+        except TypeError:  # unhashable callable (rare)
+            cached = None
+        if cached is not None:
+            return cached
         blob = dumps_call(obj)
         fn_id = hashlib.sha1(blob).hexdigest()[:16]
         if fn_id not in self._exported:
             self.rpc("export_function", fn_id=fn_id, blob=blob)
             self._exported.add(fn_id)
+        try:
+            self._fn_id_cache[obj] = fn_id
+        except TypeError:
+            pass
         return fn_id
 
     def fetch_callable(self, fn_id: str) -> Any:
@@ -551,7 +580,11 @@ class Worker:
             "runtime_env": runtime_env,
             **fields,
         }
-        self.rpc("submit_task", spec=spec)
+        # one-way submit: return ids are generated client-side, so there is
+        # nothing to wait for — pipelined submissions instead of a control-
+        # plane round trip per task (reference: lease-cached submission).
+        # FIFO on the thread-local conn keeps submit → release ordering.
+        self.rpc_oneway("submit_task", spec=spec)
         for oid in transient:
             self.rpc_oneway("release", object_id=oid)
         return [ObjectRef(oid, worker=self) for oid in return_ids]
@@ -631,10 +664,27 @@ class Worker:
                "_retries_left": max_task_retries,
                "arg_ledger": f"call:{call_id}" if hold else None, **fields}
         ch = self._actor_channel(actor_id, max_task_retries)
+        with self._actor_chan_lock:
+            for oid in return_ids:
+                self._inflight_calls[oid] = (actor_id, call_id)
         ch.send_call(msg)
         for oid in transient:
             self.rpc_oneway("release", object_id=oid)
         return [ObjectRef(oid, worker=self) for oid in return_ids]
+
+    def _mark_call_done(self, oid: str) -> None:
+        """A return object materialized: the actor call that produced it
+        completed — clear it from in-flight bookkeeping so a later
+        disconnect never resubmits it (double execution on a restarted
+        stateful actor)."""
+        with self._actor_chan_lock:
+            entry = self._inflight_calls.pop(oid, None)
+            if entry is None:
+                return
+            actor_id, call_id = entry
+            ch = self._actor_channels.get(actor_id)
+        if ch is not None:
+            ch.mark_done(call_id)
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
         self.rpc("kill_actor", actor_id=actor_id, no_restart=no_restart)
@@ -881,6 +931,12 @@ class _ActorChannel:
         threading.Thread(target=self._read_loop, args=(self._conn,),
                          name=f"actor-ch-{self.actor_id[:6]}", daemon=True).start()
 
+    def mark_done(self, call_id: str) -> None:
+        """The call's result was observed via the authoritative store —
+        it must never be resubmitted."""
+        with self._lock:
+            self._outstanding.pop(call_id, None)
+
     def send_call(self, msg: dict) -> None:
         with self._lock:
             if self.closed:
@@ -896,11 +952,16 @@ class _ActorChannel:
         while True:
             try:
                 msg = conn.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
+                # TypeError: close() from another thread nulls the handle
+                # mid-recv — same meaning as EOF here
                 break
             call_id = msg.get("call_id")
             with self._lock:
                 self._outstanding.pop(call_id, None)
+            with self.worker._actor_chan_lock:
+                for oid in msg["return_ids"]:
+                    self.worker._inflight_calls.pop(oid, None)
             for oid, res in zip(msg["return_ids"], msg.get("inline_results") or []):
                 if res is not None:
                     self.worker.cache_local(oid, res)
@@ -916,13 +977,34 @@ class _ActorChannel:
             with self._lock:
                 self.closed = True
             return
+        # The inline reply and the death can race: the actor seals results
+        # with the GCS (authoritative) BEFORE replying, so a call whose
+        # returns are already sealed COMPLETED — resubmitting it would
+        # re-execute a finished method (observable with stateful actors).
+        # Drop those from the pending set before applying retry budgets.
+        done: set = set()
+        try:
+            oids = {oid: cid for cid, m in pending.items()
+                    for oid in m["return_ids"]}
+            metas = self.worker.rpc("peek_meta",
+                                    object_ids=list(oids)).get("metas", {})
+            sealed = {oid for oid, meta in metas.items()
+                      if meta and meta.get("state") in ("ready", "error")}
+            for cid, m in pending.items():
+                if all(oid in sealed for oid in m["return_ids"]):
+                    done.add(cid)
+        except Exception:  # noqa: BLE001 - GCS unreachable: fall through
+            pass           # to the retry budget (at-least-once)
         # actor died with calls in flight: per-call retry budget decides
         # resubmission vs sealing an error (reference: max_task_retries)
         resubmit, fail = {}, {}
         for call_id, msg in pending.items():
+            if call_id in done:
+                continue
             left = msg.get("_retries_left", 0)
             if left != 0:
                 msg["_retries_left"] = left - 1 if left > 0 else -1
+                msg["_resubmitted"] = True  # receiver re-checks the seal
                 resubmit[call_id] = msg
             else:
                 fail[call_id] = msg

@@ -187,3 +187,20 @@ def test_tpu_task_routing_and_worker_capability():
         assert cpu_flag2 is None
     finally:
         ray_tpu.shutdown()
+
+
+def test_feasible_task_behind_infeasible_backlog(ray_start_regular):
+    """Liveness: a runnable task parked behind many permanently
+    unplaceable specs still dispatches (pump scan cutoff + rotation +
+    periodic pump)."""
+    @ray_tpu.remote(resources={"no_such_resource": 1})
+    def stuck():
+        return "never"
+
+    @ray_tpu.remote
+    def runnable():
+        return 42
+
+    blocked = [stuck.remote() for _ in range(64)]
+    assert ray_tpu.get(runnable.remote(), timeout=30) == 42
+    del blocked
