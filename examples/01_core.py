@@ -1,0 +1,51 @@
+"""Core API: tasks, objects, actors (reference: ray core walkthrough)."""
+import numpy as np
+
+import ray_tpu
+
+ray_tpu.init()
+
+
+# --- tasks: python functions running in parallel worker processes
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+
+print("squares:", ray_tpu.get([square.remote(i) for i in range(8)]))
+
+
+# --- objects: immutable values in shared memory, zero-copy reads
+big = np.arange(1_000_000, dtype=np.float64)
+ref = ray_tpu.put(big)
+
+
+@ray_tpu.remote
+def total(arr):          # arr is a zero-copy view onto the store
+    return float(arr.sum())
+
+
+print("sum:", ray_tpu.get(total.remote(ref)))
+
+
+# --- actors: stateful workers with ordered method calls
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self, k=1):
+        self.n += k
+        return self.n
+
+
+c = Counter.remote()
+futures = [c.add.remote() for _ in range(5)]
+print("counter:", ray_tpu.get(futures))
+
+# --- wait: first-completed consumption
+fast, slow = ray_tpu.wait([square.remote(i) for i in range(4)],
+                          num_returns=2)
+print("first two done:", ray_tpu.get(fast))
+
+ray_tpu.shutdown()

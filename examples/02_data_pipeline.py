@@ -1,0 +1,27 @@
+"""Data: transforms, shuffle, split, device batches (reference: Ray Data)."""
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rd
+
+ray_tpu.init()
+
+ds = rd.from_items([{"x": float(i), "label": i % 10} for i in range(10_000)])
+ds = (ds.map_batches(lambda b: {"x": b["x"] * 2, "label": b["label"]})
+        .filter(lambda row: row["label"] != 9)
+        .random_shuffle(seed=0)
+        .repartition(8))
+
+print("rows:", ds.count(), "schema:", ds.schema())
+print("mean x:", ds.mean("x"), "labels:", sorted(ds.unique("label")))
+
+# per-trainer shards (reference: Dataset.split(locality_hints))
+shards = ds.split(4)
+print("shard sizes:", [s.count() for s in shards])
+
+# batches ready for jax.device_put / a training loop
+for batch in ds.iter_batches(batch_size=4096):
+    print("batch:", {k: (v.shape, v.dtype) for k, v in batch.items()})
+    break
+
+ray_tpu.shutdown()

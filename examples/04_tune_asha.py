@@ -1,0 +1,36 @@
+"""Tune: random search + ASHA early stopping (reference: Ray Tune)."""
+import numpy as np
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.schedulers import ASHAScheduler
+
+ray_tpu.init()
+
+
+def objective(config):
+    # toy objective: converges toward 1/(lr distance from 0.1)
+    score = 0.0
+    for step in range(20):
+        score += max(0.0, 1.0 - abs(config["lr"] - 0.1) * 10)
+        score += np.random.default_rng(step).normal(0, 0.05)
+        tune.report({"score": score, "training_iteration": step + 1})
+
+
+results = Tuner(
+    objective,
+    param_space={"lr": tune.loguniform(1e-3, 1.0),
+                 "batch": tune.choice([16, 32, 64])},
+    tune_config=TuneConfig(
+        metric="score", mode="max", num_samples=12,
+        scheduler=ASHAScheduler(metric="score", mode="max", max_t=20,
+                                grace_period=4)),
+    run_config=RunConfig(storage_path="/tmp/rtpu_example_tune"),
+).fit()
+
+best = results.get_best_result("score", "max")
+print("best config:", best.metrics["config"], "score:",
+      round(best.metrics["score"], 2))
+ray_tpu.shutdown()

@@ -1,0 +1,46 @@
+"""Serve: a BERT classifier deployment with batching + autoscaling.
+
+The replica compiles its model in __init__ (warm start — requests never
+hit a cold XLA compile) and serves both the handle path and HTTP.
+"""
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=4)
+serve.start(serve.HTTPOptions(port=8011))
+
+
+@serve.deployment(num_replicas=1,
+                  autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                                      "target_ongoing_requests": 4})
+class BertClassifier:
+    def __init__(self):
+        import jax
+
+        from ray_tpu.models import bert
+        self.cfg = bert.tiny()
+        self.params = bert.init_params(jax.random.key(0), self.cfg)
+        self._jit = jax.jit(
+            lambda p, ids: bert.classify(p, ids, self.cfg))
+        # warm the compile cache before taking traffic
+        self._jit(self.params, np.zeros((1, 16), np.int32))
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.005)
+    async def classify_batch(self, ids_list):
+        ids = np.stack(ids_list)
+        logits = np.asarray(self._jit(self.params, ids))
+        return [int(x) for x in logits.argmax(-1)]
+
+    async def __call__(self, request):
+        ids = np.asarray(request if not isinstance(request, serve.Request)
+                         else request.json()["ids"], np.int32)
+        return await self.classify_batch(ids)
+
+
+handle = serve.run(BertClassifier.bind(), route_prefix="/classify")
+ids = np.random.default_rng(0).integers(0, 100, (16,)).astype(np.int32)
+print("prediction:", handle.remote(ids).result())
+serve.shutdown()
+ray_tpu.shutdown()

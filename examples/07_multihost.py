@@ -1,0 +1,69 @@
+"""Multi-host: a second host joins the cluster and runs tasks + actors.
+
+Production shape:
+  host A (head):   python -m ray_tpu start --client-server-port 10001
+  host B (worker): RTPU_AUTH_KEY=<hex>  \
+                   python -m ray_tpu join --address hostA:10001
+
+This example simulates host B with a NodeAgent subprocess on localhost —
+the transport (TCP tunnel, HMAC auth, tcp:// actor channels) is identical.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import ray_tpu
+from ray_tpu.util import state
+from ray_tpu.util.client import ClientProxyServer
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+from ray_tpu._private import worker as worker_mod
+
+ray_tpu.init()
+
+session = worker_mod.global_worker().session
+proxy = ClientProxyServer(session, host="127.0.0.1", port=0)
+port = proxy._listener.address[1]
+env = dict(os.environ, RTPU_AUTH_KEY=session.auth_key().hex())
+env.pop("RTPU_SESSION_DIR", None)
+agent = subprocess.Popen(
+    [sys.executable, "-m", "ray_tpu._private.node_agent",
+     "--address", f"127.0.0.1:{port}", "--num-cpus", "2"], env=env)
+
+# wait for the remote node to register
+node_id = None
+deadline = time.time() + 60
+while time.time() < deadline and node_id is None:
+    for n in state.list_nodes():
+        if n["labels"].get("agent") == "1" and n["alive"]:
+            node_id = n["node_id"]
+    time.sleep(0.2)
+print("remote node:", node_id)
+
+pin = NodeAffinitySchedulingStrategy(node_id)
+
+
+@ray_tpu.remote(scheduling_strategy=pin)
+def where():
+    return os.getpid()
+
+
+@ray_tpu.remote(scheduling_strategy=pin)
+class RemoteCounter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self):
+        self.n += 1
+        return self.n
+
+
+print("remote task pid:", ray_tpu.get(where.remote(), timeout=60))
+c = RemoteCounter.remote()
+print("remote actor counts:", ray_tpu.get([c.add.remote() for _ in range(3)],
+                                          timeout=60))
+
+agent.terminate()
+agent.wait(timeout=30)
+proxy.stop()
+ray_tpu.shutdown()

@@ -61,16 +61,21 @@ const TABS = {
                      "assignment"],
 };
 let tab = "nodes";
+const esc = s => String(s).replace(/[&<>"']/g, c => ({
+  "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
+}[c]));
 const fmt = v => {
+  // every API value is attacker-influencable (actor names, labels,
+  // error strings) — escape BEFORE any innerHTML interpolation
   if (v === null || v === undefined) return "";
   if (typeof v === "boolean")
     return `<span class="${v ? "ok" : "bad"}">${v}</span>`;
-  if (typeof v === "object") return JSON.stringify(v);
+  if (typeof v === "object") return esc(JSON.stringify(v));
   if (typeof v === "string" && /^(ALIVE|READY|ok|idle|FINISHED)$/.test(v))
     return `<span class="ok">${v}</span>`;
   if (typeof v === "string" && /^(DEAD|FAILED|dead|ERROR)$/.test(v))
     return `<span class="bad">${v}</span>`;
-  return String(v);
+  return esc(String(v));
 };
 function renderTabs() {
   document.getElementById("tabs").innerHTML = Object.keys(TABS).map(t =>

@@ -275,43 +275,70 @@ class Dataset:
     # Each block reduces to a tiny partial INSIDE its task; only O(blocks)
     # scalars (or unique sets) cross the object store, never whole columns.
     def _partials(self, on: str) -> List[Optional[tuple]]:
+        """Per-block (n, mean, M2, min, max) — mean/M2 are the Welford
+        moments (None for non-numeric columns), mergeable without the
+        catastrophic cancellation of raw sum-of-squares."""
         @ray_tpu.remote
         def _part(b: Block):
-            v = np.asarray(b[on], dtype=np.float64) \
-                if np.asarray(b[on]).dtype.kind in "fiub" \
-                else np.asarray(b[on])
-            if v.size == 0:
+            raw = np.asarray(b[on])
+            if raw.size == 0:
                 return None
-            return (float(v.sum()), float((v.astype(np.float64) ** 2).sum()),
-                    v.min(), v.max(), int(v.size))
+            if raw.dtype.kind in "fiub":
+                v = raw.astype(np.float64)
+                mean = float(v.mean())
+                m2 = float(((v - mean) ** 2).sum())
+                s = raw.sum()  # native dtype: exact for integer columns
+            else:
+                mean = m2 = s = None  # min/max stay lexicographic
+            return (int(raw.size), mean, m2, raw.min(), raw.max(), s)
         return [p for p in ray_tpu.get(
             [_part.remote(r) for r in self._iter_refs()]) if p is not None]
 
+    @staticmethod
+    def _merged_moments(parts):
+        """Chan et al. parallel merge of per-block (n, mean, M2)."""
+        n, mean, m2 = 0, 0.0, 0.0
+        for pn, pmean, pm2 in parts:
+            if pmean is None:
+                raise TypeError("numeric aggregate on non-numeric column")
+            delta = pmean - mean
+            tot = n + pn
+            mean += delta * pn / tot
+            m2 += pm2 + delta * delta * n * pn / tot
+            n = tot
+        return n, mean, m2
+
     def sum(self, on: str):
         parts = self._partials(on)
-        return sum(p[0] for p in parts) if parts else None
+        if not parts:
+            return None
+        if parts[0][5] is None:
+            raise TypeError("numeric aggregate on non-numeric column")
+        return sum(p[5] for p in parts)
 
     def min(self, on: str):
         parts = self._partials(on)
-        return min(p[2] for p in parts) if parts else None
+        return min(p[3] for p in parts) if parts else None
 
     def max(self, on: str):
         parts = self._partials(on)
-        return max(p[3] for p in parts) if parts else None
+        return max(p[4] for p in parts) if parts else None
 
     def mean(self, on: str):
         parts = self._partials(on)
-        n = sum(p[4] for p in parts)
-        return float(sum(p[0] for p in parts) / n) if n else None
+        if not parts:
+            return None
+        _, mean, _ = self._merged_moments([p[:3] for p in parts])
+        return float(mean)
 
     def std(self, on: str, ddof: int = 1):
         parts = self._partials(on)
-        n = sum(p[4] for p in parts)
+        if not parts:
+            return None
+        n, _, m2 = self._merged_moments([p[:3] for p in parts])
         if n <= ddof:
             return None
-        s1 = sum(p[0] for p in parts)
-        s2 = sum(p[1] for p in parts)
-        return float(np.sqrt(max(0.0, (s2 - s1 * s1 / n) / (n - ddof))))
+        return float(np.sqrt(m2 / (n - ddof)))
 
     def unique(self, on: str) -> List[Any]:
         @ray_tpu.remote
