@@ -182,6 +182,7 @@ class GcsServer:
         # exact waiters instead of notify_all-storming every blocked call
         # into an O(oids) rescan (that was quadratic in batch gets)
         self._object_waiters: Dict[str, List[dict]] = {}
+        self._stack_reqs: List[Dict[str, str]] = []  # `ray_tpu stack` calls
         self.infeasible_tasks: List[dict] = []
         self.running: Dict[str, Tuple[str, dict]] = {}   # task_id -> (worker, spec)
         self.actors: Dict[str, ActorState] = {}
@@ -977,6 +978,11 @@ class GcsServer:
                 if a is not None:
                     a.spec["_killed"] = True  # intentional exit → no restart
                     a.death_reason = "exit_actor"
+        elif kind == "stack_dump":
+            with self.cv:
+                for req in self._stack_reqs:
+                    req[worker_id] = msg["text"]
+                self.cv.notify_all()
         elif kind == "log" and self.log_sink is not None:
             self.log_sink(msg["line"])
         elif kind == "profile_events":
@@ -1839,6 +1845,36 @@ class GcsServer:
     def _h_timeline(self, msg: dict) -> dict:
         with self.lock:
             return {"events": list(self.events)}
+
+    def _h_stack(self, msg: dict) -> dict:
+        """Stack dumps from every live worker (reference: ``ray stack``
+        via py-spy; here an in-process all-threads snapshot).  Each call
+        collects into its own request record (concurrent calls don't
+        clobber each other), waits on the cv (no polling), and only
+        counts workers whose dump request was actually delivered."""
+        collected: Dict[str, str] = {}
+        with self.cv:
+            self._stack_reqs.append(collected)
+            targets = [w for w in self.workers.values()
+                       if w.state in ("idle", "busy", "actor")
+                       and w.task_conn is not None]
+        try:
+            targets = [w for w in targets
+                       if w.push({"kind": "dump_stack"})]
+            deadline = time.time() + float(msg.get("timeout", 3.0))
+            with self.cv:
+                while len(collected) < len(targets):
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        break
+                    self.cv.wait(timeout=min(0.5, remaining))
+        finally:
+            with self.cv:
+                try:
+                    self._stack_reqs.remove(collected)
+                except ValueError:
+                    pass
+        return {"stacks": dict(collected), "expected": len(targets)}
 
     def _h_ping(self, msg: dict) -> dict:
         return {"pong": True, "time": time.time()}

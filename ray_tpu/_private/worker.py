@@ -58,6 +58,18 @@ def set_global_worker(w: Optional["Worker"]) -> None:
         _global_worker = w
 
 
+def _dump_all_stacks() -> str:
+    """All-thread stack snapshot of this process (``ray_tpu stack``)."""
+    import traceback
+    out = []
+    for tid, frame in sys._current_frames().items():
+        name = next((t.name for t in threading.enumerate()
+                     if t.ident == tid), "?")
+        out.append(f"--- thread {name} ({tid}) ---\n"
+                   + "".join(traceback.format_stack(frame)))
+    return "\n".join(out)
+
+
 def _counter():
     n = [0]
     lock = threading.Lock()
@@ -719,6 +731,12 @@ class Worker:
                 kind = msg.get("kind")
                 if kind == "cancel":
                     self._cancel_current(msg["task_id"])
+                elif kind == "dump_stack":
+                    # `ray_tpu stack` (reference: py-spy attach): dump all
+                    # threads from the reader thread — works mid-task and
+                    # inside actors
+                    self._send_event({"kind": "stack_dump",
+                                      "text": _dump_all_stacks()})
                 elif kind == "stop_worker":
                     self._stop.set()
                     tasks.put(None)

@@ -287,7 +287,9 @@ class Dataset:
                 v = raw.astype(np.float64)
                 mean = float(v.mean())
                 m2 = float(((v - mean) ** 2).sum())
-                s = raw.sum()  # native dtype: exact for integer columns
+                # integers/bools: native accumulation is exact; floats:
+                # float64 (a native float16/32 sum overflows/loses bits)
+                s = raw.sum() if raw.dtype.kind in "iub" else float(v.sum())
             else:
                 mean = m2 = s = None  # min/max stay lexicographic
             return (int(raw.size), mean, m2, raw.min(), raw.max(), s)

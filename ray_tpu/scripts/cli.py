@@ -145,6 +145,20 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """All-worker stack dump (reference: ``ray stack``)."""
+    _connect(args.address)
+    from ray_tpu._private import worker as _worker
+    resp = _worker.global_worker().rpc("stack")
+    got, expected = resp["stacks"], resp["expected"]
+    for wid, text in sorted(got.items()):
+        print(f"===== worker {wid} =====")
+        print(text)
+    if len(got) < expected:
+        print(f"({expected - len(got)} worker(s) did not reply in time)")
+    return 0
+
+
 def cmd_timeline(args) -> int:
     _connect(args.address)
     import ray_tpu
@@ -204,7 +218,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_join)
 
     for name, fn in (("status", cmd_status), ("timeline", cmd_timeline),
-                     ("memory", cmd_memory), ("metrics", cmd_metrics)):
+                     ("memory", cmd_memory), ("metrics", cmd_metrics),
+                     ("stack", cmd_stack)):
         sp = sub.add_parser(name)
         sp.add_argument("--address", default=None)
         if name == "timeline":

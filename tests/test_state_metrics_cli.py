@@ -160,3 +160,23 @@ def test_cli_start_status_stop():
     finally:
         r3 = _cli("stop")
         assert r3.returncode == 0, r3.stderr[-2000:]
+
+
+def test_stack_dump(ray_start_regular):
+    """`ray_tpu stack` analog: all-worker thread dumps (SURVEY.md §5.1)."""
+    import time as _t
+
+    from ray_tpu._private import worker as _wm
+
+    @ray_tpu.remote
+    def sleepy():
+        _t.sleep(8)
+        return 1
+
+    ref = sleepy.remote()
+    _t.sleep(0.8)  # let it dispatch
+    resp = _wm.global_worker().rpc("stack")
+    assert resp["expected"] >= 1
+    joined = "\n".join(resp["stacks"].values())
+    assert "sleepy" in joined or "sleep" in joined
+    ray_tpu.cancel(ref)
