@@ -1,0 +1,71 @@
+"""Long-context attention: Pallas flash (fused bwd) vs XLA dense.
+
+fwd+bwd step time per sequence length at constant ~8k total tokens.
+Measured on the attached chip (TPU v5 lite, 2026-07-30):
+
+    seq= 2048 b=4: dense  20.4ms   flash 20.1ms
+    seq= 4096 b=2: dense  36.9ms   flash 28.0ms   (1.3x)
+    seq= 8192 b=1: dense 376.9ms   flash 37.4ms   (10.1x)
+
+Dense materializes (B,H,T,T) f32 score temps — O(T²) HBM traffic that
+falls off a cliff once the working set exceeds VMEM-friendly tiling;
+flash streams K/V blocks with O(T·block) memory, and the fused Pallas
+backward (lse residual + in-kernel delta) keeps the bwd on the same
+schedule.  Usage: python benchmarks/attention_bench.py [--seqs 2048,4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def bench(fn, q, k, v, iters=8):
+    loss = lambda q, k, v: (fn(q, k, v).astype(jnp.float32) ** 2).sum()  # noqa: E731
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    try:
+        r = g(q, k, v)
+        float(jax.device_get(r[0][0, 0, 0, 0]))
+    except Exception as e:  # noqa: BLE001 - OOM / compile limits
+        return {"error": str(e)[:120]}
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = g(q, k, v)
+    float(jax.device_get(r[0][0, 0, 0, 0]))
+    return {"ms": round((time.perf_counter() - t0) / iters * 1e3, 1)}
+
+
+def main():
+    from ray_tpu.ops.attention import dense_attention
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="2048,4096,8192")
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--tokens", type=int, default=8192,
+                    help="total tokens per step (batch = tokens/seq)")
+    args = ap.parse_args()
+    for T in (int(s) for s in args.seqs.split(",")):
+        B = max(1, args.tokens // T)
+        ks = jax.random.split(jax.random.key(0), 3)
+        q, k, v = [jax.random.normal(kk, (B, T, args.heads, args.head_dim),
+                                     jnp.bfloat16) for kk in ks]
+        row = {"seq": T, "batch": B,
+               "dense": bench(lambda a, b, c: dense_attention(
+                   a, b, c, causal=True), q, k, v),
+               "flash": bench(lambda a, b, c: flash_attention(
+                   a, b, c, True), q, k, v)}
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,22 +32,23 @@ logger = rtlog.get("node-agent")
 class NodeAgent:
     def __init__(self, head_host: str, head_port: int, *,
                  num_cpus: Optional[int] = None,
+                 num_tpus: float = 0,
+                 labels: Optional[Dict[str, str]] = None,
                  resources: Optional[Dict[str, float]] = None):
         self.head = (head_host, head_port)
         self.num_workers = int(num_cpus or os.cpu_count() or 1)
+        self.num_tpus = float(num_tpus or 0)
         res = dict(resources or {})
-        device_keys = [k for k in res if k in ("TPU", "GPU")]
-        if device_keys:
-            # v1: agent workers are CPU-pinned; advertising device
-            # resources would route device tasks here to hang forever
-            raise ValueError(
-                f"NodeAgent v1 cannot offer device resources {device_keys} "
-                f"(its workers are CPU-only; see DESIGN.md)")
         res["CPU"] = float(self.num_workers)
+        if self.num_tpus:
+            # this host's chips: served by ONE device-holding worker (the
+            # same one-jax-process-per-host rule as head-local TPU workers)
+            res["TPU"] = self.num_tpus
+        all_labels = {"agent": "1", **(labels or {})}
         self._conn = protocol.tunnel_connect(*self.head, "gcs")
         self._chan = protocol.RpcChannel(self._conn)
         resp = self._chan.call("add_node", resources=res,
-                               labels={"agent": "1"}, remote=True)
+                               labels=all_labels, remote=True)
         self.node_id = resp["node_id"]
         # dedicate this connection to liveness: the head removes the node
         # when it drops (kill -9 / host crash / partition)
@@ -84,12 +85,17 @@ class NodeAgent:
         finally:
             s.close()
 
-    def _spawn(self) -> subprocess.Popen:
+    def _spawn(self, tpu: bool = False) -> subprocess.Popen:
         env = dict(os.environ)
         env["RTPU_PROXY_ADDR"] = f"{self.head[0]}:{self.head[1]}"
         env["RTPU_NODE_ID"] = self.node_id
         env["RTPU_ADVERTISE_HOST"] = self._advertise_host()
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        if tpu:
+            # device-holding worker: jax initializes the real platform
+            env["RTPU_TPU_WORKER"] = "1"
+            env.pop("JAX_PLATFORMS", None)
+        else:
+            env.setdefault("JAX_PLATFORMS", "cpu")
         env.pop("RTPU_SESSION_DIR", None)
         sink = None if os.environ.get("RTPU_AGENT_WORKER_LOG") \
             else subprocess.DEVNULL  # debug: inherit stderr when set
@@ -101,7 +107,9 @@ class NodeAgent:
         """Maintain the pool until stopped; respawn dead workers with
         exponential backoff (a head outage or startup import error must
         not become a silent fork loop)."""
-        self._procs = [self._spawn() for _ in range(self.num_workers)]
+        self._tpu_slots = 1 if self.num_tpus else 0
+        self._procs = [self._spawn(tpu=i < self._tpu_slots)
+                       for i in range(self._tpu_slots + self.num_workers)]
         spawn_times = [time.monotonic()] * self.num_workers
         backoff = [1.0] * self.num_workers
         while not self._stop.is_set():

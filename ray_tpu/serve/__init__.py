@@ -21,12 +21,14 @@ from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions  # noqa: F401
 from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
-from ray_tpu.serve.http_util import Request, Response  # noqa: F401
+from ray_tpu.serve.http_util import (Request, Response,  # noqa: F401
+                                     StreamingResponse)
 
 __all__ = [
     "deployment", "run", "start", "shutdown", "status", "delete",
     "get_app_handle", "get_deployment_handle", "get_http_address",
     "batch", "AutoscalingConfig", "HTTPOptions", "Application",
+    "StreamingResponse",
     "Deployment", "DeploymentHandle", "DeploymentResponse",
     "Request", "Response",
 ]

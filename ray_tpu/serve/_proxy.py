@@ -131,7 +131,10 @@ class ProxyActor:
             resp_f = handle._router().assign(
                 "__call__", (request,), {}, timeout_s=self._timeout)
             remaining = max(0.1, self._timeout - (time.monotonic() - start))
-            result = resp_f.result(timeout_s=remaining)
+            # raw result: a stream MARKER must reach the chunked-encoding
+            # path below, not result()'s generator conversion
+            result = ray_tpu.get(resp_f._to_object_ref(),
+                                 timeout=remaining)
         except ray_tpu.exceptions.GetTimeoutError:
             self._respond(req, 408, b"request timed out", "text/plain")
             return
@@ -141,8 +144,33 @@ class ProxyActor:
         except Exception as e:  # noqa: BLE001 - user code raised
             self._respond(req, 500, str(e).encode(), "text/plain")
             return
+        if isinstance(result, dict) and "__serve_stream__" in result:
+            self._respond_stream(req, result, resp_f)
+            return
         resp = coerce_response(result)
         self._respond(req, resp.status_code, resp.body, resp.content_type)
+
+    @staticmethod
+    def _respond_stream(req, marker: dict, resp_f) -> None:
+        """Chunked transfer encoding fed by replica-side generator pulls
+        (reference: Serve StreamingResponse over ASGI)."""
+        from ray_tpu.serve.http_util import encode_chunk
+        req.serve_response_started = True
+        req.send_response(marker.get("status", 200))
+        req.send_header("Content-Type",
+                        marker.get("content_type", "text/plain"))
+        req.send_header("Transfer-Encoding", "chunked")
+        req.end_headers()
+        try:
+            for chunk in resp_f._stream_chunks(marker["__serve_stream__"]):
+                b = encode_chunk(chunk)
+                if not b:
+                    continue  # empty chunk would terminate the encoding
+                req.wfile.write(f"{len(b):x}\r\n".encode() + b + b"\r\n")
+                req.wfile.flush()
+            req.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; replica reaper collects the stream
 
     @staticmethod
     def _respond(req, status: int, body: bytes, content_type: str) -> None:

@@ -52,6 +52,8 @@ class Replica:
                          name="replica-asyncio", daemon=True).start()
         init_args = _resolve_markers(tuple(init_args))
         init_kwargs = _resolve_markers(dict(init_kwargs))
+        self._streams: Dict[str, Tuple[Any, float]] = {}
+        self._streams_lock = threading.Lock()
         self._instance = user_cls(*init_args, **init_kwargs)
 
     def handle_request(self, method: str, args: Tuple, kwargs: Dict):
@@ -79,8 +81,72 @@ class Replica:
         if inspect.iscoroutinefunction(m):
             fut = asyncio.run_coroutine_threadsafe(
                 m(*args, **kwargs), self._loop)
-            return fut.result()
-        return m(*args, **kwargs)
+            result = fut.result()
+        else:
+            result = m(*args, **kwargs)
+        return self._maybe_register_stream(result)
+
+    # ------------------------------------------------------------ streaming
+    def _maybe_register_stream(self, result: Any):
+        """Generators / StreamingResponse stay replica-side; the caller
+        gets a marker and pulls chunks via ``stream_next`` (the router
+        pins continuations to THIS replica)."""
+        from ray_tpu.serve.http_util import StreamingResponse
+        status, ctype, it = 200, "text/plain", None
+        if isinstance(result, StreamingResponse):
+            status, ctype = result.status_code, result.content_type
+            it = (self._drive_asyncgen(result.content)
+                  if inspect.isasyncgen(result.content)
+                  else iter(result.content))
+        elif inspect.isgenerator(result):
+            it = result
+        elif inspect.isasyncgen(result):
+            it = self._drive_asyncgen(result)
+        if it is None:
+            return result
+        import time as _time
+        import uuid
+        sid = uuid.uuid4().hex
+        with self._streams_lock:
+            # reap streams abandoned by disconnected clients
+            now = _time.time()
+            for old in [s for s, (_, ts) in self._streams.items()
+                        if now - ts > 600]:
+                del self._streams[old]
+            self._streams[sid] = (it, now)
+        return {"__serve_stream__": sid, "status": status,
+                "content_type": ctype}
+
+    def _drive_asyncgen(self, agen):
+        while True:
+            fut = asyncio.run_coroutine_threadsafe(agen.__anext__(),
+                                                   self._loop)
+            try:
+                yield fut.result()
+            except StopAsyncIteration:
+                return
+
+    def stream_next(self, sid: str, max_chunks: int = 16):
+        """Pull up to ``max_chunks`` items; returns (chunks, done)."""
+        import time as _time
+        with self._streams_lock:
+            entry = self._streams.get(sid)
+        if entry is None:
+            return [], True
+        it = entry[0]
+        chunks, done = [], False
+        for _ in range(max_chunks):
+            try:
+                chunks.append(next(it))
+            except StopIteration:
+                done = True
+                break
+        with self._streams_lock:
+            if done:
+                self._streams.pop(sid, None)
+            elif sid in self._streams:
+                self._streams[sid] = (it, _time.time())
+        return chunks, done
 
     def check_health(self) -> bool:
         chk = getattr(self._instance, "check_health", None)

@@ -40,7 +40,23 @@ class DeploymentResponse:
         self._replica_tag = replica_tag
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        out = ray_tpu.get(self._ref, timeout=timeout_s)
+        if isinstance(out, dict) and "__serve_stream__" in out:
+            # streaming method: hand back a generator that pulls chunks
+            # from the replica that owns the generator state
+            return self._stream_chunks(out["__serve_stream__"])
+        return out
+
+    def _stream_chunks(self, sid: str):
+        with self._router._lock:
+            handle = self._router._replicas.get(self._replica_tag)
+        while handle is not None:
+            chunks, done = ray_tpu.get(handle.stream_next.remote(sid))
+            yield from chunks
+            if done:
+                return
+        raise ray_tpu.exceptions.RayServeError(
+            "streaming replica went away mid-stream")
 
     def _to_object_ref(self):
         return self._ref

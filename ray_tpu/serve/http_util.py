@@ -63,6 +63,27 @@ class Response:
                         self.content_type or "application/json")
 
 
+class StreamingResponse:
+    """Incremental response (reference: ``StreamingResponse``): ``content``
+    is any iterable/generator; chunks reach the client as produced —
+    HTTP clients via chunked transfer encoding, handle callers as a
+    generator from ``DeploymentResponse.result()``."""
+
+    def __init__(self, content, content_type: str = "text/plain",
+                 status_code: int = 200):
+        self.content = content
+        self.content_type = content_type
+        self.status_code = status_code
+
+
+def encode_chunk(chunk: object) -> bytes:
+    if isinstance(chunk, bytes):
+        return chunk
+    if isinstance(chunk, str):
+        return chunk.encode()
+    return _json.dumps(chunk).encode()
+
+
 def coerce_response(value: object) -> Response:
     if isinstance(value, Response):
         return value.encode()

@@ -201,3 +201,26 @@ def test_redeploy_and_delete(serve_cluster):
     assert handle.remote(None).result() == 2
     serve.delete("default")
     assert serve.status() == {}
+
+
+def test_streaming_response_handle_and_http(serve_cluster):
+    """Generators stream incrementally: handle path yields chunks as
+    produced; HTTP path uses chunked transfer encoding."""
+    @serve.deployment
+    class Tokens:
+        def stream(self, n):
+            for i in range(n):
+                yield f"tok{i} "
+
+        def __call__(self, request):
+            return serve.StreamingResponse(
+                (f"c{i}|" for i in range(5)), content_type="text/plain")
+
+    handle = serve.run(Tokens.bind(), route_prefix="/stream")
+    # handle path: result() is a generator
+    got = list(handle.stream.remote(4).result())
+    assert got == ["tok0 ", "tok1 ", "tok2 ", "tok3 "]
+    # HTTP path: chunked transfer, body reassembled by the client
+    status, body = _http("GET", _base_url() + "/stream")
+    assert status == 200
+    assert body.decode() == "c0|c1|c2|c3|c4|"
