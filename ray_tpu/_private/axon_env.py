@@ -1,0 +1,75 @@
+"""Single source of truth for scrubbing the TPU-tunnel environment.
+
+The ambient environment on TPU-tunnel hosts pins ``JAX_PLATFORMS`` to the
+tunnel's PJRT plugin and pre-registers it via a ``sitecustomize.py`` on
+``PYTHONPATH``; any process that imports jax with those vars set claims the
+real chip (and pays a multi-second plugin init, or blocks if the chip is
+already claimed).  Three places need the same scrub — the test rig
+(``tests/conftest.py``), CPU worker spawns (``_private/gcs.py``), and the
+driver's multi-chip dryrun (``__graft_entry__.py``) — so it lives here, with
+no jax (or heavy ray_tpu) imports of its own.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import MutableMapping, Optional
+
+# Every env var the tunnel's sitecustomize reacts to.  Popping only the
+# pool-IPs var is enough to skip plugin *registration*, but the others leak
+# tunnel behavior into children that re-set it, so drop the whole set.
+AXON_ENV_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "AXON_POOL_SVC_OVERRIDE",
+    "AXON_LOOPBACK_RELAY",
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "PALLAS_AXON_TPU_GEN",
+    "TPU_WORKER_HOSTNAMES",
+)
+
+
+def _is_tunnel_site_dir(path: str) -> bool:
+    """True for the tunnel's sitecustomize dir specifically (it holds both a
+    ``sitecustomize.py`` and the plugin package) — NOT any path merely
+    containing the substring "axon", which would strip unrelated user
+    packages from PYTHONPATH."""
+    return (os.path.isfile(os.path.join(path, "sitecustomize.py"))
+            and os.path.isdir(os.path.join(path, "axon")))
+
+
+def tpu_tunnel_present(env: Optional[MutableMapping] = None) -> bool:
+    """True when the ambient env routes jax to the real-TPU tunnel."""
+    env = os.environ if env is None else env
+    return bool(env.get("PALLAS_AXON_POOL_IPS"))
+
+
+def scrub_tpu_tunnel(
+    env: MutableMapping,
+    *,
+    cpu_devices: Optional[int] = None,
+    drop_plugin_pythonpath: bool = False,
+) -> MutableMapping:
+    """Mutate ``env`` so a process seeing it runs jax on the CPU backend.
+
+    ``env`` may be ``os.environ`` (scrub the current process before jax is
+    imported) or a child-process env dict.
+
+    - ``cpu_devices``: if set, force that many virtual CPU host devices via
+      ``XLA_FLAGS`` (replacing any existing force-count flag).
+    - ``drop_plugin_pythonpath``: also remove the sitecustomize dir from
+      ``PYTHONPATH`` so even the plugin *registration hook* never runs
+      (needed when the child must not pay the plugin import at all).
+    """
+    for k in AXON_ENV_VARS:
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if drop_plugin_pythonpath:
+        parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                 if p and not _is_tunnel_site_dir(p)]
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+    if cpu_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={cpu_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env

@@ -9,21 +9,21 @@
 
 import os
 
-# Must be set before jax import anywhere in the test process.  Force CPU even
-# when the environment tunnels a real TPU (a sitecustomize may pre-register
-# the TPU PJRT plugin, so the env var alone is not enough — the jax.config
-# update below wins): unit tests run on the 8-virtual-device rig; only
-# bench.py uses the real chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Must run before jax import anywhere in the test process.  Force CPU even
+# when the environment tunnels a real TPU (shared scrub in
+# ray_tpu._private.axon_env; the jax.config update below wins even if a
+# sitecustomize pre-registered the TPU plugin): unit tests run on the
+# 8-virtual-device rig; only bench.py uses the real chip.  TPU-capable
+# workers inherit env, and the rig must never grab the real chip (or pay
+# the 3.4s sitecustomize plugin registration per worker).
+from ray_tpu._private.axon_env import scrub_tpu_tunnel  # noqa: E402
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+scrub_tpu_tunnel(
+    os.environ,
+    cpu_devices=(None if "xla_force_host_platform_device_count" in _flags
+                 else 8))
 os.environ.setdefault("RTPU_OBJECT_STORE_MEMORY_MB", "256")
-# Drop the TPU tunnel from the whole test session: TPU-capable workers
-# inherit env, and the rig must never grab the real chip (or pay the
-# 3.4s sitecustomize plugin registration per worker).
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 
