@@ -459,11 +459,11 @@ class GcsServer:
             GLOBAL_CONFIG.apply_xla_cache_env(env)
         else:
             # Plain workers never grab the TPU: jax must not lock the chip
-            # in every spawned process.
-            env.setdefault("JAX_PLATFORMS", "cpu")
-            # Skip the axon/jax PJRT registration in sitecustomize (3.4s
-            # import tax per process) — CPU workers don't touch the tunnel.
-            env.pop("PALLAS_AXON_POOL_IPS", None)
+            # in every spawned process, and the sitecustomize PJRT
+            # registration is a 3.4s import tax — shared scrub drops the
+            # whole tunnel env set (ray_tpu._private.axon_env).
+            from ray_tpu._private.axon_env import scrub_tpu_tunnel
+            scrub_tpu_tunnel(env)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env, cwd=os.getcwd(),
@@ -1812,10 +1812,16 @@ class GcsServer:
                 fd = os.open(str(_seg_path(oid)),
                              os.O_CREAT | os.O_RDWR, 0o600)
                 os.ftruncate(fd, max(total, 1))
-                st = {"fd": fd, "got": 0, "ts": time.time()}
+                st = {"fd": fd, "offsets": set(), "got": 0,
+                      "ts": time.time()}
                 self._staging[oid] = st
             os.pwrite(st["fd"], data, off)
-            st["got"] += len(data)
+            # Completion tracks *covered offsets*, not cumulative bytes: a
+            # retried/duplicated chunk must not double-count and seal a
+            # segment that still has holes.
+            if off not in st["offsets"]:
+                st["offsets"].add(off)
+                st["got"] += len(data)
             st["ts"] = time.time()
             done = st["got"] >= total
             if done:

@@ -95,7 +95,10 @@ class NodeAgent:
             env["RTPU_TPU_WORKER"] = "1"
             env.pop("JAX_PLATFORMS", None)
         else:
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            # CPU workers must not claim the chip or pay the tunnel's
+            # sitecustomize import (shared scrub, ray_tpu._private.axon_env)
+            from ray_tpu._private.axon_env import scrub_tpu_tunnel
+            scrub_tpu_tunnel(env)
         env.pop("RTPU_SESSION_DIR", None)
         sink = None if os.environ.get("RTPU_AGENT_WORKER_LOG") \
             else subprocess.DEVNULL  # debug: inherit stderr when set
@@ -110,8 +113,8 @@ class NodeAgent:
         self._tpu_slots = 1 if self.num_tpus else 0
         self._procs = [self._spawn(tpu=i < self._tpu_slots)
                        for i in range(self._tpu_slots + self.num_workers)]
-        spawn_times = [time.monotonic()] * self.num_workers
-        backoff = [1.0] * self.num_workers
+        spawn_times = [time.monotonic()] * len(self._procs)
+        backoff = [1.0] * len(self._procs)
         while not self._stop.is_set():
             time.sleep(0.5)
             for i, p in enumerate(self._procs):
@@ -129,7 +132,9 @@ class NodeAgent:
                     backoff[i] = 1.0
                 if self._stop.is_set():
                     break  # stop() during the backoff wait: no respawn
-                self._procs[i] = self._spawn()
+                # slot i keeps its role: a dead TPU worker must come back
+                # TPU-capable or TPU tasks pinned to this node hang forever
+                self._procs[i] = self._spawn(tpu=i < self._tpu_slots)
                 spawn_times[i] = time.monotonic()
 
     def stop(self) -> None:
@@ -152,18 +157,71 @@ class NodeAgent:
             pass
 
 
+def _detect_tpu_env() -> Dict[str, str]:
+    """TPU topology hints from the ambient environment (GKE TPU node pools
+    export TPU_WORKER_ID/TPU_WORKER_HOSTNAMES/TPU_ACCELERATOR_TYPE; the
+    deploy/k8s manifests additionally pass RTPU_* explicitly).
+
+    ``ici_domain`` must be unique *per slice* ("<topology>/<slice-id>",
+    parallel/topology.py convention), not per accelerator type — two
+    distinct v5litepod-8 slices share no ICI, and collapsing them into one
+    domain would let STRICT_PACK span disconnected slices.  The slice
+    identity comes from TPU_WORKER_HOSTNAMES (identical on every host of a
+    slice, distinct across slices)."""
+    import hashlib
+
+    labels = {}
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE")  # e.g. "v5litepod-8"
+    if acc:
+        labels["tpu_accelerator"] = acc
+        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        slice_id = hashlib.sha1(hosts.encode()).hexdigest()[:8] if hosts \
+            else "0"
+        labels.setdefault("ici_domain", f"{acc}/{slice_id}")
+    wid = os.environ.get("TPU_WORKER_ID")
+    if wid is not None:
+        labels["slice_host"] = str(wid)
+    return labels
+
+
+def parse_labels(spec: str) -> Dict[str, str]:
+    """``k=v,k2=v2`` → dict (CLI --labels format).  A bare item without
+    '=' is rejected: a typo'd label (e.g. ``ici_domain`` for
+    ``ici_domain=...``) must fail fast, not register an empty-string label
+    that label-equality placement would silently group on."""
+    out: Dict[str, str] = {}
+    for item in (spec or "").split(","):
+        if not item:
+            continue
+        k, sep, v = item.partition("=")
+        if not sep or not k.strip():
+            raise ValueError(f"malformed label {item!r}: expected k=v")
+        out[k.strip()] = v.strip()
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     ap = argparse.ArgumentParser(prog="ray_tpu node-agent")
     ap.add_argument("--address", required=True, help="head HOST:PORT "
                     "(the head's --client-server-port)")
     ap.add_argument("--num-cpus", type=int, default=0)
+    ap.add_argument("--num-tpus", type=float,
+                    default=float(os.environ.get("RTPU_NUM_TPUS", 0) or 0),
+                    help="TPU chips on this host (default: $RTPU_NUM_TPUS); "
+                         "served by one device-holding worker")
+    ap.add_argument("--labels", default=os.environ.get("RTPU_NODE_LABELS", ""),
+                    help="node labels k=v,k2=v2 (default: $RTPU_NODE_LABELS); "
+                         "merged over GKE TPU metadata autodetection")
     args = ap.parse_args(argv)
     host, _, port = args.address.partition(":")
     protocol.set_authkey_from_env()
     rtlog.setup("node-agent", None)
+    labels = {**_detect_tpu_env(), **parse_labels(args.labels)}
     agent = NodeAgent(host, int(port or 10001),
-                      num_cpus=args.num_cpus or None)
+                      num_cpus=args.num_cpus or None,
+                      num_tpus=args.num_tpus,
+                      labels=labels or None)
     signal.signal(signal.SIGTERM, lambda *_: agent.stop())
     try:
         agent.run()

@@ -91,6 +91,12 @@ def cmd_join(args) -> int:
     argv = ["--address", args.address]
     if args.num_cpus:
         argv += ["--num-cpus", str(args.num_cpus)]
+    # forward explicit values even when falsy ("--num-tpus 0" must be able
+    # to override an ambient $RTPU_NUM_TPUS)
+    if args.num_tpus is not None:
+        argv += ["--num-tpus", str(args.num_tpus)]
+    if args.labels is not None:
+        argv += ["--labels", args.labels]
     return na.main(argv)
 
 
@@ -215,6 +221,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(set RTPU_AUTH_KEY to the head session's key)")
     sp.add_argument("--address", required=True, help="head HOST:PORT")
     sp.add_argument("--num-cpus", type=int, default=0)
+    sp.add_argument("--num-tpus", type=float, default=None,
+                    help="TPU chips on this host (also $RTPU_NUM_TPUS / GKE "
+                         "TPU metadata autodetection)")
+    sp.add_argument("--labels", default=None,
+                    help="node labels k=v,k2=v2 (e.g. ici_domain=...,"
+                         "slice_host=0; also $RTPU_NODE_LABELS)")
     sp.set_defaults(fn=cmd_join)
 
     for name, fn in (("status", cmd_status), ("timeline", cmd_timeline),

@@ -48,15 +48,23 @@ class DeploymentResponse:
         return out
 
     def _stream_chunks(self, sid: str):
-        with self._router._lock:
-            handle = self._router._replicas.get(self._replica_tag)
-        while handle is not None:
-            chunks, done = ray_tpu.get(handle.stream_next.remote(sid))
+        # Re-look-up the replica on every pull: generator state lives on
+        # the replica, so a replica that dies (or is scaled away) mid-stream
+        # must surface as RayServeError, not a raw actor error.
+        while True:
+            with self._router._lock:
+                handle = self._router._replicas.get(self._replica_tag)
+            if handle is None:
+                raise ray_tpu.exceptions.RayServeError(
+                    "streaming replica went away mid-stream")
+            try:
+                chunks, done = ray_tpu.get(handle.stream_next.remote(sid))
+            except ray_tpu.exceptions.RayActorError as e:
+                raise ray_tpu.exceptions.RayServeError(
+                    "streaming replica died mid-stream") from e
             yield from chunks
             if done:
                 return
-        raise ray_tpu.exceptions.RayServeError(
-            "streaming replica went away mid-stream")
 
     def _to_object_ref(self):
         return self._ref

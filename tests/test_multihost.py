@@ -209,3 +209,61 @@ def test_remote_node_removed_on_agent_exit(ray_start_2_cpus):
         time.sleep(0.2)
     assert not alive, "remote node still alive after agent exit"
     proxy.stop()
+
+
+def test_put_chunk_duplicate_does_not_seal_holes(ray_start_regular):
+    """A retried/duplicated chunk must not double-count toward completion
+    and seal a segment that still has holes (ObjectManager chunked-transfer
+    semantics: completion = covered offsets, not cumulative bytes)."""
+    import ray_tpu as rt
+
+    head = rt._head
+    oid = "putchunkdup0000000000000000000001"
+    chunk = b"x" * 1024
+    total = 3 * len(chunk)
+    r = head._h_put_chunk({"object_id": oid, "offset": 0, "total": total,
+                           "data": chunk})
+    assert not r["done"]
+    # duplicate of offset 0 (e.g. an uploader retry after a dropped reply)
+    r = head._h_put_chunk({"object_id": oid, "offset": 0, "total": total,
+                           "data": chunk})
+    assert not r["done"]
+    r = head._h_put_chunk({"object_id": oid, "offset": 1024, "total": total,
+                           "data": chunk})
+    assert not r["done"], "segment still has a hole at offset 2048"
+    r = head._h_put_chunk({"object_id": oid, "offset": 2048, "total": total,
+                           "data": chunk})
+    assert r["done"]
+
+
+def test_node_agent_label_parsing():
+    """`ray_tpu join --labels` format + GKE TPU metadata autodetection."""
+    from ray_tpu._private import node_agent as na
+
+    assert na.parse_labels("a=1,b=x y") == {"a": "1", "b": "x y"}
+    assert na.parse_labels("") == {}
+    old = dict(os.environ)
+    try:
+        os.environ["TPU_ACCELERATOR_TYPE"] = "v5litepod-8"
+        os.environ["TPU_WORKER_ID"] = "2"
+        os.environ["TPU_WORKER_HOSTNAMES"] = "h0,h1"
+        labels = na._detect_tpu_env()
+        assert labels["tpu_accelerator"] == "v5litepod-8"
+        # per-slice unique domain: "<topology>/<slice-id>", NOT the bare
+        # accelerator type (two slices of the same type share no ICI)
+        assert labels["ici_domain"].startswith("v5litepod-8/")
+        assert labels["ici_domain"] != "v5litepod-8/0"
+        assert labels["slice_host"] == "2"
+        os.environ["TPU_WORKER_HOSTNAMES"] = "h2,h3"
+        assert na._detect_tpu_env()["ici_domain"] != labels["ici_domain"]
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+
+
+def test_parse_labels_rejects_malformed():
+    from ray_tpu._private import node_agent as na
+    with pytest.raises(ValueError):
+        na.parse_labels("ici_domain")  # missing =v must fail fast
+    with pytest.raises(ValueError):
+        na.parse_labels("=v")
