@@ -45,7 +45,7 @@ def bench(fn, q, k, v, iters=8):
 
 def main():
     from ray_tpu.ops.attention import dense_attention
-    from ray_tpu.ops.flash_attention import flash_attention
+    from ray_tpu.ops.flash_attention import flash_attention, pick_block_size
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", default="2048,4096,8192")
@@ -63,7 +63,7 @@ def main():
                "dense": bench(lambda a, b, c: dense_attention(
                    a, b, c, causal=True), q, k, v),
                "flash": bench(lambda a, b, c: flash_attention(
-                   a, b, c, True), q, k, v)}
+                   a, b, c, True, pick_block_size(a.shape[1])), q, k, v)}
         print(json.dumps(row), flush=True)
 
 

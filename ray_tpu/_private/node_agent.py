@@ -174,9 +174,14 @@ def _detect_tpu_env() -> Dict[str, str]:
     acc = os.environ.get("TPU_ACCELERATOR_TYPE")  # e.g. "v5litepod-8"
     if acc:
         labels["tpu_accelerator"] = acc
+        # Multi-host slices: TPU_WORKER_HOSTNAMES is identical on every
+        # host of the slice and distinct across slices.  Single-host node
+        # pools don't get it — there each HOST is its own ICI domain, so
+        # fall back to this host's name (never a shared constant: two
+        # single-host nodes of the same type share no ICI).
         hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
-        slice_id = hashlib.sha1(hosts.encode()).hexdigest()[:8] if hosts \
-            else "0"
+        ident = hosts or socket.gethostname()
+        slice_id = hashlib.sha1(ident.encode()).hexdigest()[:8]
         labels.setdefault("ici_domain", f"{acc}/{slice_id}")
     wid = os.environ.get("TPU_WORKER_ID")
     if wid is not None:

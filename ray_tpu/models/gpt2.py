@@ -45,9 +45,12 @@ class GPT2Config:
     remat: bool = True
     # full: recompute everything in bwd (min HBM).  dots: save matmul
     # outputs without batch dims (MLP/projections) and recompute only
-    # attention — the standard transformer trade (big step-time win when
-    # HBM allows).  Ignored when remat=False.
-    remat_policy: str = "full"  # full | dots
+    # attention.  attn: save ONLY the flash-attention residuals (out+lse,
+    # tagged via checkpoint_name in ops/flash_attention.py) so the
+    # rematerialized backward skips re-running the flash forward kernel —
+    # measured v5e b32/s1024: the biggest recompute in the step; requires
+    # attn_impl="flash".  Ignored when remat=False.
+    remat_policy: str = "full"  # full | dots | attn
     attn_impl: str = "dense"   # dense | flash | blockwise | ring | ulysses
     # >0: compute the LM-head matmul + cross entropy in this many sequence
     # chunks under jax.checkpoint, so the (B, T, vocab) f32 logits never
@@ -214,6 +217,17 @@ def forward_hidden(params: Params, tokens: jax.Array,
             block = jax.checkpoint(
                 block,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy == "attn":
+            if cfg.attn_impl != "flash":
+                # the saved names are tagged only inside the flash vjp;
+                # with any other impl this policy would silently behave
+                # as full remat
+                raise ValueError(
+                    "remat_policy='attn' requires attn_impl='flash'")
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "flash_attn_out", "flash_attn_lse"))
         elif cfg.remat_policy == "full":
             block = jax.checkpoint(block)
         else:

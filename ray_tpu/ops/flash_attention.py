@@ -184,6 +184,8 @@ def _unflatten(x, B, H):
 
 
 def _resolve(block_size, T, interpret):
+    if block_size is None:
+        block_size = pick_block_size(T)
     bs = min(block_size, T)
     if T % bs:
         raise ValueError(f"seq len {T} not divisible by block {bs}")
@@ -269,9 +271,14 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, block_size: int,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = True, block_size: int = DEFAULT_BLOCK,
+                    causal: bool = True,
+                    block_size: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """(B,T,H,D)×3 → (B,T,H,D) tiled attention; differentiable."""
+    """(B,T,H,D)×3 → (B,T,H,D) tiled attention; differentiable.
+
+    ``block_size=None`` (default) resolves via ``pick_block_size`` — the
+    measured-fastest tile for the sequence length — so every caller gets
+    the tuned configuration without opting in."""
     out, _ = _flash_forward_lse(q, k, v, causal=causal,
                                 block_size=block_size, interpret=interpret,
                                 want_lse=False)
@@ -281,6 +288,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _fwd(q, k, v, causal, block_size, interpret):
     out, lse = _flash_forward_lse(q, k, v, causal=causal,
                                   block_size=block_size, interpret=interpret)
+    # Name the backward residuals so a jax.checkpoint policy
+    # (save_only_these_names, models/gpt2.py remat_policy="attn") can pin
+    # them across the remat boundary: saving out+lse (~52MB + ~200MB per
+    # GPT-2-small layer at b32/s1024) lets the rematerialized backward skip
+    # re-running the whole flash forward kernel — the single largest
+    # recompute in the step.
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "flash_attn_out")
+    lse = checkpoint_name(lse, "flash_attn_lse")
     return out, (q, k, v, out, lse)
 
 
@@ -291,6 +307,18 @@ def _bwd(causal, block_size, interpret, res, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+def pick_block_size(T: int) -> int:
+    """Largest block in {512, 256, 128} dividing T.  Measured on v5e
+    (benchmarks/attention_bench.py --seqs 1024 --tokens 32768): fwd+bwd
+    per-call 33.6/25.0/21.5 ms at blocks 128/256/512 — bigger q/k tiles
+    amortize the per-grid-step VPU chain (mask iota, exp, rescale) and
+    feed the MXU (block, D)x(D, block) dots with fuller tiles."""
+    for bs in (512, 256, 128):
+        if T % bs == 0:
+            return bs
+    return min(T, DEFAULT_BLOCK)
 
 
 def flash_attention_for_model(q, k, v, cfg=None, **_):
