@@ -32,23 +32,17 @@ class DQNPolicy:
         self.observation_space = observation_space
         self.action_space = action_space
         self.config = config
-        hiddens = tuple(config.get("fcnet_hiddens", (64, 64)))
-        self.model_config = models.ModelConfig(
-            obs_dim=models.flat_obs_dim(observation_space),
-            num_outputs=int(action_space.n), hiddens=hiddens)
-        self._num_layers = len(hiddens) + 1
+        self.model_config = models.make_model_config(
+            observation_space, action_space,
+            {"fcnet_hiddens": (64, 64), **config})
         seed = config.get("seed", 0)
-        self.params = models.init_q_net(jax.random.key(seed),
-                                        self.model_config)
+        # catalog: MLP Q-net for flat obs, Nature-CNN torso + linear Q
+        # head for rank-3 (pixel) obs
+        self.params, self.q_apply = models.make_q_net(
+            jax.random.key(seed), self.model_config)
         self.epsilon = float(config.get("initial_epsilon", 1.0))
         self._rng = np.random.default_rng(seed)
-        n_layers = self._num_layers
-
-        @jax.jit
-        def _q(params, obs):
-            return models.q_net_apply(params, obs, n_layers)
-
-        self._q = _q
+        self._q = jax.jit(self.q_apply)
 
     def compute_actions(self, obs: np.ndarray, explore: bool = True):
         q = np.asarray(self._q(self.params, jnp.asarray(obs, jnp.float32)))
@@ -73,7 +67,7 @@ class DQNPolicy:
         return np.asarray(q.max(axis=-1))
 
     def get_weights(self):
-        return {"params": jax.tree_util.tree_map(np.asarray, self.params),
+        return {"params": models.pull_params(self.params),
                 "epsilon": self.epsilon}
 
     def set_weights(self, weights):
@@ -142,18 +136,16 @@ class DQN(Algorithm):
         self._rng = np.random.default_rng(config.get("seed") or 0)
         gamma = float(config["gamma"])
         double_q = bool(config["double_q"])
-        n_layers = policy._num_layers
+        q_apply = policy.q_apply
         optimizer = self._optimizer
 
         def loss_fn(params, target_params, mb):
-            q = models.q_net_apply(params, mb[OBS], n_layers)
+            q = q_apply(params, mb[OBS])
             q_taken = jnp.take_along_axis(
                 q, mb[ACTIONS][:, None].astype(jnp.int32), axis=1)[:, 0]
-            q_next_target = models.q_net_apply(target_params, mb[NEXT_OBS],
-                                               n_layers)
+            q_next_target = q_apply(target_params, mb[NEXT_OBS])
             if double_q:
-                q_next_online = models.q_net_apply(params, mb[NEXT_OBS],
-                                                   n_layers)
+                q_next_online = q_apply(params, mb[NEXT_OBS])
                 best = jnp.argmax(q_next_online, axis=-1)
                 q_next = jnp.take_along_axis(
                     q_next_target, best[:, None], axis=1)[:, 0]

@@ -96,6 +96,93 @@ class RandomEnv:
 register_env("RandomEnv", lambda cfg: RandomEnv(cfg))
 
 
+class RandomPixelEnv:
+    """Atari-shaped random pixels (default 84×84×4 uint8) — the pixel
+    analog of RandomEnv, used for conv-policy plumbing tests and pixel
+    rollout throughput benchmarks (reference: baseline #3 'IMPALA Atari
+    pixel' runs 84×84×4 stacked frames; no ALE ships in this image, so
+    throughput is measured against synthetic frames of the same shape)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.size = int(config.get("size", 84))
+        self.frames = int(config.get("frames", 4))
+        self.num_actions = int(config.get("num_actions", 6))
+        self.episode_len = int(config.get("episode_len", 128))
+        shape = (self.size, self.size, self.frames)
+        self.observation_space = make_box(0, 255, shape, np.uint8)
+        self.action_space = make_discrete(self.num_actions)
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        self._t += 1
+        return self._obs(), float(self._rng.uniform()), False, \
+            self._t >= self.episode_len, {}
+
+    def _obs(self):
+        return self._rng.integers(
+            0, 256, (self.size, self.size, self.frames), dtype=np.uint8)
+
+
+class PixelSquareEnv:
+    """Learnable pixel task: a bright square sits in the LEFT or RIGHT
+    half of the frame; action 0 = "left", 1 = "right"; reward 1.0 for
+    naming the correct side, else 0.  A random policy averages 0.5 —
+    only a net that actually *sees* the frame beats it, which makes this
+    the conv-policy learning test (an in-tree stand-in for Atari; the
+    reference uses ALE which this image does not ship)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.size = int(config.get("size", 84))
+        self.frames = int(config.get("frames", 4))
+        self.square = int(config.get("square", max(8, self.size // 7)))
+        self.episode_len = int(config.get("episode_len", 16))
+        if self.square >= self.size // 2:
+            raise ValueError(
+                f"square ({self.square}) must fit inside one half of the "
+                f"frame (size {self.size} → half {self.size // 2}); pass a "
+                f"smaller 'square' or a larger 'size'")
+        shape = (self.size, self.size, self.frames)
+        self.observation_space = make_box(0, 255, shape, np.uint8)
+        self.action_space = make_discrete(2)
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._t = 0
+        self._side = 0
+
+    def _obs(self):
+        obs = np.zeros((self.size, self.size, self.frames), np.uint8)
+        self._side = int(self._rng.integers(2))
+        half = self.size // 2
+        x0 = int(self._rng.integers(0, half - self.square)) \
+            + (half if self._side else 0)
+        y0 = int(self._rng.integers(0, self.size - self.square))
+        obs[y0:y0 + self.square, x0:x0 + self.square, :] = 255
+        return obs
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        reward = 1.0 if int(action) == self._side else 0.0
+        self._t += 1
+        return self._obs(), reward, False, self._t >= self.episode_len, {}
+
+
+register_env("RandomPixelEnv", lambda cfg: RandomPixelEnv(cfg))
+register_env("PixelSquareEnv", lambda cfg: PixelSquareEnv(cfg))
+
+
 def create_env(env: Any, env_config: Optional[dict] = None):
     """Resolve an env spec: registered name, gymnasium id, class, or
     callable."""

@@ -31,36 +31,34 @@ class Policy:
         self.action_space = action_space
         self.config = config
         self.dist_class = models.get_dist_class(action_space)
-        hiddens = tuple(config.get("fcnet_hiddens", (256, 256)))
-        self.model_config = models.ModelConfig(
-            obs_dim=models.flat_obs_dim(observation_space),
-            num_outputs=models.num_dist_inputs(action_space),
-            hiddens=hiddens)
+        self.model_config = models.make_model_config(
+            observation_space, action_space, config)
         seed = config.get("seed", 0)
-        self.params = models.init_actor_critic(
+        # catalog: MLP towers for flat obs, shared Nature-CNN torso +
+        # linear heads for rank-3 (pixel) obs
+        self.params, self._apply = models.make_actor_critic(
             jax.random.key(seed), self.model_config)
         self._key = jax.random.key(seed + 1)
-        n_hidden = len(hiddens)
         dist = self.dist_class
+        apply = self._apply
 
         @jax.jit
         def _act(params, obs, key):
-            inputs, values = models.actor_critic_apply(params, obs, n_hidden)
+            inputs, values = apply(params, obs)
             actions = dist.sample(inputs, key)
             logp = dist.logp(inputs, actions)
             return actions, logp, inputs, values
 
         @jax.jit
         def _act_det(params, obs):
-            inputs, values = models.actor_critic_apply(params, obs, n_hidden)
+            inputs, values = apply(params, obs)
             return dist.deterministic(inputs), inputs, values
 
         self._act, self._act_det = _act, _act_det
 
     def apply_fn(self, params, obs):
         """(dist_inputs, values) — used by algorithm loss fns."""
-        return models.actor_critic_apply(
-            params, obs, len(self.model_config.hiddens))
+        return self._apply(params, obs)
 
     def compute_actions(self, obs: np.ndarray, explore: bool = True
                         ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
@@ -87,7 +85,7 @@ class Policy:
         return np.asarray(values)
 
     def get_weights(self) -> Dict[str, Any]:
-        return jax.tree_util.tree_map(np.asarray, self.params)
+        return models.pull_params(self.params)
 
     def set_weights(self, weights: Dict[str, Any]) -> None:
         self.params = jax.tree_util.tree_map(jnp.asarray, weights)

@@ -244,3 +244,91 @@ def test_multi_agent_ppo_smoke(ray_start_regular):
     assert set(w) == {"p0", "p1"}
     algo.set_weights(w)
     algo.stop()
+
+
+# ------------------------------------------------------------ pixel / CNN
+
+def test_conv_catalog_shapes():
+    """Rank-3 obs get the Nature CNN by default; AC and Q heads share the
+    torso layout (reference: rllib/models catalog CNNs)."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib import models
+    from ray_tpu.rllib.env import PixelSquareEnv
+
+    env = PixelSquareEnv()
+    mc = models.make_model_config(env.observation_space, env.action_space, {})
+    assert mc.conv_filters == models.NATURE_CNN_FILTERS
+    assert mc.obs_shape == (84, 84, 4)
+    params, apply = models.make_actor_critic(jax.random.key(0), mc)
+    obs = jnp.zeros((3, 84, 84, 4), jnp.float32)
+    logits, values = apply(params, obs)
+    assert logits.shape == (3, 2) and values.shape == (3,)
+    qp, q_apply = models.make_q_net(jax.random.key(1), mc)
+    assert q_apply(qp, obs).shape == (3, 2)
+    # pi and vf read the same torso features
+    assert "torso" in params and "pi_out" in params and "vf_out" in params
+
+
+def test_conv_policy_compute_actions():
+    from ray_tpu.rllib.env import RandomPixelEnv
+
+    env = RandomPixelEnv({"size": 36, "frames": 2})
+    pol = Policy(env.observation_space, env.action_space, {"seed": 0})
+    obs, _ = env.reset(seed=0)
+    a, extras = pol.compute_single_action(obs)
+    assert int(a) in range(env.num_actions)
+    assert extras[VF_PREDS].shape == ()
+
+
+_PIXEL_CFG = {"size": 42, "frames": 2, "episode_len": 8}
+_SMALL_CONV = ((16, 8, 4), (32, 4, 2))
+
+
+def test_ppo_conv_policy_learns(ray_start_regular):
+    """PPO with the conv catalog beats random on PixelSquareEnv (random
+    policy: ~0.5 reward/step; seeing the frame is required to do better)."""
+    algo = PPOConfig().environment(
+        "PixelSquareEnv", env_config=dict(_PIXEL_CFG)).rollouts(
+        num_workers=0, num_envs_per_worker=4,
+        rollout_fragment_length=64).training(
+        train_batch_size=256, sgd_minibatch_size=64, num_sgd_iter=4,
+        lr=1e-3, entropy_coeff=0.003, conv_filters=_SMALL_CONV,
+        conv_dense=128).debugging(seed=0).build()
+    last = None
+    for _ in range(10):
+        r = algo.train()
+        if not np.isnan(r["episode_reward_mean"]):
+            last = r["episode_reward_mean"]
+        if last is not None and last >= 6.5:
+            break
+    # 8 steps/episode: random ~4.0, perfect 8.0
+    assert last is not None and last > 5.2, last
+    algo.stop()
+
+
+def test_impala_conv_smoke(ray_start_regular):
+    algo = IMPALAConfig().environment(
+        "RandomPixelEnv", env_config={"size": 36, "frames": 2}).rollouts(
+        num_workers=2, rollout_fragment_length=16,
+        num_envs_per_worker=2).training(
+        num_batches_per_iteration=2, lr=3e-4, conv_filters=_SMALL_CONV,
+        conv_dense=64).debugging(seed=0).build()
+    for _ in range(2):
+        r = algo.train()
+    assert r["info"]["num_env_steps_trained"] >= 2 * 32
+    assert np.isfinite(r["info"]["policy_loss"])
+    algo.stop()
+
+
+def test_dqn_conv_smoke():
+    algo = DQNConfig().environment(
+        "PixelSquareEnv", env_config=dict(_PIXEL_CFG)).rollouts(
+        num_workers=0, rollout_fragment_length=16).training(
+        learning_starts=32, train_batch_size=16, buffer_size=512,
+        num_sgd_per_step=2, conv_filters=_SMALL_CONV,
+        conv_dense=64).debugging(seed=0).build()
+    for _ in range(4):
+        r = algo.train()
+    assert "mean_td_error" in r["info"]
+    algo.stop()
