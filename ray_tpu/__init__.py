@@ -61,7 +61,8 @@ def init(address: Optional[str] = None, *,
          num_cpus: Optional[int] = None, num_tpus: Optional[float] = None,
          resources: Optional[dict] = None, namespace: str = "default",
          log_to_driver: bool = True, _system_config: Optional[dict] = None,
-         ignore_reinit_error: bool = False, **_compat: Any):
+         ignore_reinit_error: bool = False,
+         _session_dir: Optional[str] = None, **_compat: Any):
     """Start (or connect to) a ray_tpu cluster. Reference: ``ray.init``.
 
     With no address, boots a head node in-process: control plane (GCS),
@@ -89,7 +90,14 @@ def init(address: Optional[str] = None, *,
         from ray_tpu._private.gcs import GcsServer
 
         if address is None or address == "local":
-            session = Session()
+            if _session_dir:
+                # head restart over an existing session dir: GcsServer
+                # restores the durable snapshot (GCS fault tolerance) and
+                # surviving workers/actors reattach
+                root, name = os.path.split(os.path.abspath(_session_dir))
+                session = Session(root=root, name=name)
+            else:
+                session = Session()
             _protocol.set_authkey(session.auth_key())
             rtlog.setup("driver", session.log_dir)
             head_res = dict(resources or {})

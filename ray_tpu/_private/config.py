@@ -67,6 +67,18 @@ _FLAG_DEFS = [
     _flag("slab_object_max_bytes", 1024 * 1024,
           "Objects <= this go through the C++ slab store; larger ones get "
           "their own tmpfs segment (zero-copy mmap reads)."),
+    _flag("gcs_snapshot", True,
+          "Persist durable GCS tables (KV, functions, actors, placement "
+          "groups) to <session>/gcs_state so a restarted head recovers "
+          "them (reference: GCS fault tolerance via Redis persistence)."),
+    _flag("gcs_reconnect_timeout_s", 30.0,
+          "How long workers and drivers retry reconnecting to a dead GCS "
+          "socket before giving up (reference: raylets reconnecting to a "
+          "restarted GCS)."),
+    _flag("gcs_restore_grace_s", 8.0,
+          "After a restored-head start, how long restored actors may wait "
+          "for their surviving worker process to reattach before the "
+          "normal restart path (max_restarts) takes over."),
     _flag("transfer_chunk_bytes", 4 * 1024 * 1024,
           "Cross-host object transfers stream in chunks of this size "
           "(reference: ObjectManager chunked transfer) instead of one "

@@ -27,6 +27,7 @@ condition variable (every state change notifies; waiters recheck predicates).
 from __future__ import annotations
 
 import os
+import pickle
 import subprocess
 import sys
 import threading
@@ -203,6 +204,25 @@ class GcsServer:
         self.head_node_id = NodeID.new()
         self.add_node_internal(self.head_node_id, head_resources, is_head=True)
 
+        # GCS fault tolerance (reference: GCS restart w/ Redis persistence,
+        # SURVEY.md §5.3): durable tables snapshot to <session>/gcs_state;
+        # a head started over a session dir that has one restores them and
+        # gives surviving worker processes a grace window to reattach.
+        self._snapshot_path = session.path / "gcs_state" / "snapshot.pkl"
+        self._persist_lock = threading.Lock()
+        self._persist_event = threading.Event()
+        self._restored_at: Optional[float] = None
+        if GLOBAL_CONFIG.gcs_snapshot and self._snapshot_path.exists():
+            try:
+                self._restore_durable()
+                self._restored_at = time.monotonic()
+            except Exception:  # noqa: BLE001 - corrupt snapshot: fresh start
+                logger.exception("failed to restore GCS snapshot; "
+                                 "starting fresh")
+        if GLOBAL_CONFIG.gcs_snapshot:
+            threading.Thread(target=self._persist_loop, name="gcs-persist",
+                             daemon=True).start()
+
         self.rpc_path = session.socket_path("gcs.sock")
         self._listener = protocol.make_listener(self.rpc_path)
         self._threads: List[threading.Thread] = []
@@ -212,6 +232,162 @@ class GcsServer:
         m = threading.Thread(target=self._monitor_loop, name="gcs-monitor", daemon=True)
         m.start()
         self._threads.append(m)
+
+    # ----------------------------------------------------- fault tolerance
+    def _persist_durable(self) -> None:
+        """Mark the durable tables dirty; a dedicated writer thread
+        snapshots them shortly after (debounced).  Mutating handlers call
+        this — cheap enough for any path, including ones holding the cv
+        lock — and the crash window is bounded by the debounce interval."""
+        if not GLOBAL_CONFIG.gcs_snapshot:
+            return
+        self._persist_event.set()
+
+    def _persist_loop(self) -> None:
+        while not self._shutdown:
+            if not self._persist_event.wait(timeout=0.5):
+                continue
+            time.sleep(0.05)  # coalesce bursts of mutations
+            self._persist_event.clear()
+            try:
+                self._write_snapshot()
+            except Exception:  # noqa: BLE001 - keep serving; retry next tick
+                logger.exception("GCS snapshot write failed")
+                self._persist_event.set()
+
+    def _write_snapshot(self) -> None:
+        """Capture + write under one ordering lock so a slow writer can
+        never clobber a newer snapshot with stale state (reference: the
+        GCS tables Redis persists — actors, PGs, KV, function exports)."""
+        with self._persist_lock:
+            with self.lock:
+                state = {
+                    "kv": {ns: dict(t) for ns, t in self.kv.items()},
+                    "functions": dict(self.functions),
+                    "named_actors": dict(self.named_actors),
+                    "actors": {
+                        aid: {"spec": {k: v for k, v in a.spec.items()
+                                       if not k.startswith("_")},
+                              "state": a.state,
+                              "restarts_left": a.restarts_left,
+                              "incarnation": a.incarnation}
+                        for aid, a in self.actors.items()
+                        if a.state != A_DEAD},
+                    "pgs": {pid: {"bundles": p.bundles,
+                                  "strategy": p.strategy, "name": p.name}
+                            for pid, p in self.pgs.items()
+                            if p.state != "removed"},
+                    "shm_objects": {
+                        oid: m.size for oid, m in self.objects.items()
+                        if m.loc == "shm" and m.state == READY},
+                    "driver_ids": set(self.driver_ids),
+                }
+            tmp = self._snapshot_path.with_suffix(".tmp")
+            tmp.parent.mkdir(parents=True, exist_ok=True)
+            tmp.write_bytes(pickle.dumps(state))
+            os.replace(tmp, self._snapshot_path)
+
+    def _restore_durable(self) -> None:
+        """Rebuild durable tables from the snapshot.  Actors come back
+        RESTARTING: their processes may still be alive (workers outlive
+        the head and reconnect — see worker.run_worker_loop); if one
+        doesn't reattach within gcs_restore_grace_s the normal restart
+        path (max_restarts) takes over.
+
+        Everything is parsed into temporaries FIRST, then applied — a
+        malformed/old-format snapshot must fail before mutating any
+        table, or restored actors would sit RESTARTING forever with no
+        grace timer running."""
+        state = pickle.loads(self._snapshot_path.read_bytes())
+        restored_actors = []
+        for aid, rec in state["actors"].items():
+            a = ActorState(rec["spec"])
+            a.state = A_RESTARTING
+            a.restarts_left = rec["restarts_left"]
+            a.incarnation = rec["incarnation"]
+            restored_actors.append((aid, a))
+        restored_pgs = [
+            (pid, PgState(pid, rec["bundles"], rec["strategy"],
+                          rec["name"]))
+            for pid, rec in state["pgs"].items()]
+        kv_tables = {ns: dict(t) for ns, t in state["kv"].items()}
+        functions = dict(state["functions"])
+        named = dict(state["named_actors"])
+        # only segments this snapshot knows about — a host-global scan
+        # would adopt (and later evict/delete) segments belonging to
+        # OTHER live sessions on the same /dev/shm
+        from ray_tpu._private.shm_store import _seg_path
+        shm_objects = []
+        for oid, size in state.get("shm_objects", {}).items():
+            try:
+                if _seg_path(oid).stat().st_size >= 1:
+                    shm_objects.append((oid, size))
+            except OSError:
+                continue
+
+        logger.info("restoring GCS state from %s (%d actors, %d pgs, "
+                    "%d shm objects)", self._snapshot_path,
+                    len(restored_actors), len(restored_pgs),
+                    len(shm_objects))
+        with self.cv:
+            for ns, table in kv_tables.items():
+                self.kv[ns].update(table)
+            self.functions.update(functions)
+            self.named_actors.update(named)
+            for aid, a in restored_actors:
+                self.actors[aid] = a
+            from ray_tpu._private.pg_scheduler import schedule_bundles
+            for pid, pg in restored_pgs:
+                # old node ids are gone; re-place on the current nodes
+                # (more re-placements happen lazily in _h_pg_wait as
+                # nodes rejoin)
+                assignment = schedule_bundles(
+                    [n for n in self.nodes.values() if n.alive],
+                    pg.bundles, pg.strategy)
+                if assignment is not None:
+                    for i, node_id in enumerate(assignment):
+                        self.nodes[node_id].acquire(pg.bundles[i])
+                        pg.assignment[i] = node_id
+                    pg.state = READY
+                self.pgs[pid] = pg
+            for oid, size in shm_objects:
+                self.store.adopt(oid, size)
+                meta = self.objects.get(oid)
+                if meta is None:
+                    meta = self.objects[oid] = ObjMeta()
+                meta.state = READY
+                meta.loc = "shm"
+                meta.size = size
+
+    def _restore_grace_check(self) -> None:
+        """After the reattach grace window, push restored actors whose
+        worker never came back through the normal death/restart path."""
+        if self._restored_at is None:
+            return
+        if time.monotonic() - self._restored_at \
+                < GLOBAL_CONFIG.gcs_restore_grace_s:
+            return
+        self._restored_at = None
+        stranded = []
+        with self.cv:
+            for a in self.actors.values():
+                if a.state == A_RESTARTING and a.worker_id is None and \
+                        not any(w.actor_id == a.actor_id
+                                for w in self.workers.values()):
+                    stranded.append(a.actor_id)
+        for aid in stranded:
+            with self.cv:
+                a = self.actors.get(aid)
+                if a is None or a.state != A_RESTARTING \
+                        or a.worker_id is not None:
+                    continue
+                logger.info("restored actor %s did not reattach; routing "
+                            "through the restart path", aid)
+                # the normal death path enforces max_restarts (budget
+                # decrement, A_DEAD + named-table cleanup when exhausted)
+                self._actor_worker_died(aid)
+        if stranded:
+            self._pump()
 
     # ------------------------------------------------------------------ nodes
     def add_node_internal(self, node_id: str, resources: Dict[str, float],
@@ -280,6 +456,10 @@ class GcsServer:
         for c in contained:
             cm = self._get_or_create_meta(c)
             cm.refcount += 1  # the container holds a ref on nested objects
+        if loc == "shm":
+            # segment survives a head crash; keep the snapshot's shm index
+            # current so a restarted head re-adopts it (just sets an event)
+            self._persist_durable()
         self.cv.notify_all()
 
     def _seal_error(self, oid: str, err_bytes: bytes) -> None:
@@ -765,11 +945,16 @@ class GcsServer:
             a.death_reason = a.death_reason or "worker died"
             if a.name:
                 self.named_actors.pop((a.namespace, a.name), None)
+        # restarts_left / liveness changed: keep the snapshot current so a
+        # head restart doesn't resurrect a dead actor or reset its budget
+        # (just sets the writer thread's event; safe under cv)
+        self._persist_durable()
 
     def _monitor_loop(self) -> None:
         last_pump = 0.0
         while not self._shutdown:
             time.sleep(0.1)
+            self._restore_grace_check()
             # unconditional periodic pump: the _PUMP_MISS_CAP scan cutoff
             # plus queue rotation means a placeable spec deep behind
             # unplaceable ones is only reached across several pumps — and
@@ -826,7 +1011,8 @@ class GcsServer:
                 kind = msg.get("kind")
                 rid = msg.get("rid")
                 if kind == "attach_task_conn":
-                    self._attach_task_conn(msg["worker_id"], conn)
+                    self._attach_task_conn(msg["worker_id"], conn,
+                                           msg.get("reattach"))
                     return  # this thread becomes the push-channel reader
                 if kind == "agent_attach":
                     self._attach_agent_conn(msg["node_id"], conn)
@@ -869,9 +1055,35 @@ class GcsServer:
             except Exception:  # noqa: BLE001
                 logger.exception("agent node removal failed")
 
-    def _attach_task_conn(self, worker_id: str, conn) -> None:
+    def _attach_task_conn(self, worker_id: str, conn,
+                          reattach: Optional[dict] = None) -> None:
         with self.cv:
             w = self.workers.get(worker_id)
+            if w is None and reattach is not None:
+                # surviving worker of a crashed head reconnecting
+                # (GCS fault tolerance): rebuild its WorkerState.  Its
+                # recorded node is gone with the old head — adopt it onto
+                # this head's node.  proc stays None: liveness is this
+                # conn's EOF (same as remote-agent workers).
+                node_id = reattach.get("node_id")
+                if node_id not in self.nodes:
+                    node_id = self.head_node_id
+                w = WorkerState(worker_id, node_id, reattach.get("pid", 0))
+                w.tpu_capable = bool(reattach.get("tpu"))
+                if reattach.get("actor_id"):
+                    # actor worker: its main thread sits in serve_forever —
+                    # it must never enter the idle pool or the scheduler
+                    # would dispatch plain tasks that can't run.  The
+                    # follow-up actor_ready(reattach) event completes the
+                    # actor linkage (addr, resources, ALIVE).
+                    w.state = "actor"
+                    w.actor_id = reattach["actor_id"]
+                self.workers[worker_id] = w
+                node = self.nodes.get(node_id)
+                if node is not None:
+                    node.workers.add(worker_id)
+                logger.info("worker %s reattached after GCS restart",
+                            worker_id[:8])
             if w is None:
                 conn.close()
                 return
@@ -1069,6 +1281,27 @@ class GcsServer:
             w = self.workers.get(worker_id)
             if a is None or w is None:
                 return
+            if msg.get("reattach"):
+                # surviving actor re-announcing to a restarted head: no
+                # creation task to settle, no resources were acquired on
+                # this GCS — re-acquire the actor-lifetime hold so the
+                # node's accounting matches reality, then go ALIVE.
+                if a.state == A_DEAD:
+                    return
+                a.state = A_ALIVE
+                a.worker_id = worker_id
+                a.addr = msg["addr"]
+                w.state = "actor"
+                w.actor_id = a.actor_id
+                if a.spec.get("hold_resources", True):
+                    req = self._task_resources(a.spec)
+                    node = self.nodes.get(w.node_id)
+                    if req and node is not None:
+                        node.acquire(req)
+                        a.spec["_req"] = req
+                        a.spec["_node"] = w.node_id
+                self.cv.notify_all()
+                return
             self.running.pop(a.spec["task_id"], None)
             if msg["status"] == "ok":
                 a.state = A_ALIVE
@@ -1114,6 +1347,10 @@ class GcsServer:
         with self.cv:
             wid = msg["client_id"]
             node_id = msg.get("node_id") or self.head_node_id
+            if node_id not in self.nodes:
+                # stale node id from before a head restart: adopt onto
+                # this head's node (GCS fault tolerance reconnects)
+                node_id = self.head_node_id
             role = msg["role"]
             existing = self.workers.get(wid)
             if existing is not None:  # extra thread-local channel re-registering
@@ -1489,6 +1726,7 @@ class GcsServer:
                 self.named_actors[key] = a.actor_id
             self.actors[a.actor_id] = a
             self.pending_tasks.append(spec)
+        self._persist_durable()
         self._pump()
         return {"actor_id": a.actor_id, "existing": False}
 
@@ -1550,12 +1788,16 @@ class GcsServer:
                 if a.name:
                     self.named_actors.pop((a.namespace, a.name), None)
             self.cv.notify_all()
+        self._persist_durable()
         return {}
 
     # --- functions / kv
     def _h_export_function(self, msg: dict) -> dict:
         with self.lock:
+            new = msg["fn_id"] not in self.functions
             self.functions.setdefault(msg["fn_id"], msg["blob"])
+        if new:
+            self._persist_durable()
         return {}
 
     def _h_fetch_function(self, msg: dict) -> dict:
@@ -1573,6 +1815,7 @@ class GcsServer:
             existed = msg["key"] in ns
             if not (msg.get("overwrite", True) is False and existed):
                 ns[msg["key"]] = msg["value"]
+        self._persist_durable()
         return {"existed": existed}
 
     def _h_kv_get(self, msg: dict) -> dict:
@@ -1582,6 +1825,8 @@ class GcsServer:
     def _h_kv_del(self, msg: dict) -> dict:
         with self.lock:
             existed = self.kv[msg.get("namespace", "default")].pop(msg["key"], None)
+        if existed is not None:
+            self._persist_durable()
         return {"deleted": existed is not None}
 
     def _h_kv_keys(self, msg: dict) -> dict:
@@ -1605,6 +1850,7 @@ class GcsServer:
                 pg.state = READY
             self.pgs[pg.pg_id] = pg
             self.cv.notify_all()
+        self._persist_durable()
         return {"state": pg.state}
 
     def _h_pg_wait(self, msg: dict) -> dict:
@@ -1643,6 +1889,7 @@ class GcsServer:
                     if node is not None:
                         node.release_res(pg.bundles[i])
             self.cv.notify_all()
+        self._persist_durable()
         self._pump()
         return {}
 
@@ -1890,6 +2137,15 @@ class GcsServer:
         self._shutdown = True
         with self.cv:
             procs = [w.proc for w in self.workers.values() if w.proc is not None]
+            # proc-less workers (reattached after a head restart) have no
+            # pid here to signal — tell them to stop so they don't sit in
+            # the GCS-reconnect grace loop after a CLEAN shutdown
+            for w in self.workers.values():
+                if w.proc is None and w.state not in ("driver", "dead"):
+                    try:
+                        w.push({"kind": "stop_worker"})
+                    except Exception:  # noqa: BLE001 - already gone
+                        pass
             self.cv.notify_all()
         for p in procs:
             try:

@@ -192,6 +192,21 @@ class RpcPool:
     def call(self, kind: str, **fields: Any) -> Dict[str, Any]:
         return self.channel().call(kind, **fields)
 
+    def invalidate(self) -> None:
+        """Drop this thread's (presumed-broken) channel so the next
+        ``channel()`` dials a fresh connection — the reconnect primitive
+        for GCS-restart fault tolerance."""
+        ch = getattr(self._tls, "ch", None)
+        if ch is None:
+            return
+        self._tls.ch = None
+        with self._lock:
+            try:
+                self._all.remove(ch)
+            except ValueError:
+                pass
+        ch.close()
+
     def close_all(self) -> None:
         with self._lock:
             chans, self._all = self._all, []

@@ -176,6 +176,7 @@ class Worker:
         self.ctx = _TaskContext()
         self._task_conn = None
         self._task_conn_lock = threading.Lock()
+        self._actor_announce: Optional[dict] = None  # set in _become_actor
         self._current_spec: Optional[dict] = None
         self._exec_thread_id: Optional[int] = None
         self._stop = threading.Event()
@@ -196,7 +197,37 @@ class Worker:
                     pid=os.getpid(), node_id=self.node_id)
 
     def rpc(self, kind: str, **fields: Any) -> dict:
-        return self.pool.call(kind, client_id=self.worker_id, **fields)
+        try:
+            return self.pool.call(kind, client_id=self.worker_id, **fields)
+        except (EOFError, OSError, ConnectionError):
+            # GCS conn lost (head crash/restart).  Reconnect with grace and
+            # re-issue ONCE: GCS fault tolerance is at-least-once for
+            # control-plane ops, the same contract worker-death retries
+            # already impose on tasks (reference: retryable gRPC clients +
+            # raylets reconnecting to a restarted GCS).
+            if self.is_client or self._stop.is_set():
+                raise
+            self._reconnect_pool()
+            return self.pool.call(kind, client_id=self.worker_id, **fields)
+
+    def _reconnect_pool(self) -> None:
+        """Re-dial the GCS socket until it answers or the grace expires.
+        A fresh channel re-registers via the pool's on_new hook."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        deadline = time.monotonic() + GLOBAL_CONFIG.gcs_reconnect_timeout_s
+        logger.warning("lost GCS connection; retrying for up to %.0fs",
+                       GLOBAL_CONFIG.gcs_reconnect_timeout_s)
+        while not self._stop.is_set():
+            self.pool.invalidate()
+            try:
+                self.pool.channel()
+                logger.info("reconnected to GCS")
+                return
+            except (EOFError, OSError, ConnectionError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+        raise ConnectionError("worker stopping during GCS reconnect")
 
     def rpc_oneway(self, kind: str, **fields: Any) -> None:
         self.pool.channel().send_oneway(kind, client_id=self.worker_id, **fields)
@@ -711,6 +742,42 @@ class Worker:
         self.pool.close_all()
 
     # ====================================================== executor (worker)
+    def _reattach_task_conn(self):
+        """After a GCS crash: re-dial, re-register, re-attach the push
+        channel, and re-announce a live actor.  Returns the new conn or
+        None when the grace window expires (then the worker exits)."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        deadline = time.monotonic() + GLOBAL_CONFIG.gcs_reconnect_timeout_s
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            try:
+                # order matters: register first (rebuilds WorkerState),
+                # then attach the push conn, then re-announce the actor
+                self._reconnect_pool()
+                c = self.open_conn(self.gcs_path)
+                c.send({"kind": "attach_task_conn",
+                        "worker_id": self.worker_id,
+                        "reattach": {
+                            "pid": os.getpid(),
+                            "node_id": self.node_id,
+                            "tpu": os.environ.get("RTPU_TPU_WORKER") == "1",
+                            # declared up front so the GCS never marks an
+                            # actor worker "idle" (its main thread sits in
+                            # serve_forever and can't run plain tasks)
+                            "actor_id": (self._actor_announce or
+                                         {}).get("actor_id"),
+                        }})
+                with self._task_conn_lock:
+                    self._task_conn = c
+                if self._actor_announce is not None:
+                    self._send_event({"kind": "actor_ready",
+                                      "reattach": True,
+                                      **self._actor_announce})
+                logger.info("reattached task conn after GCS restart")
+                return c
+            except (EOFError, OSError, ConnectionError):
+                time.sleep(0.5)
+        return None
+
     def run_worker_loop(self) -> None:
         """Main loop of a spawned worker process."""
         conn = self.open_conn(self.gcs_path)
@@ -721,13 +788,20 @@ class Worker:
         tasks: "_q.Queue" = _q.Queue()
 
         def reader():
+            nonlocal conn
             while not self._stop.is_set():
                 try:
                     msg = conn.recv()
                 except (EOFError, OSError):
-                    self._stop.set()
-                    tasks.put(None)
-                    return
+                    # head gone: outlive it and reattach (GCS fault
+                    # tolerance) — actors keep serving direct calls the
+                    # whole time; only the control-plane link heals.
+                    conn = self._reattach_task_conn()
+                    if conn is None:
+                        self._stop.set()
+                        tasks.put(None)
+                        return
+                    continue
                 kind = msg.get("kind")
                 if kind == "cancel":
                     self._cancel_current(msg["task_id"])
@@ -897,6 +971,10 @@ class Worker:
             return
         self._current_spec = None
         server = ActorServer(self, spec, instance)
+        # kept for GCS-restart reattach: the actor re-announces itself to
+        # a fresh head with the same id + addr (state intact)
+        self._actor_announce = {"actor_id": spec["actor_id"],
+                                "status": "ok", "addr": server.addr}
         self._send_event({"kind": "actor_ready", "actor_id": spec["actor_id"],
                           "status": "ok", "addr": server.addr})
         server.serve_forever()  # returns on exit_actor / stop

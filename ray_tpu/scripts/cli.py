@@ -42,8 +42,10 @@ def _start_aux_servers(args) -> None:
 
 def cmd_start(args) -> int:
     import ray_tpu
+    session_dir = getattr(args, "session_dir", None)
     if args.block:
-        ray_tpu.init(num_cpus=args.num_cpus or None)
+        ray_tpu.init(num_cpus=args.num_cpus or None,
+                     _session_dir=session_dir)
         _start_aux_servers(args)
         desc = ray_tpu._worker_mod.global_worker().session.path  # noqa: SLF001
         print(f"head started (session {desc}); Ctrl-C to stop")
@@ -61,7 +63,8 @@ def cmd_start(args) -> int:
         devnull = os.open(os.devnull, os.O_RDWR)
         for fd in (0, 1, 2):
             os.dup2(devnull, fd)
-        ray_tpu.init(num_cpus=args.num_cpus or None)
+        ray_tpu.init(num_cpus=args.num_cpus or None,
+                     _session_dir=session_dir)
         _start_aux_servers(args)
         w = ray_tpu._worker_mod.global_worker()  # noqa: SLF001
         desc = w.session.read_descriptor()
@@ -212,6 +215,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bind address for the client server (default "
                          "loopback; 0.0.0.0 requires sharing the session "
                          "auth key with clients via RTPU_AUTH_KEY)")
+    sp.add_argument("--session-dir", default=None,
+                    help="start over an EXISTING session dir, restoring "
+                         "the GCS snapshot (head restart / fault "
+                         "tolerance); surviving workers reattach")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the latest head node")
