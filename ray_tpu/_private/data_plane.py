@@ -1,0 +1,203 @@
+"""Peer-to-peer object plane for multi-host clusters.
+
+Reference: the ObjectManager's node↔node chunked transfer (PullManager /
+PushManager, SURVEY.md §2.1) — data moves directly between the holder
+host and the puller host; the head is only a *fallback relay* for hosts
+that cannot reach each other (hub-spoke NAT topologies).
+
+Mechanics here: each NodeAgent host keeps a **spool directory** of large
+objects produced on that host (one file per object, written by the
+producing worker — same host, plain file I/O) and runs a
+``DataPlaneServer`` — a TCP listener (per-session HMAC auth, the same
+handshake as every other socket) serving chunked reads of those files.
+The GCS records ``loc="remote"`` + the holder node; consumers dial the
+holder's advertised data address and stream chunks, falling back to the
+head relay when the dial fails.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from pathlib import Path
+from typing import Optional, Tuple
+
+from ray_tpu._private import protocol, rtlog
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+logger = rtlog.get("data-plane")
+
+
+def spool_path(spool_dir: str, object_id: str) -> Path:
+    return Path(spool_dir) / f"obj_{object_id}"
+
+
+def spool_capacity_bytes() -> int:
+    mb = int(os.environ.get("RTPU_SPOOL_CAPACITY_MB", 0) or 0)
+    if mb <= 0:
+        mb = GLOBAL_CONFIG.object_store_memory_mb
+    return mb * 1024 * 1024
+
+
+def write_spool(spool_dir: str, object_id: str, wire) -> int:
+    """Atomic write of an object's wire bytes into the host spool.
+
+    Admission-checked against the spool capacity (default: the object
+    store capacity — the replaced head-upload path enforced the head
+    store's bound; an unbounded spool on a tmpfs-backed /tmp would OOM
+    the host with no backpressure).  The scan is O(spooled files);
+    spooled objects are large, so counts stay small."""
+    size = len(wire)
+    cap = spool_capacity_bytes()
+    used = 0
+    try:
+        with os.scandir(spool_dir) as it:
+            for e in it:
+                try:
+                    used += e.stat().st_size
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    if used + size > cap:
+        from ray_tpu.exceptions import ObjectStoreFullError
+        raise ObjectStoreFullError(
+            f"host spool full: {used + size} > {cap} bytes "
+            f"(RTPU_SPOOL_CAPACITY_MB to raise)")
+    path = spool_path(spool_dir, object_id)
+    tmp = path.with_suffix(".tmp")
+    with open(tmp, "wb") as f:
+        f.write(wire)
+    os.replace(tmp, path)
+    return size
+
+
+class DataPlaneServer:
+    """Serves chunked reads of one host's object spool.
+
+    Ops (framed-pickle messages, same wire as the control plane):
+      fetch_object: {object_id} → {size} | {error}
+      fetch_chunk:  {object_id, offset, length} → {data}
+      delete_object:{object_id} → {}           (refcount hit zero)
+      stats:        {} → {bytes_served, objects_served}
+    """
+
+    def __init__(self, spool_dir: str, host: str = "0.0.0.0",
+                 advertise_host: Optional[str] = None):
+        self.spool_dir = spool_dir
+        Path(spool_dir).mkdir(parents=True, exist_ok=True)
+        self._listener = protocol.make_tcp_listener(host, 0)
+        self.port = self._listener.address[1]
+        self.advertise_addr = f"tcp://{advertise_host or host}:{self.port}"
+        self.bytes_served = 0
+        self.objects_served = 0
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, name="data-plane",
+                         daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                op = msg.get("op")
+                oid = msg.get("object_id", "")
+                path = spool_path(self.spool_dir, oid)
+                try:
+                    if op == "fetch_object":
+                        self.objects_served += 1
+                        conn.send({"size": path.stat().st_size})
+                    elif op == "fetch_chunk":
+                        with open(path, "rb") as f:
+                            data = os.pread(f.fileno(), msg["length"],
+                                            msg["offset"])
+                        self.bytes_served += len(data)
+                        conn.send({"data": data})
+                    elif op == "delete_object":
+                        try:
+                            os.unlink(path)
+                        except FileNotFoundError:
+                            pass
+                        conn.send({})
+                    elif op == "stats":
+                        conn.send({"bytes_served": self.bytes_served,
+                                   "objects_served": self.objects_served})
+                    else:
+                        conn.send({"error": f"unknown op {op!r}"})
+                except FileNotFoundError:
+                    conn.send({"error": "not found"})
+                except OSError as e:
+                    conn.send({"error": str(e)})
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def pull_from_peer(open_conn, addr: str, object_id: str) -> bytearray:
+    """Stream one object from a holder host's data plane.
+
+    ``open_conn(addr)`` supplies the connection — Worker.open_conn, which
+    dials tcp addresses directly with a bounded handshake and falls back
+    to the head's proxy relay for unreachable peers (hub-spoke), giving
+    exactly the reference PullManager's direct-else-relay behavior."""
+    conn = open_conn(addr)
+    try:
+        conn.send({"op": "fetch_object", "object_id": object_id})
+        head = conn.recv()
+        if "error" in head:
+            raise FileNotFoundError(object_id)
+        size = head["size"]
+        chunk = GLOBAL_CONFIG.transfer_chunk_bytes
+        buf = bytearray(size)
+        off = 0
+        while off < size:
+            conn.send({"op": "fetch_chunk", "object_id": object_id,
+                       "offset": off, "length": min(chunk, size - off)})
+            r = conn.recv()
+            piece = r.get("data")
+            if not piece:
+                raise FileNotFoundError(object_id)
+            buf[off:off + len(piece)] = piece
+            off += len(piece)
+        return buf
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def delete_on_peer(addr: str, object_id: str) -> None:
+    """Best-effort spool delete on the holder (refcount reached zero)."""
+    tcp = protocol.parse_tcp_addr(addr)
+    if tcp is None:
+        return
+    try:
+        conn = protocol.connect_tcp(*tcp, timeout=3.0)
+        try:
+            conn.send({"op": "delete_object", "object_id": object_id})
+            conn.recv()
+        finally:
+            conn.close()
+    except (OSError, EOFError, ConnectionError):
+        pass

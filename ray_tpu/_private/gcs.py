@@ -59,6 +59,7 @@ class NodeState:
         self.resources_avail = dict(resources)
         self.labels = labels or {}
         self.alive = True
+        self.data_addr: Optional[str] = None  # P2P object-plane listener
         self.is_remote = False   # owned by a NodeAgent on another host:
         # the GCS cannot fork workers there (the agent owns the pool);
         # actors there listen on TCP and advertise tcp:// addresses
@@ -196,6 +197,7 @@ class GcsServer:
         self.events: List[dict] = []                      # timeline events
         self.dead_clients: Set[str] = set()
         self._staging: Dict[str, dict] = {}   # in-flight chunked uploads
+        self._remote_pulls: Dict[str, threading.Event] = {}  # relay dedup
         self.driver_ids: Set[str] = set()
         self.log_sink = None                              # callable(line)
         self._shutdown = False
@@ -393,12 +395,14 @@ class GcsServer:
     def add_node_internal(self, node_id: str, resources: Dict[str, float],
                           is_head: bool = False,
                           labels: Optional[Dict[str, str]] = None,
-                          remote: bool = False) -> str:
+                          remote: bool = False,
+                          data_addr: Optional[str] = None) -> str:
         with self.cv:
             res = dict(resources)
             res.setdefault("CPU", float(os.cpu_count() or 4) if is_head else 1.0)
             node = NodeState(node_id, res, labels)
             node.is_remote = remote
+            node.data_addr = data_addr
             # node-id resource enables NodeAffinity via plain resource matching
             node.resources_total[f"node:{node_id}"] = 1.0
             node.resources_avail[f"node:{node_id}"] = 1.0
@@ -503,6 +507,13 @@ class GcsServer:
                 self.store.delete_object(oid)
             elif meta.loc == "slab" and self.slab is not None:
                 self.slab.delete(oid)
+            elif meta.loc == "remote":
+                node = self.nodes.get(meta.node_id)
+                if node is not None and node.data_addr:
+                    from ray_tpu._private.data_plane import delete_on_peer
+                    threading.Thread(
+                        target=delete_on_peer,
+                        args=(node.data_addr, oid), daemon=True).start()
             del self.objects[oid]
 
     # ------------------------------------------------------------- scheduling
@@ -1125,6 +1136,7 @@ class GcsServer:
         elif kind == "actor_result":
             # actor method results sealed by the actor's worker
             with self.cv:
+                w = self.workers.get(worker_id)
                 for oid, res in zip(msg["return_ids"], msg["results"]):
                     meta = self._get_or_create_meta(oid)
                     if res["loc"] == "error":
@@ -1132,9 +1144,22 @@ class GcsServer:
                     else:
                         if res["loc"] == "shm":
                             self.store.adopt(oid, res.get("size", 0))
-                        self._seal_object(oid, res["loc"], res.get("data"),
-                                          res.get("size", 0), None,
-                                          res.get("contained", []))
+                        # remote-spooled results are pinned to the holder
+                        # node (P2P pulls resolve its data addr; node loss
+                        # routes them to reconstruction)
+                        self._seal_object(
+                            oid, res["loc"], res.get("data"),
+                            res.get("size", 0),
+                            (w.node_id if w is not None
+                             and res["loc"] == "remote" else None),
+                            res.get("contained", []))
+                        if res["loc"] == "remote" and w is None:
+                            # holder unknown (worker record already
+                            # reaped): a READY remote object with no
+                            # node resolves nowhere and node-loss scans
+                            # never reclaim it — mark lost NOW
+                            self._mark_object_lost(
+                                oid, self.objects[oid])
             self._pump()  # tasks may be waiting on these objects as deps
         elif kind == "task_blocked":
             # reference: raylet releases the CPU while a task blocks in get().
@@ -1527,8 +1552,13 @@ class GcsServer:
             for oid in oids:
                 meta = self.objects[oid]
                 self.store.touch(oid)
-                out[oid] = {"state": meta.state, "loc": meta.loc,
-                            "data": meta.data, "size": meta.size}
+                entry = {"state": meta.state, "loc": meta.loc,
+                         "data": meta.data, "size": meta.size}
+                if meta.loc == "remote":
+                    node = self.nodes.get(meta.node_id)
+                    entry["node_id"] = meta.node_id
+                    entry["addr"] = node.data_addr if node else None
+                out[oid] = entry
             return {"metas": out}
 
     def _h_wait(self, msg: dict) -> dict:
@@ -1904,7 +1934,8 @@ class GcsServer:
     def _h_add_node(self, msg: dict) -> dict:
         nid = self.add_node_internal(NodeID.new(), msg["resources"],
                                      labels=msg.get("labels"),
-                                     remote=bool(msg.get("remote")))
+                                     remote=bool(msg.get("remote")),
+                                     data_addr=msg.get("data_addr"))
         self._pump()
         return {"node_id": nid}
 
@@ -2006,6 +2037,17 @@ class GcsServer:
             if meta is None or meta.state != READY:
                 return None
             loc, data = meta.loc, meta.data
+        if loc == "remote":
+            # head acting as the RELAY FALLBACK for a puller that cannot
+            # reach the holder host (hub-spoke): pull the spool copy into
+            # the local store once, then serve it like any shm object
+            if not self._pull_remote_local(oid):
+                return None
+            with self.lock:
+                meta = self.objects.get(oid)
+                if meta is None or meta.state != READY:
+                    return None
+                loc, data = meta.loc, meta.data
         if loc == "inline":
             return ("inline", data)
         if loc == "slab":
@@ -2014,6 +2056,61 @@ class GcsServer:
         self.store.restore(oid)
         from ray_tpu._private.shm_store import _seg_path
         return ("shm", _seg_path(oid))
+
+    def _pull_remote_local(self, oid: str) -> bool:
+        """Pull a remote-spooled object into the head's shm store
+        (concurrent pulls of the same oid coalesce — reference:
+        PullManager dedup)."""
+        with self.lock:
+            meta = self.objects.get(oid)
+            if meta is None or meta.loc != "remote":
+                return meta is not None and meta.state == READY
+            node = self.nodes.get(meta.node_id)
+            addr = node.data_addr if node else None
+            ev = self._remote_pulls.get(oid)
+            leader = ev is None
+            if leader:
+                ev = self._remote_pulls[oid] = threading.Event()
+        if not leader:
+            ev.wait(timeout=120)
+            with self.lock:
+                m = self.objects.get(oid)
+                return m is not None and m.state == READY \
+                    and m.loc != "remote"
+        try:
+            if addr is None:
+                return False
+            from ray_tpu._private import data_plane
+            from ray_tpu._private.shm_store import _seg_path
+            tcp = protocol.parse_tcp_addr(addr)
+            if tcp is None:
+                return False
+            wire = data_plane.pull_from_peer(
+                lambda a: protocol.connect_tcp(*tcp, timeout=5.0),
+                addr, oid)
+            seg = _seg_path(oid)
+            tmp = seg.with_name(seg.name + ".pull")
+            tmp.write_bytes(wire)
+            os.replace(tmp, seg)
+            with self.cv:
+                self.store.adopt(oid, len(wire))
+                meta = self.objects.get(oid)
+                if meta is not None:
+                    meta.loc = "shm"
+                    meta.size = len(wire)
+                    meta.node_id = self.head_node_id
+            # the head owns the object now — drop the holder's spool copy
+            # or relay-fallback traffic accumulates dead files on A
+            from ray_tpu._private.data_plane import delete_on_peer
+            threading.Thread(target=delete_on_peer, args=(addr, oid),
+                             daemon=True).start()
+            return True
+        except (OSError, EOFError, FileNotFoundError, ConnectionError):
+            return False
+        finally:
+            with self.lock:
+                self._remote_pulls.pop(oid, None)
+            ev.set()
 
     def _h_fetch_object(self, msg: dict) -> dict:
         """Object bytes through the control plane — the cross-host data

@@ -47,8 +47,17 @@ class NodeAgent:
         all_labels = {"agent": "1", **(labels or {})}
         self._conn = protocol.tunnel_connect(*self.head, "gcs")
         self._chan = protocol.RpcChannel(self._conn)
+        # P2P object plane (reference: ObjectManager node↔node transfer):
+        # large objects produced on this host spool locally and are served
+        # directly to sibling hosts; the head is only the fallback relay.
+        import tempfile
+        from ray_tpu._private.data_plane import DataPlaneServer
+        self._spool_dir = tempfile.mkdtemp(prefix="rtpu_spool_")
+        self._data_plane = DataPlaneServer(
+            self._spool_dir, advertise_host=self._advertise_host())
         resp = self._chan.call("add_node", resources=res,
-                               labels=all_labels, remote=True)
+                               labels=all_labels, remote=True,
+                               data_addr=self._data_plane.advertise_addr)
         self.node_id = resp["node_id"]
         # dedicate this connection to liveness: the head removes the node
         # when it drops (kill -9 / host crash / partition)
@@ -90,6 +99,8 @@ class NodeAgent:
         env["RTPU_PROXY_ADDR"] = f"{self.head[0]}:{self.head[1]}"
         env["RTPU_NODE_ID"] = self.node_id
         env["RTPU_ADVERTISE_HOST"] = self._advertise_host()
+        env["RTPU_SPOOL_DIR"] = self._spool_dir
+        env["RTPU_DATA_ADDR"] = self._data_plane.advertise_addr
         if tpu:
             # device-holding worker: jax initializes the real platform
             env["RTPU_TPU_WORKER"] = "1"
@@ -155,6 +166,9 @@ class NodeAgent:
             self._conn.close()
         except OSError:
             pass
+        self._data_plane.stop()
+        import shutil
+        shutil.rmtree(self._spool_dir, ignore_errors=True)
 
 
 def _detect_tpu_env() -> Dict[str, str]:

@@ -311,10 +311,8 @@ class Worker:
             return wire_cache[0]
 
         if self.is_client and not tiny:
-            # client data plane = control plane (proxied): stream large
-            # puts to the head's store in chunks, then register them
-            self._upload_wire(str(oid), wire())
-            self.rpc("put_object", object_id=str(oid), loc="shm",
+            loc = self._spool_or_upload(str(oid), wire())
+            self.rpc("put_object", object_id=str(oid), loc=loc,
                      size=size, contained=contained, node_id=self.node_id)
         elif slab is not None and size <= GLOBAL_CONFIG.slab_object_max_bytes \
                 and slab.put(str(oid), wire()):
@@ -338,6 +336,9 @@ class Worker:
             raise err
         if meta["loc"] == "inline":
             return deserialize_from(memoryview(meta["data"]))
+        if meta["loc"] == "remote":
+            # spooled on a sibling host's data plane (P2P object plane)
+            return deserialize_from(self._fetch_peer_object(oid, meta))
         if self.is_client and meta["loc"] in ("slab", "shm", "spilled"):
             return deserialize_from(self._fetch_remote_wire(oid))
         if meta["loc"] == "slab":
@@ -350,6 +351,31 @@ class Worker:
             return deserialize_from(memoryview(data))
         mapped = ShmObjectStore.map_readonly(oid)
         return deserialize_from(mapped.buf)
+
+    def _fetch_peer_object(self, oid: str, meta: dict) -> memoryview:
+        """Read a remote-spooled object: same-host spool file directly,
+        else dial the holder's data plane (direct, or through the head
+        proxy for unreachable peers — open_conn's ladder), else fall back
+        to the head relay, which pulls the object through itself
+        (reference: PullManager direct-pull with relay fallback)."""
+        spool = os.environ.get("RTPU_SPOOL_DIR")
+        if spool and meta.get("node_id") == self.node_id:
+            from ray_tpu._private.data_plane import spool_path
+            try:
+                return memoryview(spool_path(spool, oid).read_bytes())
+            except OSError:
+                pass  # spool lost locally: try the network paths
+        addr = meta.get("addr")
+        if addr:
+            from ray_tpu._private.data_plane import pull_from_peer
+            with self._pull_sem:
+                try:
+                    return memoryview(pull_from_peer(
+                        lambda a: self.open_conn(a), addr, oid))
+                except (OSError, EOFError, ConnectionError,
+                        FileNotFoundError):
+                    pass  # unreachable holder: head relay below
+        return self._fetch_remote_wire(oid)
 
     def _fetch_remote_wire(self, oid: str) -> memoryview:
         """Pull one object's wire bytes over the control plane (the
@@ -885,10 +911,28 @@ class Worker:
                 else:
                     shm_write_value(oid, pickled, buffers, overwrite=True)
             elif res["loc"] == "upload":
-                self._upload_wire(oid, res.pop("wire"))
-                res["loc"] = "shm"  # now lives in the head's tmpfs plane
+                res["loc"] = self._spool_or_upload(oid, res.pop("wire"))
             out.append(res)
         return out
+
+    def _spool_or_upload(self, oid: str, wire: bytes) -> str:
+        """Large bytes leaving a proxied worker: spool on THIS host's P2P
+        data plane when an agent provides one (consumers pull from the
+        holder directly; head relays only as fallback) — else stream to
+        the head's store in chunks.  Returns the sealed loc.
+
+        NOTE: remote-spooled objects currently do not survive a HEAD
+        restart — agents exit on head loss (liveness watch), taking their
+        spools with them; the GCS snapshot therefore indexes only
+        head-local shm objects.  Agent reconnect (and with it spool
+        survival) is the follow-on."""
+        spool = os.environ.get("RTPU_SPOOL_DIR")
+        if spool:
+            from ray_tpu._private.data_plane import write_spool
+            write_spool(spool, oid, wire)
+            return "remote"
+        self._upload_wire(oid, wire)
+        return "shm"  # now lives in the head's tmpfs plane
 
     def _upload_wire(self, oid: str, wire: bytes) -> None:
         """Stream large wire bytes to the head's store in chunks (the

@@ -18,9 +18,11 @@ from ray_tpu.util import state
 from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
 
 
-def _start_agent(num_cpus: int):
+def _start_agent(num_cpus: int, exclude=()):
     """Start a proxy + node agent against the current head; returns
-    (proxy, agent_proc, node_id)."""
+    (proxy, agent_proc, node_id).  ``exclude`` holds node ids of agents
+    already running so multi-agent tests don't mistake an earlier agent's
+    node for the new one."""
     from ray_tpu._private import worker as worker_mod
     from ray_tpu.util.client import ClientProxyServer
 
@@ -38,7 +40,8 @@ def _start_agent(num_cpus: int):
     node_id = None
     while time.time() < deadline and node_id is None:
         for n in state.list_nodes():
-            if n["labels"].get("agent") == "1" and n["alive"]:
+            if n["labels"].get("agent") == "1" and n["alive"] \
+                    and n["node_id"] not in exclude:
                 node_id = n["node_id"]
         time.sleep(0.2)
     assert node_id, "agent node never registered"
@@ -267,3 +270,108 @@ def test_parse_labels_rejects_malformed():
         na.parse_labels("ici_domain")  # missing =v must fail fast
     with pytest.raises(ValueError):
         na.parse_labels("=v")
+
+
+def test_p2p_object_transfer_bypasses_head(ray_start_2_cpus, monkeypatch):
+    """A large object produced on agent host A is consumed on agent host B
+    by pulling directly from A's data-plane listener — the head never
+    stores or relays the bytes (reference: ObjectManager node-to-node
+    chunked transfer; head relay is only the unreachable-peer fallback)."""
+    from ray_tpu._private import protocol
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    monkeypatch.setattr(GLOBAL_CONFIG, "transfer_chunk_bytes", 64 * 1024)
+    monkeypatch.setenv("RTPU_TRANSFER_CHUNK_BYTES", str(64 * 1024))
+    proxy_a, agent_a, node_a = _start_agent(num_cpus=1)
+    proxy_b, agent_b, node_b = _start_agent(num_cpus=1, exclude={node_a})
+    try:
+        pin_a = NodeAffinitySchedulingStrategy(node_a)
+        pin_b = NodeAffinitySchedulingStrategy(node_b)
+
+        @ray_tpu.remote(scheduling_strategy=pin_a)
+        def produce():
+            return np.arange(300_000, dtype=np.float64)  # 2.4MB
+
+        ref = produce.remote()
+        # object seals as remote-spooled on A, not uploaded to the head
+        head = ray_tpu._head
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            meta = head.objects.get(str(ref.id))
+            if meta is not None and meta.state == "ready":
+                break
+            time.sleep(0.2)
+        meta = head.objects[str(ref.id)]
+        assert meta.loc == "remote", meta.loc
+        assert meta.node_id == node_a
+
+        @ray_tpu.remote(scheduling_strategy=pin_b)
+        def consume(a):
+            return float(a.sum())
+
+        expect = float(np.arange(300_000, dtype=np.float64).sum())
+        assert ray_tpu.get(consume.remote(ref), timeout=90) == expect
+
+        # bytes moved A→B directly: A's data plane served them...
+        data_addr = head.nodes[node_a].data_addr
+        host, port = protocol.parse_tcp_addr(data_addr)
+        conn = protocol.connect_tcp(host, port, timeout=5)
+        conn.send({"op": "stats"})
+        stats = conn.recv()
+        conn.close()
+        assert stats["bytes_served"] >= 2_400_000, stats
+
+        # ...and the head never staged or relayed them
+        assert str(ref.id) not in head._staging
+        assert meta.loc == "remote", "head pulled the object through itself"
+        from ray_tpu._private.shm_store import ShmObjectStore
+        assert not ShmObjectStore.exists_in_shm(str(ref.id))
+
+        # the driver (head host) reads it straight from A's data plane too
+        np.testing.assert_array_equal(
+            ray_tpu.get(ref, timeout=60),
+            np.arange(300_000, dtype=np.float64))
+        assert meta.loc == "remote"
+    finally:
+        for agent, proxy in ((agent_a, proxy_a), (agent_b, proxy_b)):
+            agent.terminate()
+            agent.wait(timeout=30)
+            proxy.stop()
+
+
+def test_p2p_head_relay_fallback(ray_start_2_cpus, monkeypatch):
+    """When a puller cannot reach the holder, the head pulls the spooled
+    object through itself once and serves it from its own store
+    (reference: PullManager relay fallback)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    monkeypatch.setattr(GLOBAL_CONFIG, "transfer_chunk_bytes", 64 * 1024)
+    monkeypatch.setenv("RTPU_TRANSFER_CHUNK_BYTES", str(64 * 1024))
+    proxy, agent, node_id = _start_agent(num_cpus=1)
+    try:
+        pin = NodeAffinitySchedulingStrategy(node_id)
+
+        @ray_tpu.remote(scheduling_strategy=pin)
+        def produce():
+            return np.arange(200_000, dtype=np.float64)  # 1.6MB
+
+        ref = produce.remote()
+        head = ray_tpu._head
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            meta = head.objects.get(str(ref.id))
+            if meta is not None and meta.state == "ready":
+                break
+            time.sleep(0.2)
+        assert head.objects[str(ref.id)].loc == "remote"
+
+        # the head-relay path: resolve locally → pull-through from the
+        # holder's data plane → object becomes head-local shm
+        got = head._resolve_object_bytes(str(ref.id))
+        assert got is not None and got[0] == "shm"
+        assert head.objects[str(ref.id)].loc == "shm"
+        np.testing.assert_array_equal(
+            ray_tpu.get(ref, timeout=60),
+            np.arange(200_000, dtype=np.float64))
+    finally:
+        agent.terminate()
+        agent.wait(timeout=30)
+        proxy.stop()
