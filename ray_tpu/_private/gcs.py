@@ -1618,6 +1618,19 @@ class GcsServer:
                 refs[oid] = refs.get(oid, 0) + 1
         return {}
 
+    def _h_release_batch(self, msg: dict) -> dict:
+        """Batched ObjectRef drops (one lock acquisition + one message for
+        up to 64 decrefs — the submit hot loop's GC traffic)."""
+        with self.cv:
+            refs = self.client_refs.get(msg["client_id"], {})
+            for oid in msg["object_ids"]:
+                if refs.get(oid, 0) > 0:
+                    refs[oid] -= 1
+                    if refs[oid] == 0:
+                        del refs[oid]
+                    self._decref(oid)
+        return {}
+
     def _h_release_all(self, msg: dict) -> dict:
         """Release every ref under a transient ledger (in-flight actor args)."""
         with self.cv:
@@ -1636,15 +1649,9 @@ class GcsServer:
         return {}
 
     def _h_release(self, msg: dict) -> dict:
-        with self.cv:
-            refs = self.client_refs.get(msg["client_id"], {})
-            oid = msg["object_id"]
-            if refs.get(oid, 0) > 0:
-                refs[oid] -= 1
-                if refs[oid] == 0:
-                    del refs[oid]
-                self._decref(oid)
-        return {}
+        return self._h_release_batch(
+            {"client_id": msg["client_id"],
+             "object_ids": (msg["object_id"],)})
 
     def _h_free_objects(self, msg: dict) -> dict:
         with self.cv:

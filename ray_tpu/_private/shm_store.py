@@ -66,6 +66,31 @@ class MappedObject:
             pass
 
 
+def _move_file(src: str, dst: str) -> None:
+    """rename, or copy+unlink across filesystems (spill dirs usually live
+    on disk while segments live on tmpfs — os.replace alone raises EXDEV)."""
+    try:
+        os.replace(src, dst)
+    except OSError as e:
+        import errno
+        import shutil
+        if e.errno != errno.EXDEV:
+            raise
+        tmp = dst + ".mv"
+        try:
+            shutil.copyfile(src, tmp)
+            os.replace(tmp, dst)
+        except BaseException:
+            # a half-written temp (e.g. ENOSPC mid-spill) would eat the
+            # very disk space spilling needs — clean it before re-raising
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        os.unlink(src)
+
+
 class ShmObjectStore:
     """Node-local store daemon side: create/seal/evict/delete + accounting."""
 
@@ -155,7 +180,7 @@ class ShmObjectStore:
         for oid, size in victims:
             src = _seg_path(oid)
             self.spill_dir.mkdir(parents=True, exist_ok=True)
-            os.replace(str(src), str(self._spill_path(oid)))
+            _move_file(str(src), str(self._spill_path(oid)))
             del self._sealed[oid]
             self._spilled[oid] = size
             self._used -= size
@@ -170,7 +195,7 @@ class ShmObjectStore:
             size = self._spilled[object_id]
             if self._used + size > self.capacity:
                 self._evict_locked(self._used + size - self.capacity)
-            os.replace(str(self._spill_path(object_id)), str(_seg_path(object_id)))
+            _move_file(str(self._spill_path(object_id)), str(_seg_path(object_id)))
             del self._spilled[object_id]
             self._sealed[object_id] = size
             self._used += size

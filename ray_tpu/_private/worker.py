@@ -166,6 +166,12 @@ class Worker:
         self._actor_chan_lock = threading.Lock()
         self._pulls: Dict[str, dict] = {}       # in-flight chunked pulls
         self._pull_lock = threading.Lock()
+        # batched ObjectRef drops.  RLock: release() runs from __del__, and
+        # an allocation inside the locked region can trigger a cyclic-GC
+        # collection that finalizes ANOTHER ObjectRef on this same thread —
+        # re-entering release() mid-hold (a plain Lock would self-deadlock).
+        self._release_buf: List[str] = []
+        self._release_lock = threading.RLock()
         # return-oid → (actor_id, call_id) for in-flight actor calls: a
         # result observed through ANY path (inline reply, GCS get) marks
         # the call complete, so a racing disconnect can't resubmit an
@@ -296,6 +302,10 @@ class Worker:
         from ray_tpu._private.serialization import (serialize,
                                                     serialized_size,
                                                     to_wire_bytes)
+        # deferred decrefs must land before allocating: a put loop that
+        # drops its previous refs would otherwise fill the store with
+        # garbage and force spills instead of deletes
+        self._flush_releases()
         oid = ObjectID.make(self.worker_id, _owner_kind, self._put_seq())
         pickled, buffers, refs = serialize(value)
         size = serialized_size(pickled, buffers)
@@ -478,6 +488,9 @@ class Worker:
         blocked = self.ctx.in_task
         if blocked:
             self._send_event({"kind": "task_blocked"})
+        # deferred decrefs must land before a potentially-long block,
+        # or they pin store memory for the whole wait
+        self._flush_releases()
         try:
             resp = self.rpc("get_meta", object_ids=oids, timeout=remaining)
         finally:
@@ -493,6 +506,9 @@ class Worker:
         if num_returns > len(refs):
             raise ValueError(
                 f"num_returns ({num_returns}) > number of refs ({len(refs)})")
+        # flush-before-block invariant: buffered decrefs must not pin dead
+        # objects for the duration of a possibly-indefinite wait
+        self._flush_releases()
         by_id = {str(r.id): r for r in refs}
         with self._local_lock:
             local_ready = [oid for oid in by_id if oid in self._local_values]
@@ -507,8 +523,35 @@ class Worker:
         return ready, not_ready
 
     def release(self, oid: str) -> None:
-        if not self._stop.is_set():
-            self.rpc_oneway("release", object_id=oid)
+        """Drop one client reference (ObjectRef.__del__).
+
+        Batched: dropping N refs costs N/64 control-plane messages, not N
+        (measured 0.3ms/message on the submit hot loop).  Safe to reorder
+        across threads: a buffered release is always for a DEAD ObjectRef
+        instance, so any oid still usable by a future submit has another
+        live instance keeping the client ledger ≥ 1 — the batch can never
+        zero an object a submit is about to borrow.  (Transient put-refs,
+        whose count is exactly 1 by construction, bypass this buffer and
+        ride the submitting thread's FIFO channel — see submit().)"""
+        if self._stop.is_set():
+            return
+        with self._release_lock:
+            self._release_buf.append(oid)
+            if len(self._release_buf) < 64:
+                return
+            batch, self._release_buf = self._release_buf, []
+        self.rpc_oneway("release_batch", object_ids=batch)
+
+    def _flush_releases(self) -> None:
+        """Drain the release buffer (called before blocking waits and on
+        shutdown so deferred decrefs don't pin store memory)."""
+        with self._release_lock:
+            batch, self._release_buf = self._release_buf, []
+        if batch and not self._stop.is_set():
+            try:
+                self.rpc_oneway("release_batch", object_ids=batch)
+            except (OSError, ConnectionError, EOFError):
+                pass
 
     def notify_borrow(self, oid: str) -> None:
         if not self._stop.is_set():
@@ -760,6 +803,7 @@ class Worker:
 
     # -------------------------------------------------------------- shutdown
     def shutdown(self) -> None:
+        self._flush_releases()
         self._stop.set()
         with self._actor_chan_lock:
             for ch in self._actor_channels.values():
