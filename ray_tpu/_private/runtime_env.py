@@ -6,9 +6,15 @@ driver uploads ``working_dir``/``py_modules`` into the GCS KV
 chdir into the working dir and extend ``sys.path``, then undo after the
 task (env application is per-task here since workers are pooled).
 
-Omitted relative to the reference: pip/conda/container isolation — those
-need network/process isolation this environment doesn't have; env shape is
-validated so unsupported keys fail loudly rather than silently no-op.
+``pip`` isolation (reference: ``runtime_env={"pip": [...]}``) creates a
+cached venv per requirement-set hash (``--system-site-packages`` so jax &
+friends stay visible) and applies it per task by prefixing the venv's
+site-packages on ``sys.path``; restore removes the path AND purges modules
+imported from the venv, so the pooled worker stays clean.  Local
+wheel/sdist paths are uploaded into the GCS KV at submit and materialized
+on the executing host — installs run ``--no-index`` (zero-egress; index
+requirements fail loudly).  Conda/container isolation remains unsupported
+and validated-out.
 """
 
 from __future__ import annotations
@@ -21,7 +27,7 @@ import zipfile
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
-SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "config"}
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "config"}
 _URI_PREFIX = "kv://runtime_env/"
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_ZIP_BYTES = 64 * 1024 * 1024
@@ -34,7 +40,7 @@ def validate(runtime_env: Optional[dict]) -> None:
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)}; supported: "
-            f"{sorted(SUPPORTED_KEYS)} (pip/conda/container isolation is "
+            f"{sorted(SUPPORTED_KEYS)} (conda/container isolation is "
             f"not available in this build)")
 
 
@@ -72,6 +78,34 @@ def upload_dir(path: str, worker) -> str:
     return uri
 
 
+_WHL_PREFIX = "kvwhl://runtime_env/"
+
+
+_upload_memo: Dict[Tuple[str, float, int], str] = {}
+
+
+def upload_file(path: Path, worker) -> str:
+    """Content-address one local file (wheel/sdist) into the KV; the URI
+    keeps the original filename — pip parses wheel metadata from it.
+
+    prepare() runs on EVERY submit, so repeats are memoized by
+    (path, mtime, size) and KV existence is probed with kv_keys (metadata
+    only) — never by fetching the blob back just to test truthiness."""
+    st = path.stat()
+    memo_key = (str(path), st.st_mtime, st.st_size)
+    uri = _upload_memo.get(memo_key)
+    if uri is not None:
+        return uri
+    data = path.read_bytes()
+    digest = hashlib.sha256(data).hexdigest()[:16]
+    key = f"runtime_env/{digest}"
+    if not worker.rpc("kv_keys", prefix=key).get("keys"):
+        worker.rpc("kv_put", key=key, value=data)
+    uri = f"{_WHL_PREFIX}{digest}/{path.name}"
+    _upload_memo[memo_key] = uri
+    return uri
+
+
 def prepare(runtime_env: Optional[dict], worker) -> Optional[dict]:
     """Driver-side: resolve local paths into uploaded URIs (at submit)."""
     if not runtime_env:
@@ -86,30 +120,54 @@ def prepare(runtime_env: Optional[dict], worker) -> Optional[dict]:
         env["py_modules"] = [
             m if str(m).startswith(_URI_PREFIX) else upload_dir(m, worker)
             for m in mods]
+    pip = env.get("pip")
+    if pip:
+        if isinstance(pip, str):
+            pip = [pip]
+        resolved = []
+        for req in pip:
+            req = str(req)
+            if req.startswith(_WHL_PREFIX):
+                resolved.append(req)
+            elif Path(req).expanduser().is_file():
+                # local wheel/sdist: ship through the KV so any host's
+                # worker can install it (zero-egress: no index fetches)
+                resolved.append(upload_file(Path(req).expanduser().resolve(),
+                                            worker))
+            else:
+                resolved.append(req)
+        env["pip"] = sorted(resolved)
     return env
 
 
 # --------------------------------------------------------------- worker side
+def _env_cache_root(worker) -> Path:
+    """Root for ALL per-host runtime-env caches (zips and venvs).
+
+    Session dir when the worker has one; else a per-user tmp dir — a
+    world-shared path would let another user pre-seed content-addressed
+    entries, so the dir is created 0o700 and a pre-existing dir with the
+    wrong owner/mode is rejected (mkdir with exist_ok succeeds silently
+    on an attacker-owned path)."""
+    if worker.session is not None:
+        return Path(worker.session.path)
+    import getpass
+    import stat as stat_mod
+    import tempfile
+    root = Path(tempfile.gettempdir()) / f"rtpu_remote_{getpass.getuser()}"
+    root.mkdir(mode=0o700, exist_ok=True)
+    st = root.stat()
+    if st.st_uid != os.getuid() or stat_mod.S_IMODE(st.st_mode) != 0o700:
+        raise PermissionError(
+            f"{root} exists with wrong owner/mode; refusing to use it "
+            f"as the runtime_env cache")
+    return root
+
+
 def ensure_local(uri: str, worker) -> Path:
     """Fetch + extract a kv:// URI into the session cache; idempotent."""
     digest = uri[len(_URI_PREFIX):]
-    if worker.session is not None:
-        root = Path(worker.session.path)
-    else:  # remote worker: no session dir on this host.  Per-user dir:
-        # a world-shared path would let another user pre-seed
-        # content-addressed entries (and breaks on mkdir permissions).
-        import getpass
-        import stat as stat_mod
-        import tempfile
-        root = Path(tempfile.gettempdir()) / f"rtpu_remote_{getpass.getuser()}"
-        root.mkdir(mode=0o700, exist_ok=True)
-        st = root.stat()  # reject a pre-seeded foreign dir (mkdir with
-        # exist_ok succeeds silently on an attacker-owned path)
-        if st.st_uid != os.getuid() or stat_mod.S_IMODE(st.st_mode) != 0o700:
-            raise PermissionError(
-                f"{root} exists with wrong owner/mode; refusing to use it "
-                f"as the runtime_env cache")
-    cache = root / "runtime_env" / digest
+    cache = _env_cache_root(worker) / "runtime_env" / digest
     if cache.exists():
         return cache
     raw = worker.rpc("kv_get", key=f"runtime_env/{digest}").get("value")
@@ -127,19 +185,102 @@ def ensure_local(uri: str, worker) -> Path:
     return cache
 
 
+def _venv_site_packages(venv_dir: Path) -> Path:
+    cands = sorted(venv_dir.glob("lib/python*/site-packages"))
+    if not cands:
+        raise FileNotFoundError(f"no site-packages under {venv_dir}")
+    return cands[0]
+
+
+def ensure_pip_env(pip: List[str], worker) -> Path:
+    """Create-or-reuse the venv for this requirement set; returns its
+    site-packages dir.
+
+    venv per sha256(requirements) under ``<cache>/runtime_env/venvs``
+    (reference: per-job cached pip environments created by the runtime-env
+    agent).  Creation runs under an flock so pooled workers racing on
+    first use build it once; the venv uses --system-site-packages (jax and
+    the baked-in stack stay importable) and installs with --no-index
+    (zero-egress: local wheels via the KV; index requirements fail
+    loudly)."""
+    import fcntl
+    import subprocess
+
+    spec = sorted(str(r) for r in pip)
+    digest = hashlib.sha256("\n".join(spec).encode()).hexdigest()[:16]
+    venv_root = _env_cache_root(worker) / "runtime_env" / "venvs"
+    venv_dir = venv_root / digest
+    if venv_dir.exists():
+        return _venv_site_packages(venv_dir)
+    venv_root.mkdir(parents=True, exist_ok=True)
+    lock_path = venv_root / f".{digest}.lock"
+    with open(lock_path, "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        if venv_dir.exists():  # lost the race: winner built it
+            return _venv_site_packages(venv_dir)
+        # materialize KV wheels (filename preserved — pip reads wheel
+        # metadata from it)
+        wheel_dir = venv_root / f".{digest}.wheels"
+        wheel_dir.mkdir(exist_ok=True)
+        install_args = []
+        for req in spec:
+            if req.startswith(_WHL_PREFIX):
+                blob_id, _, fname = req[len(_WHL_PREFIX):].partition("/")
+                raw = worker.rpc("kv_get",
+                                 key=f"runtime_env/{blob_id}").get("value")
+                if raw is None:
+                    raise FileNotFoundError(
+                        f"runtime_env wheel missing from KV: {req}")
+                wheel_path = wheel_dir / fname
+                wheel_path.write_bytes(raw)
+                install_args.append(str(wheel_path))
+            else:
+                install_args.append(req)
+        tmp = venv_root / f".{digest}.tmp"
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+        import venv as venv_mod
+        venv_mod.create(tmp, system_site_packages=True, with_pip=True,
+                        symlinks=True)
+        proc = subprocess.run(
+            [str(tmp / "bin" / "python"), "-m", "pip", "install",
+             "--no-index", "--quiet", *install_args],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            shutil.rmtree(wheel_dir, ignore_errors=True)
+            raise RuntimeError(
+                f"pip runtime_env install failed (--no-index; only local/"
+                f"KV wheels resolve in this zero-egress build): "
+                f"{proc.stderr[-800:]}")
+        os.rename(tmp, venv_dir)  # atomic publish under the lock
+        shutil.rmtree(wheel_dir, ignore_errors=True)
+    return _venv_site_packages(venv_dir)
+
+
 def apply(runtime_env: Optional[dict], worker) -> Dict[str, Any]:
     """Apply working_dir/py_modules/env_vars; returns restore state.
 
     Exception-safe: a failure mid-application (missing KV blob, corrupt
     zip) restores whatever was already applied before re-raising, so the
     pooled worker process is left clean for the next task."""
-    saved: Dict[str, Any] = {"env": {}, "cwd": None, "sys_path": []}
+    saved: Dict[str, Any] = {"env": {}, "cwd": None, "sys_path": [],
+                             "module_prefixes": []}
     if not runtime_env:
         return saved
     try:
         for k, v in (runtime_env.get("env_vars") or {}).items():
             saved["env"][k] = os.environ.get(k)
             os.environ[k] = str(v)
+        pip = runtime_env.get("pip")
+        if pip:
+            site = ensure_pip_env([pip] if isinstance(pip, str) else pip,
+                                  worker)
+            sys.path.insert(0, str(site))
+            saved["sys_path"].append(str(site))
+            # restore() purges modules imported from here so the pooled
+            # worker's import state is not polluted for the next task
+            saved["module_prefixes"].append(str(site))
         wd = runtime_env.get("working_dir")
         if wd:
             local = ensure_local(wd, worker)
@@ -173,3 +314,9 @@ def restore(saved: Dict[str, Any]) -> None:
             sys.path.remove(p)
         except ValueError:
             pass
+    prefixes = tuple(saved.get("module_prefixes") or ())
+    if prefixes:
+        for name, mod in list(sys.modules.items()):
+            f = getattr(mod, "__file__", None)
+            if f and f.startswith(prefixes):
+                del sys.modules[name]

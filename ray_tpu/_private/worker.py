@@ -166,11 +166,19 @@ class Worker:
         self._actor_chan_lock = threading.Lock()
         self._pulls: Dict[str, dict] = {}       # in-flight chunked pulls
         self._pull_lock = threading.Lock()
-        # batched ObjectRef drops.  RLock: release() runs from __del__, and
-        # an allocation inside the locked region can trigger a cyclic-GC
-        # collection that finalizes ANOTHER ObjectRef on this same thread —
-        # re-entering release() mid-hold (a plain Lock would self-deadlock).
-        self._release_buf: List[str] = []
+        # Batched ObjectRef drops, buffered PER THREAD and flushed on the
+        # owning thread's (thread-local) channel.  This preserves the exact
+        # per-channel FIFO the unbatched code had — a release always lands
+        # on the same channel as, and after, that thread's earlier submits,
+        # so a pipelined `put(x); f.remote(r); del r` can never have its
+        # decref overtake the submit on another connection and free the dep
+        # before the GCS sees the task.  (Deferral only ever delays a
+        # release — the safe direction.)  The registry exists so shutdown
+        # can drain buffers of threads that went idle; RLock because
+        # release() runs from __del__ and an in-lock allocation can
+        # trigger cyclic GC that re-enters on the same thread.
+        self._release_tls = threading.local()
+        self._release_bufs: Dict[int, List[str]] = {}
         self._release_lock = threading.RLock()
         # return-oid → (actor_id, call_id) for in-flight actor calls: a
         # result observed through ANY path (inline reply, GCS get) marks
@@ -522,36 +530,57 @@ class Worker:
         not_ready = [by_id[o] for o in resp["not_ready"]]
         return ready, not_ready
 
+    def _release_buf(self) -> List[str]:
+        buf = getattr(self._release_tls, "buf", None)
+        if buf is None:
+            buf = self._release_tls.buf = []
+            with self._release_lock:
+                self._release_bufs[threading.get_ident()] = buf
+        return buf
+
     def release(self, oid: str) -> None:
         """Drop one client reference (ObjectRef.__del__).
 
-        Batched: dropping N refs costs N/64 control-plane messages, not N
-        (measured 0.3ms/message on the submit hot loop).  Safe to reorder
-        across threads: a buffered release is always for a DEAD ObjectRef
-        instance, so any oid still usable by a future submit has another
-        live instance keeping the client ledger ≥ 1 — the batch can never
-        zero an object a submit is about to borrow.  (Transient put-refs,
-        whose count is exactly 1 by construction, bypass this buffer and
-        ride the submitting thread's FIFO channel — see submit().)"""
+        Batched per thread: dropping N refs costs N/64 control-plane
+        messages, not N (measured 0.3ms/message on the submit hot loop).
+        Flushing on the dropping thread's own channel keeps the exact
+        submit→release FIFO of the unbatched path — see the buffer's
+        declaration comment for the ordering argument."""
         if self._stop.is_set():
             return
-        with self._release_lock:
-            self._release_buf.append(oid)
-            if len(self._release_buf) < 64:
+        buf = self._release_buf()
+        with self._release_lock:  # RLock: cyclic-GC re-entry safe
+            buf.append(oid)
+            if len(buf) < 64:
                 return
-            batch, self._release_buf = self._release_buf, []
+            batch = buf[:]
+            del buf[:]
         self.rpc_oneway("release_batch", object_ids=batch)
 
-    def _flush_releases(self) -> None:
-        """Drain the release buffer (called before blocking waits and on
-        shutdown so deferred decrefs don't pin store memory)."""
-        with self._release_lock:
-            batch, self._release_buf = self._release_buf, []
-        if batch and not self._stop.is_set():
+    def _flush_releases(self, all_threads: bool = False) -> None:
+        """Drain THIS thread's release buffer (called before blocking
+        waits and puts so deferred decrefs don't pin store memory).
+        ``all_threads`` (shutdown only) drains every thread's buffer on
+        the calling thread — cross-channel ordering no longer matters
+        once nothing new can be submitted."""
+        batches: List[List[str]] = []
+        buf = getattr(self._release_tls, "buf", None)
+        if buf:
+            batches.append(buf[:])
+            del buf[:]
+        if all_threads:
+            with self._release_lock:
+                for b in self._release_bufs.values():
+                    if b:
+                        batches.append(b[:])
+                        del b[:]
+        for batch in batches:
+            if self._stop.is_set():
+                return
             try:
                 self.rpc_oneway("release_batch", object_ids=batch)
             except (OSError, ConnectionError, EOFError):
-                pass
+                return
 
     def notify_borrow(self, oid: str) -> None:
         if not self._stop.is_set():
@@ -803,7 +832,7 @@ class Worker:
 
     # -------------------------------------------------------------- shutdown
     def shutdown(self) -> None:
-        self._flush_releases()
+        self._flush_releases(all_threads=True)
         self._stop.set()
         with self._actor_chan_lock:
             for ch in self._actor_channels.values():

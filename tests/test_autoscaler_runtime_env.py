@@ -111,7 +111,7 @@ def test_autoscaler_reports_infeasible(ray_start_2_cpus):
 # ---------------------------------------------------------- runtime_env
 
 def test_runtime_env_validation(ray_start_regular):
-    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    @ray_tpu.remote(runtime_env={"conda": "myenv"})
     def f():
         return 1
 
@@ -196,3 +196,72 @@ def test_runtime_env_missing_blob_fails_task_not_worker(ray_start_regular):
         return "alive"
 
     assert ray_tpu.get(g.remote(), timeout=60) == "alive"
+
+
+# ------------------------------------------------------------ pip isolation
+
+def _make_wheel(tmp_path, name="rtpu_testpkg", version="1.0",
+                body="MAGIC = 42\n"):
+    """Minimal hand-built wheel (no network, no build backend needed)."""
+    import zipfile
+
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    di = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", body)
+        zf.writestr(f"{di}/METADATA",
+                    f"Metadata-Version: 2.1\nName: {name}\n"
+                    f"Version: {version}\n")
+        zf.writestr(f"{di}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\n"
+                    "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        zf.writestr(f"{di}/RECORD", "")
+    return whl
+
+
+def test_pip_runtime_env_isolated_venv(ray_start_regular, tmp_path):
+    """A wheel installs into a per-env-hash venv; the task sees it, the
+    worker pool stays clean, and the cached venv is reused (reference:
+    runtime_env pip isolation with per-job cached environments)."""
+    import ray_tpu
+
+    whl = _make_wheel(tmp_path)
+
+    @ray_tpu.remote(runtime_env={"pip": [str(whl)]})
+    def with_env():
+        import rtpu_testpkg
+        return rtpu_testpkg.MAGIC, rtpu_testpkg.__file__, os.getpid()
+
+    @ray_tpu.remote
+    def without_env():
+        try:
+            import rtpu_testpkg  # noqa: F401
+            return "POLLUTED"
+        except ImportError:
+            return "clean"
+
+    magic, modfile, pid1 = ray_tpu.get(with_env.remote(), timeout=180)
+    assert magic == 42
+    assert "/runtime_env/venvs/" in modfile, modfile
+
+    # the pooled workers must not see the package without the env
+    import time as _t
+    for _ in range(4):
+        assert ray_tpu.get(without_env.remote(), timeout=60) == "clean", \
+            "venv leaked into the pooled worker"
+    # cached venv reused: second env task is fast and yields the same env
+    t0 = _t.time()
+    magic2, modfile2, _ = ray_tpu.get(with_env.remote(), timeout=60)
+    assert magic2 == 42 and modfile2 == modfile
+    assert _t.time() - t0 < 30, "venv cache not reused"
+
+
+def test_pip_runtime_env_bad_requirement_fails_loudly(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(runtime_env={"pip": ["definitely-not-a-real-pkg==9.9"]})
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.RayTaskError):
+        ray_tpu.get(f.remote(), timeout=180)
