@@ -19,6 +19,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import protocol, rtlog
+from ray_tpu.util import tracing
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.serialization import serialize_to_bytes
 from ray_tpu import exceptions as exc
@@ -216,7 +217,28 @@ class ActorServer:
                         self._run_async_call(method, args, kwargs, conn, msg),
                         self._loop)
                     return  # executor thread freed; reply comes from the loop
-            value = self._run_method(method_name, args, kwargs)
+            span = tracing.SpanContext.from_dict(msg.get("trace_ctx"))
+            if span is not None:
+                # child span per method call; timeline events link back to
+                # the caller's span (reference: ray.util.tracing)
+                t0 = time.time()
+                tracing._set_span(tracing.SpanContext(
+                    span.trace_id, tracing._new_id(), span.span_id,
+                    method_name))
+            try:
+                value = self._run_method(method_name, args, kwargs)
+            finally:
+                if span is not None:
+                    cur = tracing.current_span()
+                    tracing._emit([{
+                        "name": f"{self.spec.get('class_name', 'Actor')}."
+                                f"{method_name}",
+                        "cat": "actor_task", "ph": "X",
+                        "pid": w.node_id, "tid": os.getpid(),
+                        "ts": t0 * 1e6,
+                        "dur": (time.time() - t0) * 1e6,
+                        "args": cur.to_dict() if cur else None}])
+                    tracing._set_span(None)
             results = w._store_results(return_ids, value, num_returns)
             ok = True
         except ActorExit:

@@ -2199,6 +2199,13 @@ class GcsServer:
     def _h_store_stats(self, msg: dict) -> dict:
         return {"stats": self.store.stats()}
 
+    def _h_ingest_events(self, msg: dict) -> dict:
+        """Timeline events from processes with no task conn (drivers):
+        span traces, merged device traces (util/tracing.py)."""
+        with self.lock:
+            self.events.extend(msg["events"])
+        return {}
+
     def _h_timeline(self, msg: dict) -> dict:
         with self.lock:
             return {"events": list(self.events)}

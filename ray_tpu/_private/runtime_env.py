@@ -81,19 +81,22 @@ def upload_dir(path: str, worker) -> str:
 _WHL_PREFIX = "kvwhl://runtime_env/"
 
 
-_upload_memo: Dict[Tuple[str, float, int], str] = {}
-
-
 def upload_file(path: Path, worker) -> str:
     """Content-address one local file (wheel/sdist) into the KV; the URI
     keeps the original filename — pip parses wheel metadata from it.
 
     prepare() runs on EVERY submit, so repeats are memoized by
-    (path, mtime, size) and KV existence is probed with kv_keys (metadata
-    only) — never by fetching the blob back just to test truthiness."""
+    (path, mtime, size) ON THE WORKER (memo dies with the cluster
+    connection — a module-level memo would survive init/shutdown/init and
+    skip the upload into a fresh, empty KV) and KV existence is probed
+    with kv_keys (metadata only) — never by fetching the blob back just
+    to test truthiness."""
+    memo = getattr(worker, "_renv_upload_memo", None)
+    if memo is None:
+        memo = worker._renv_upload_memo = {}
     st = path.stat()
     memo_key = (str(path), st.st_mtime, st.st_size)
-    uri = _upload_memo.get(memo_key)
+    uri = memo.get(memo_key)
     if uri is not None:
         return uri
     data = path.read_bytes()
@@ -102,7 +105,7 @@ def upload_file(path: Path, worker) -> str:
     if not worker.rpc("kv_keys", prefix=key).get("keys"):
         worker.rpc("kv_put", key=key, value=data)
     uri = f"{_WHL_PREFIX}{digest}/{path.name}"
-    _upload_memo[memo_key] = uri
+    memo[memo_key] = uri
     return uri
 
 

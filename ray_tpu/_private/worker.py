@@ -34,6 +34,7 @@ from ray_tpu._private.serialization import (
 )
 from ray_tpu._private.session import Session
 from ray_tpu._private.shm_store import ShmObjectStore
+from ray_tpu.util import tracing
 from ray_tpu import exceptions as exc
 
 logger = rtlog.get("worker")
@@ -535,6 +536,11 @@ class Worker:
         if buf is None:
             buf = self._release_tls.buf = []
             with self._release_lock:
+                stale = self._release_bufs.get(threading.get_ident())
+                if stale:
+                    # CPython reuses thread idents: adopt a dead thread's
+                    # unflushed drops instead of orphaning them forever
+                    buf.extend(stale)
                 self._release_bufs[threading.get_ident()] = buf
         return buf
 
@@ -564,12 +570,12 @@ class Worker:
         the calling thread — cross-channel ordering no longer matters
         once nothing new can be submitted."""
         batches: List[List[str]] = []
-        buf = getattr(self._release_tls, "buf", None)
-        if buf:
-            batches.append(buf[:])
-            del buf[:]
-        if all_threads:
-            with self._release_lock:
+        with self._release_lock:  # copy+clear must be atomic vs shutdown
+            buf = getattr(self._release_tls, "buf", None)
+            if buf:
+                batches.append(buf[:])
+                del buf[:]
+            if all_threads:
                 for b in self._release_bufs.values():
                     if b:
                         batches.append(b[:])
@@ -721,6 +727,11 @@ class Worker:
             "runtime_env": runtime_env,
             **fields,
         }
+        span = tracing.current_span()
+        if span is not None:
+            # OTel-style propagation: the task's span will parent to this
+            # one in the timeline dump (reference: ray.util.tracing)
+            spec["trace_ctx"] = span.to_dict()
         # one-way submit: return ids are generated client-side, so there is
         # nothing to wait for — pipelined submissions instead of a control-
         # plane round trip per task (reference: lease-cached submission).
@@ -800,9 +811,11 @@ class Worker:
         if hold:
             self.rpc_oneway("add_refs", object_ids=hold,
                             ledger=f"call:{call_id}")
+        span = tracing.current_span()
         msg = {"kind": "call", "call_id": call_id, "method": method,
                "return_ids": return_ids, "num_returns": num_returns,
                "_retries_left": max_task_retries,
+               "trace_ctx": span.to_dict() if span else None,
                "arg_ledger": f"call:{call_id}" if hold else None, **fields}
         ch = self._actor_channel(actor_id, max_task_retries)
         with self._actor_chan_lock:
@@ -1038,6 +1051,13 @@ class Worker:
         self._current_spec = spec
         self.ctx.in_task = True
         self.ctx.task_id = spec["task_id"]
+        parent_span = tracing.SpanContext.from_dict(spec.get("trace_ctx"))
+        task_span = None
+        if parent_span is not None:
+            task_span = tracing.SpanContext(
+                parent_span.trace_id, tracing._new_id(),
+                parent_span.span_id, spec.get("name", "task"))
+            tracing._set_span(task_span)
         saved_env = {}
         try:
             # inside the try: a bad runtime_env (missing KV blob, corrupt
@@ -1062,11 +1082,15 @@ class Worker:
             self._current_spec = None
             self.ctx.in_task = False
             self.ctx.task_id = None
+            if task_span is not None:
+                tracing._set_span(None)
             if GLOBAL_CONFIG.timeline_enabled:
-                self._send_event({"kind": "profile_events", "events": [{
-                    "name": spec.get("name", "task"), "cat": "task",
-                    "ph": "X", "pid": self.node_id, "tid": os.getpid(),
-                    "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6}]})
+                ev = {"name": spec.get("name", "task"), "cat": "task",
+                      "ph": "X", "pid": self.node_id, "tid": os.getpid(),
+                      "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6}
+                if task_span is not None:
+                    ev["args"] = task_span.to_dict()
+                self._send_event({"kind": "profile_events", "events": [ev]})
 
     # ------------------------------------------------------------ actor side
     def _become_actor(self, spec: dict, task_queue) -> None:

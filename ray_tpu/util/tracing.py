@@ -1,0 +1,181 @@
+"""Span tracing + device-trace merge onto the cluster timeline.
+
+Reference: ``python/ray/util/tracing/`` (SURVEY.md §5.1) — OpenTelemetry
+span context rides task/actor metadata so a request's causal tree spans
+processes; and ``ray timeline`` renders host-side Chrome trace events.
+TPU-native addition (§5.1 rebuild note): ``jax.profiler`` device traces
+are merged ONTO THE SAME CLOCK as the host spans, so one
+``ray_tpu.timeline()`` dump shows a train step's host dispatch span above
+the XLA ops it ran.
+
+Usage::
+
+    from ray_tpu.util import tracing
+
+    with tracing.trace("ingest-and-train"):       # driver: new trace root
+        ref = preprocess.remote(batch)            # ctx propagates to tasks
+        ...
+
+    with tracing.profile_device("train_step"):    # any process with jax
+        state, m = step_fn(state, batch)          # device events captured
+        jax.block_until_ready(m)
+    # both land in ray_tpu.timeline(): host spans carry
+    # trace_id/span_id/parent_id args; device events carry cat="device".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+import uuid
+from typing import Iterator, Optional
+
+_tls = threading.local()
+
+
+class SpanContext:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name}
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> Optional["SpanContext"]:
+        if not d:
+            return None
+        return SpanContext(d["trace_id"], d["span_id"],
+                           d.get("parent_id"), d.get("name", ""))
+
+
+def current_span() -> Optional[SpanContext]:
+    return getattr(_tls, "span", None)
+
+
+def _set_span(ctx: Optional[SpanContext]) -> None:
+    _tls.span = ctx
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@contextlib.contextmanager
+def trace(name: str) -> Iterator[SpanContext]:
+    """Open a span (new trace root, or child of the current span).
+
+    Submissions made inside inherit the span context through task
+    metadata, so worker-side spans link back to this one in the
+    timeline dump."""
+    parent = current_span()
+    ctx = SpanContext(
+        trace_id=parent.trace_id if parent else _new_id(),
+        span_id=_new_id(),
+        parent_id=parent.span_id if parent else None,
+        name=name)
+    _set_span(ctx)
+    t0 = time.time()
+    try:
+        yield ctx
+    finally:
+        _set_span(parent)
+        _emit([{"name": name, "cat": "span", "ph": "X",
+                "pid": _host_pid(), "tid": threading.get_ident() % 100000,
+                "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6,
+                "args": ctx.to_dict()}])
+
+
+def _host_pid() -> str:
+    """Timeline row for this process: the executing node for workers,
+    'driver' for the driver (matching the task-event convention)."""
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod.try_global_worker()
+    if w is None or w.role == "driver":
+        return "driver"
+    return w.node_id or "worker"
+
+
+def _emit(events) -> None:
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    if not GLOBAL_CONFIG.timeline_enabled:
+        return  # operator disabled the timeline: emit nothing anywhere,
+        # so trace trees never appear partially (and GCS events stay flat)
+    w = worker_mod.try_global_worker()
+    if w is None:
+        return
+    if w.role == "driver":
+        # drivers have no task conn; ship via rpc (best effort)
+        try:
+            w.rpc_oneway("ingest_events", events=events)
+        except Exception:  # noqa: BLE001 - tracing must never break work
+            pass
+    else:
+        w._send_event({"kind": "profile_events", "events": events})
+
+
+@contextlib.contextmanager
+def profile_device(name: str = "device",
+                   keep_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture a jax.profiler device trace and merge it onto the cluster
+    timeline's clock.
+
+    jax writes a Chrome trace (``*.trace.json.gz``) with timestamps
+    relative to capture start; events are re-based to wall-clock epoch µs
+    (the timeline's clock) using the capture-start host time, tagged
+    cat="device", and shipped to the GCS — one ``ray_tpu.timeline()``
+    dump then shows host task/span rows and XLA device rows together."""
+    import glob
+    import gzip
+    import json
+    import shutil
+    import tempfile
+
+    import jax
+
+    out_dir = keep_dir or tempfile.mkdtemp(prefix="rtpu_devtrace_")
+    span = current_span()
+    host_start_us = time.time() * 1e6
+    try:
+        with jax.profiler.trace(out_dir):
+            yield
+    finally:
+        events = []
+        try:
+            for path in glob.glob(
+                    os.path.join(out_dir, "plugins", "profile", "*",
+                                 "*.trace.json.gz")):
+                data = json.loads(gzip.open(path).read())
+                raw = data.get("traceEvents", [])
+                xs = [e["ts"] for e in raw
+                      if e.get("ts") is not None and e.get("ph") == "X"]
+                if not xs:
+                    continue
+                base = min(xs)
+                for e in raw:
+                    if e.get("ph") != "X" or e.get("ts") is None:
+                        continue
+                    ev = {"name": e.get("name", "?"), "cat": "device",
+                          "ph": "X",
+                          "pid": f"device:{name}",
+                          "tid": e.get("tid", 0),
+                          "ts": host_start_us + (e["ts"] - base),
+                          "dur": e.get("dur", 0)}
+                    if span is not None:
+                        ev["args"] = span.to_dict()
+                    events.append(ev)
+        except Exception:  # noqa: BLE001 - tracing must never break work
+            events = []
+        if events:
+            _emit(events)
+        if keep_dir is None:
+            shutil.rmtree(out_dir, ignore_errors=True)
