@@ -1,0 +1,23 @@
+# Convenience targets; everything here is also runnable through pytest.
+
+PY ?= python
+
+.PHONY: test sanitize fuzz bench
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# ASAN + TSAN over the native slab store (SURVEY.md §5.2): longer runs
+# than the in-suite smoke (tests/test_native_sanitizers.py).
+sanitize:
+	RTPU_SANITIZE_SECONDS=20 $(PY) -m pytest \
+		tests/test_native_sanitizers.py -q -x
+
+# Seedable protocol fuzz (lease/refcount/lineage state machines) at
+# multi-million-step depth (the in-suite run uses a smaller budget).
+fuzz:
+	RTPU_SIM_STEPS=2000000 $(PY) -m pytest \
+		tests/test_protocol_sim.py -q -x
+
+bench:
+	$(PY) bench.py

@@ -119,7 +119,7 @@ class WorkerState:
 
 class ObjMeta:
     __slots__ = ("state", "loc", "data", "size", "node_id", "refcount",
-                 "lineage_task", "contained")
+                 "lineage_task", "contained", "has_producer")
 
     def __init__(self):
         self.state = PENDING
@@ -130,6 +130,12 @@ class ObjMeta:
         self.refcount = 0
         self.lineage_task: Optional[str] = None
         self.contained: List[str] = []  # refs nested inside the value
+        # True while a submitted task's return is in flight: a PENDING
+        # meta with a producer must survive refcount 0 (the seal is
+        # coming); a PENDING meta WITHOUT one (resurrected by a stray
+        # add_ref on a deleted object) must not leak forever — found by
+        # the refcount fuzz (tests/test_protocol_sim.py).
+        self.has_producer = False
 
 
 class ActorState:
@@ -198,6 +204,7 @@ class GcsServer:
         self.dead_clients: Set[str] = set()
         self._staging: Dict[str, dict] = {}   # in-flight chunked uploads
         self._remote_pulls: Dict[str, threading.Event] = {}  # relay dedup
+        self._graceful_free: Dict[str, float] = {}  # rc-0-at-seal grace
         self.driver_ids: Set[str] = set()
         self.log_sink = None                              # callable(line)
         self._shutdown = False
@@ -448,6 +455,7 @@ class GcsServer:
                      lineage_task: Optional[str] = None) -> None:
         meta = self._get_or_create_meta(oid)
         meta.state = READY
+        meta.has_producer = False
         meta.loc = loc
         meta.data = data
         meta.size = size
@@ -464,11 +472,21 @@ class GcsServer:
             # segment survives a head crash; keep the snapshot's shm index
             # current so a restarted head re-adopts it (just sets an event)
             self._persist_durable()
+        if meta.refcount <= 0:
+            # Sealed with zero refs — e.g. an actor result whose caller
+            # died mid-call: nothing will ever release it.  Free after a
+            # grace period, NOT now: (a) the caller's add_refs oneway may
+            # still be in flight on another channel (no cross-channel
+            # ordering) and will rescue it, and (b) a just-woken getter
+            # needs a moment to read/mmap (unlink under a live mmap is
+            # safe by store design, so late frees cannot corrupt reads).
+            self._graceful_free[oid] = time.monotonic()
         self.cv.notify_all()
 
     def _seal_error(self, oid: str, err_bytes: bytes) -> None:
         meta = self._get_or_create_meta(oid)
         meta.state = ERROR
+        meta.has_producer = False
         meta.loc = "inline"
         meta.data = err_bytes
         self._promote_dep_waiters(oid, errored=True)
@@ -478,6 +496,9 @@ class GcsServer:
     def _mark_object_lost(self, oid: str, meta: ObjMeta) -> None:
         if meta.lineage_task and meta.lineage_task in self.lineage:
             meta.state = PENDING
+            meta.has_producer = True  # the reconstruction below is the
+            # producer; without this a zero-ref decref would zombie-delete
+            # the meta out from under it
             meta.data = None
             spec = dict(self.lineage[meta.lineage_task])
             spec["is_reconstruction"] = True
@@ -500,6 +521,12 @@ class GcsServer:
         if meta is None:
             return
         meta.refcount -= n
+        if meta.refcount <= 0 and meta.state == PENDING \
+                and not meta.has_producer:
+            # zombie: zero refs, nothing will ever seal it — drop the
+            # entry (no data to free; a late seal re-creates it cleanly)
+            del self.objects[oid]
+            return
         if meta.refcount <= 0 and meta.state != PENDING:
             for c in meta.contained:
                 self._decref(c)
@@ -966,6 +993,18 @@ class GcsServer:
         while not self._shutdown:
             time.sleep(0.1)
             self._restore_grace_check()
+            # free rc-0-at-seal objects whose grace expired with no
+            # add_refs having landed (see _seal_object)
+            if self._graceful_free:
+                now = time.monotonic()
+                with self.cv:
+                    for oid in [o for o, t in self._graceful_free.items()
+                                if now - t > 10.0]:
+                        self._graceful_free.pop(oid, None)
+                        meta = self.objects.get(oid)
+                        if meta is not None and meta.refcount <= 0 \
+                                and meta.state != PENDING:
+                            self._decref(oid, 0)
             # unconditional periodic pump: the _PUMP_MISS_CAP scan cutoff
             # plus queue rotation means a placeable spec deep behind
             # unplaceable ones is only reached across several pumps — and
@@ -1674,6 +1713,7 @@ class GcsServer:
                 for oid in spec["return_ids"]:
                     meta = self._get_or_create_meta(oid)
                     meta.refcount += 1
+                    meta.has_producer = True
                     refs[oid] = refs.get(oid, 0) + 1
                 # pin args (top-level refs) and borrows (refs nested in
                 # values) until the task reaches a terminal state

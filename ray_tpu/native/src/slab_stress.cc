@@ -1,0 +1,249 @@
+// Multi-process stress harness for slab_store.cc, built under
+// ASAN/TSAN by the sanitizer test target (reference: Ray's Bazel
+// --config=asan/tsan gtest runs over plasma; SURVEY.md §5.2).
+//
+// Forks N writer/reader/deleter processes against ONE shared store file:
+//   - writers put/seal objects of random sizes (forcing LRU eviction),
+//   - readers get/pin/unpin concurrently,
+//   - deleters delete random ids,
+//   - the parent SIGKILLs a writer mid-put every round, then relies on
+//     the robust mutex (EOWNERDEAD → consistent → reap_unsealed) to
+//     recover the half-written blocks.
+// Exit code 0 = no sanitizer findings, store stayed consistent (final
+// stats walk + a full put/get round-trip).
+//
+// Usage: slab_stress <store-path> <seconds> [seed] [mode]
+//   mode "procs" (default): forked processes + SIGKILL chaos (ASAN run)
+//   mode "threads": in-process threads sharing one handle — the schedule
+//   TSAN can actually instrument (cross-process shm races are invisible
+//   to it); no kill chaos in this mode.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <csignal>
+#include <ctime>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <thread>
+#include <vector>
+
+extern "C" {
+struct rtpu_store;
+rtpu_store* rtpu_store_open(const char* path, uint64_t cap, uint32_t max_obj,
+                            int create);
+void rtpu_store_close(rtpu_store* s);
+int rtpu_store_unlink(const char* path);
+int64_t rtpu_put(rtpu_store* s, const char* id, const void* data,
+                 uint64_t size);
+int64_t rtpu_get(rtpu_store* s, const char* id, void* out, uint64_t cap);
+int64_t rtpu_create(rtpu_store* s, const char* id, uint64_t size);
+int rtpu_seal(rtpu_store* s, const char* id);
+int rtpu_delete(rtpu_store* s, const char* id);
+int rtpu_exists(rtpu_store* s, const char* id);
+int rtpu_unpin(rtpu_store* s, const char* id);
+int64_t rtpu_lookup_pin(rtpu_store* s, const char* id, uint64_t* size);
+void* rtpu_base(rtpu_store* s);
+int64_t rtpu_reap_dead(rtpu_store* s);
+void rtpu_store_stats(rtpu_store* s, uint64_t* out);
+}
+
+static const uint64_t kCap = 8ull << 20;    // 8MB heap: eviction pressure
+static const uint32_t kMaxObj = 512;
+static const int kIds = 64;
+
+static void make_id(char* buf, unsigned v) {
+  snprintf(buf, 64, "obj%05u", v % kIds);
+}
+
+static unsigned xorshift(unsigned* st) {
+  unsigned x = *st;
+  x ^= x << 13; x ^= x >> 17; x ^= x << 5;
+  return *st = x;
+}
+
+// one worker process: mixed ops until killed or deadline
+static int worker(const char* path, int role, unsigned seed, int seconds) {
+  rtpu_store* s = rtpu_store_open(path, kCap, kMaxObj, 0);
+  if (!s) return 2;
+  char id[64];
+  char buf[1 << 16];
+  time_t end = time(nullptr) + seconds;
+  unsigned st = seed | 1;
+  while (time(nullptr) < end) {
+    unsigned r = xorshift(&st);
+    make_id(id, r >> 8);
+    switch ((role + (r & 3)) % 4) {
+      case 0: {  // put (sealed in one call)
+        uint64_t size = 64 + (r % (sizeof(buf) - 64));
+        memset(buf, (int)(r & 0xff), size);
+        rtpu_put(s, id, buf, size);
+        break;
+      }
+      case 1: {  // create→seal (two-phase; this is the kill -9 window)
+        uint64_t size = 64 + (r % (sizeof(buf) - 64));
+        if (rtpu_create(s, id, size) >= 0) rtpu_seal(s, id);
+        break;
+      }
+      case 2: {  // pinned read + unpin
+        uint64_t size = 0;
+        int64_t off = rtpu_lookup_pin(s, id, &size);
+        if (off >= 0) {
+          volatile char sink = ((char*)rtpu_base(s) + off)[0];
+          (void)sink;
+          rtpu_unpin(s, id);
+        } else {
+          rtpu_get(s, id, buf, sizeof(buf));
+        }
+        break;
+      }
+      default:
+        rtpu_delete(s, id);
+    }
+  }
+  rtpu_store_close(s);
+  return 0;
+}
+
+// thread-mode body: same op mix against a SHARED handle
+static void thread_worker(rtpu_store* s, int role, unsigned seed,
+                          int seconds) {
+  char id[64];
+  std::vector<char> buf(1 << 16);
+  time_t end = time(nullptr) + seconds;
+  unsigned st = seed | 1;
+  while (time(nullptr) < end) {
+    unsigned r = xorshift(&st);
+    make_id(id, r >> 8);
+    switch ((role + (r & 3)) % 4) {
+      case 0: {
+        uint64_t size = 64 + (r % (buf.size() - 64));
+        memset(buf.data(), (int)(r & 0xff), size);
+        rtpu_put(s, id, buf.data(), size);
+        break;
+      }
+      case 1: {
+        uint64_t size = 64 + (r % (buf.size() - 64));
+        if (rtpu_create(s, id, size) >= 0) rtpu_seal(s, id);
+        break;
+      }
+      case 2: {
+        uint64_t size = 0;
+        int64_t off = rtpu_lookup_pin(s, id, &size);
+        if (off >= 0) {
+          volatile char sink = ((char*)rtpu_base(s) + off)[0];
+          (void)sink;
+          rtpu_unpin(s, id);
+        } else {
+          rtpu_get(s, id, buf.data(), buf.size());
+        }
+        break;
+      }
+      default:
+        rtpu_delete(s, id);
+    }
+  }
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <store-path> <seconds> [seed] [mode]\n",
+            argv[0]);
+    return 2;
+  }
+  const char* path = argv[1];
+  int seconds = atoi(argv[2]);
+  unsigned seed = argc > 3 ? (unsigned)atoi(argv[3]) : 1234u;
+  bool thread_mode = argc > 4 && strcmp(argv[4], "threads") == 0;
+
+  rtpu_store_unlink(path);
+  rtpu_store* s = rtpu_store_open(path, kCap, kMaxObj, 1);
+  if (!s) { fprintf(stderr, "create failed\n"); return 2; }
+
+  if (thread_mode) {
+    std::vector<std::thread> ts;
+    for (int i = 0; i < 6; ++i)
+      ts.emplace_back(thread_worker, s, i, seed + i * 977, seconds);
+    for (auto& t : ts) t.join();
+    uint64_t stats[8] = {0};
+    rtpu_store_stats(s, stats);
+    char buf[4096];
+    memset(buf, 0x5a, sizeof(buf));
+    int rc = 0;
+    char out[4096];
+    if (rtpu_put(s, "final_check", buf, sizeof(buf)) < 0 ||
+        rtpu_get(s, "final_check", out, sizeof(out)) !=
+            (int64_t)sizeof(out) ||
+        memcmp(buf, out, sizeof(out)) != 0) {
+      fprintf(stderr, "thread-mode post round-trip failed\n");
+      rc = 5;
+    }
+    fprintf(stderr, "thread stress done: used=%llu objects=%llu rc=%d\n",
+            (unsigned long long)stats[0], (unsigned long long)stats[2], rc);
+    rtpu_store_close(s);
+    rtpu_store_unlink(path);
+    return rc;
+  }
+
+  const int kWorkers = 6;
+  pid_t pids[kWorkers];
+  for (int i = 0; i < kWorkers; ++i) {
+    pid_t pid = fork();
+    if (pid == 0) _exit(worker(path, i, seed + i * 977, seconds));
+    pids[i] = pid;
+  }
+
+  // chaos: SIGKILL a (re-forked) writer mid-run, every ~200ms
+  time_t end = time(nullptr) + seconds;
+  unsigned st = seed;
+  int kills = 0;
+  while (time(nullptr) < end) {
+    usleep(200 * 1000);
+    int victim = xorshift(&st) % kWorkers;
+    kill(pids[victim], SIGKILL);
+    ++kills;
+    int status = 0;
+    waitpid(pids[victim], &status, 0);
+    rtpu_reap_dead(s);  // what the GCS monitor does on worker death
+    pid_t pid = fork();
+    if (pid == 0) _exit(worker(path, victim, seed + kills * 31, seconds));
+    pids[victim] = pid;
+  }
+
+  int rc = 0;
+  for (int i = 0; i < kWorkers; ++i) {
+    int status = 0;
+    waitpid(pids[i], &status, 0);
+    if (WIFEXITED(status) && WEXITSTATUS(status) != 0) rc = WEXITSTATUS(status);
+    if (WIFSIGNALED(status) && WTERMSIG(status) != SIGKILL) {
+      fprintf(stderr, "worker died on signal %d\n", WTERMSIG(status));
+      rc = 3;
+    }
+  }
+
+  // post-chaos consistency: reap, stats walk, and a full round-trip
+  rtpu_reap_dead(s);
+  uint64_t stats[8] = {0};
+  rtpu_store_stats(s, stats);
+  char buf[4096];
+  memset(buf, 0x5a, sizeof(buf));
+  if (rtpu_put(s, "final_check", buf, sizeof(buf)) < 0) {
+    fprintf(stderr, "post-chaos put failed\n");
+    rc = rc ? rc : 4;
+  } else {
+    char out[4096];
+    if (rtpu_get(s, "final_check", out, sizeof(out)) !=
+            (int64_t)sizeof(out) ||
+        memcmp(buf, out, sizeof(out)) != 0) {
+      fprintf(stderr, "post-chaos round-trip mismatch\n");
+      rc = rc ? rc : 5;
+    }
+  }
+  fprintf(stderr, "stress done: kills=%d used=%llu objects=%llu rc=%d\n",
+          kills, (unsigned long long)stats[0], (unsigned long long)stats[2],
+          rc);
+  rtpu_store_close(s);
+  rtpu_store_unlink(path);
+  return rc;
+}

@@ -1,0 +1,263 @@
+"""Seedable random-schedule simulator for the control-plane protocols
+(SURVEY.md §5.2 rebuild note: "a seedable in-process scheduler-sim
+harness for lease/refcount protocol fuzzing — cheap, pays for itself").
+
+Drives the GCS's state machines DIRECTLY at the handler level — no
+sockets, no worker processes — so hundreds of thousands of protocol
+steps run in seconds, against independent oracles:
+
+- refcount fuzz: random put/add_ref/release/release_batch/disconnect
+  interleavings; oracle = a model ledger; invariant = the GCS refcount
+  table matches the model exactly and objects die exactly when counts
+  reach zero.
+- lease/lineage sim: fake workers (stub task conns) receive dispatches;
+  a seeded schedule completes tasks, fails them, or kills workers;
+  invariants = every submitted task reaches a terminal state, retry
+  budgets are honored, and node resources return to full after drain.
+
+``RTPU_SIM_STEPS`` scales the depth (``make fuzz`` runs 2M).
+"""
+
+import os
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import gcs as gcs_mod
+
+STEPS = int(os.environ.get("RTPU_SIM_STEPS", "250000"))
+
+
+# ------------------------------------------------------------- refcounts
+
+def test_refcount_protocol_fuzz(ray_start_regular):
+    head = ray_tpu._head
+    rng = random.Random(1234)
+    clients = [f"simclient{i:02d}" for i in range(8)]
+    live_oids = []
+    model = {c: {} for c in clients}  # client -> oid -> count
+    next_oid = [0]
+
+    def new_oid():
+        next_oid[0] += 1
+        return f"simobj{next_oid[0]:08d}"
+
+    def model_refcount(oid):
+        return sum(t.get(oid, 0) for t in model.values())
+
+    for step in range(STEPS):
+        op = rng.random()
+        c = rng.choice(clients)
+        if op < 0.25 or not live_oids:
+            oid = new_oid()
+            head._h_put_object({"client_id": c, "object_id": oid,
+                                "loc": "inline", "data": b"x", "size": 1,
+                                "contained": []})
+            model[c][oid] = model[c].get(oid, 0) + 1
+            live_oids.append(oid)
+        elif op < 0.45:
+            oid = rng.choice(live_oids)
+            head._h_add_ref({"client_id": c, "object_id": oid})
+            model[c][oid] = model[c].get(oid, 0) + 1
+        elif op < 0.60:
+            oids = rng.sample(live_oids, min(len(live_oids), 4))
+            head._h_add_refs({"client_id": c, "object_ids": oids})
+            for oid in oids:
+                model[c][oid] = model[c].get(oid, 0) + 1
+        elif op < 0.80:
+            oid = rng.choice(live_oids)
+            head._h_release({"client_id": c, "object_id": oid})
+            if model[c].get(oid, 0) > 0:
+                model[c][oid] -= 1
+                if model[c][oid] == 0:
+                    del model[c][oid]
+        elif op < 0.95:
+            oids = rng.sample(live_oids, min(len(live_oids), 6))
+            head._h_release_batch({"client_id": c, "object_ids": oids})
+            for oid in oids:
+                if model[c].get(oid, 0) > 0:
+                    model[c][oid] -= 1
+                    if model[c][oid] == 0:
+                        del model[c][oid]
+        else:
+            # client "disconnect": the GCS reclaims its whole ledger
+            with head.cv:
+                for oid, n in head.client_refs.pop(c, {}).items():
+                    head._decref(oid, n)
+            model[c] = {}
+        if step % 997 == 0:
+            # sampled invariant check on a random live oid
+            oid = rng.choice(live_oids)
+            meta = head.objects.get(oid)
+            expect = model_refcount(oid)
+            got = meta.refcount if meta is not None else 0
+            assert got == expect, (step, oid, got, expect)
+            live_oids = [o for o in live_oids
+                         if o in head.objects or model_refcount(o) > 0]
+
+    # final oracle sweep: exact match, and zero-count means deleted
+    for oid in set(live_oids):
+        expect = model_refcount(oid)
+        meta = head.objects.get(oid)
+        if expect == 0:
+            assert meta is None, \
+                f"{oid} leaked (model count 0, state " \
+                f"{getattr(meta, 'state', None)})"
+        else:
+            assert meta is not None and meta.refcount == expect, \
+                (oid, getattr(meta, "refcount", None), expect)
+
+
+# ------------------------------------------------------- lease / lineage
+
+class _FakeConn:
+    """Stub task conn: collects pushes the scheduler sends a worker."""
+
+    def __init__(self):
+        self.inbox = []
+
+    def send(self, msg):
+        self.inbox.append(msg)
+
+
+def _add_fake_worker(head, i):
+    wid = f"simworker{i:04d}"
+    w = gcs_mod.WorkerState(wid, head.head_node_id, 90000 + i)
+    w.state = "idle"
+    w.task_conn = _FakeConn()
+    head.workers[wid] = w
+    node = head.nodes[head.head_node_id]
+    node.workers.add(wid)
+    node.idle_workers.append(wid)
+    return w
+
+
+def test_lease_lineage_schedule_sim(ray_start_regular, monkeypatch):
+    head = ray_tpu._head
+    # the sim owns the worker pool: never fork real processes
+    monkeypatch.setattr(head, "_spawn_worker",
+                        lambda *a, **k: None)
+    rng = random.Random(77)
+    workers = [_add_fake_worker(head, i) for i in range(4)]
+    submitted = {}          # task_id -> spec
+    terminal_ok = set()
+    terminal_err = set()
+    next_id = [0]
+    iters = max(1000, STEPS // 50)
+
+    def submit(deps=()):
+        next_id[0] += 1
+        tid = f"simtask{next_id[0]:08d}"
+        ret = f"simret{next_id[0]:08d}"
+        spec = {"task_id": tid, "fn_id": "f", "name": "simtask",
+                "owner": "simdriver", "return_ids": [ret],
+                "num_returns": 1, "deps": list(deps), "borrows": [],
+                "num_cpus": 1, "num_tpus": 0, "resources": {},
+                "max_retries": rng.randint(0, 2),
+                "retry_exceptions": False, "scheduling_strategy": None,
+                "runtime_env": None, "args": [], "kwargs": {}}
+        submitted[tid] = dict(spec)
+        head._h_submit_task({"spec": spec, "client_id": "simdriver"})
+        return ret
+
+    recent_rets = []
+    for it in range(iters):
+        r = rng.random()
+        if r < 0.45:
+            deps = rng.sample(recent_rets, min(len(recent_rets),
+                                               rng.randint(0, 2)))
+            recent_rets.append(submit(deps))
+            recent_rets = recent_rets[-32:]
+        # drain: fake workers act on their dispatched tasks
+        for w in list(workers):
+            conn = w.task_conn
+            if not isinstance(conn, _FakeConn) or not conn.inbox:
+                continue
+            msg = conn.inbox.pop(0)
+            if msg.get("kind") != "execute_task":
+                continue
+            spec = msg["spec"]
+            roll = rng.random()
+            if roll < 0.75:  # completes
+                head._handle_worker_event(w.worker_id, {
+                    "kind": "task_done", "task_id": spec["task_id"],
+                    "status": "ok",
+                    "results": [{"loc": "inline", "data": b"r", "size": 1,
+                                 "contained": []}
+                                for _ in spec["return_ids"]]})
+                terminal_ok.add(spec["task_id"])
+            elif roll < 0.9:  # app error
+                from ray_tpu._private.serialization import serialize_to_bytes
+                err = ray_tpu.exceptions.RayTaskError("simtask", "boom")
+                head._handle_worker_event(w.worker_id, {
+                    "kind": "task_done", "task_id": spec["task_id"],
+                    "status": "app_error",
+                    "error": serialize_to_bytes(err)[0]})
+                terminal_err.add(spec["task_id"])
+            else:  # worker dies mid-task → retry or failure
+                with head.cv:
+                    head._handle_worker_death(w)
+                workers.remove(w)
+                next_id[0] += 1  # monotonic: two same-iteration deaths
+                # must not mint colliding worker ids
+                workers.append(_add_fake_worker(head, 1000 + next_id[0]))
+        if it % 7 == 0:
+            head._pump()
+
+    # drain everything still pending deterministically: complete all
+    for _ in range(20000):
+        head._pump()
+        moved = False
+        for w in list(workers):
+            conn = w.task_conn
+            while isinstance(conn, _FakeConn) and conn.inbox:
+                msg = conn.inbox.pop(0)
+                if msg.get("kind") != "execute_task":
+                    continue
+                spec = msg["spec"]
+                head._handle_worker_event(w.worker_id, {
+                    "kind": "task_done", "task_id": spec["task_id"],
+                    "status": "ok",
+                    "results": [{"loc": "inline", "data": b"r", "size": 1,
+                                 "contained": []}
+                                for _ in spec["return_ids"]]})
+                moved = True
+        if not moved and not head.pending_tasks and not head.running:
+            break
+
+    with head.lock:
+        # every return object terminal (sealed or error), nothing stuck
+        for tid, spec in submitted.items():
+            for ret in spec["return_ids"]:
+                meta = head.objects.get(ret)
+                assert meta is not None and meta.state in ("ready", "error"), \
+                    (tid, ret, getattr(meta, "state", None))
+        # no orphaned running entries; resources fully returned
+        sim_running = [t for t in head.running if t.startswith("simtask")]
+        assert not sim_running, sim_running
+        node = head.nodes[head.head_node_id]
+        for k, total in node.resources_total.items():
+            if k == "CPU":
+                # allow the real pool's own workers their headroom
+                assert node.resources_avail[k] >= total - 4.01
+
+
+
+def test_zombie_pending_meta_regression(ray_start_regular):
+    """The exact leak the fuzz found: put → disconnect (deleted) →
+    add_ref resurrects a PENDING meta → final release must DELETE it,
+    not strand it at refcount 0 forever."""
+    head = ray_tpu._head
+    oid = "zombieregression0000000000000001"
+    head._h_put_object({"client_id": "zc1", "object_id": oid,
+                        "loc": "inline", "data": b"x", "size": 1,
+                        "contained": []})
+    with head.cv:
+        for o, n in head.client_refs.pop("zc1", {}).items():
+            head._decref(o, n)
+    assert oid not in head.objects
+    head._h_add_ref({"client_id": "zc2", "object_id": oid})
+    assert head.objects[oid].state == "pending"
+    head._h_release({"client_id": "zc2", "object_id": oid})
+    assert oid not in head.objects, "zombie PENDING meta leaked"
