@@ -67,6 +67,12 @@ _FLAG_DEFS = [
     _flag("slab_object_max_bytes", 1024 * 1024,
           "Objects <= this go through the C++ slab store; larger ones get "
           "their own tmpfs segment (zero-copy mmap reads)."),
+    _flag("memory_usage_threshold", 0.95,
+          "Node memory fraction above which the memory monitor kills the "
+          "newest running task's worker (reference: MemoryMonitor OOM "
+          "killing; 1.0 disables)."),
+    _flag("memory_monitor_interval_s", 1.0,
+          "How often the memory monitor samples node usage."),
     _flag("gcs_snapshot", True,
           "Persist durable GCS tables (KV, functions, actors, placement "
           "groups) to <session>/gcs_state so a restarted head recovers "

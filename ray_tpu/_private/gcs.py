@@ -846,6 +846,7 @@ class GcsServer:
                     node.acquire(req)
                 spec["_req"] = req
                 spec["_node"] = node.node_id
+                spec["_started_at"] = time.monotonic()
                 worker.state = "busy"
                 worker.current_task = spec
                 self.running[spec["task_id"]] = (worker.worker_id, spec)
@@ -948,16 +949,25 @@ class GcsServer:
             self.running.pop(spec["task_id"], None)
             retries = spec.get("max_retries", GLOBAL_CONFIG.task_default_max_retries)
             attempts = spec.get("attempt", 0)
+            oom = spec.pop("_oom_killed", False)
             if not spec.get("is_actor_creation") and (retries < 0 or attempts < retries):
                 spec = dict(spec)
                 spec["attempt"] = attempts + 1
-                logger.info("retrying task %s (attempt %d)", spec["task_id"],
-                            spec["attempt"])
+                logger.info("retrying task %s (attempt %d)%s",
+                            spec["task_id"], spec["attempt"],
+                            " after OOM kill" if oom else "")
                 self.pending_tasks.append(spec)
             elif not spec.get("is_actor_creation"):
-                self._fail_task(spec, exc.WorkerCrashedError(
-                    f"worker {w.worker_id} (pid {w.pid}) died running "
-                    f"{spec.get('name', spec['task_id'])}"))
+                if oom:
+                    self._fail_task(spec, exc.OutOfMemoryError(
+                        f"task {spec.get('name', spec['task_id'])} killed "
+                        f"by the memory monitor: node memory usage "
+                        f"exceeded the configured threshold "
+                        f"(RTPU_MEMORY_USAGE_THRESHOLD)"))
+                else:
+                    self._fail_task(spec, exc.WorkerCrashedError(
+                        f"worker {w.worker_id} (pid {w.pid}) died running "
+                        f"{spec.get('name', spec['task_id'])}"))
         self.cv.notify_all()
 
     def _actor_worker_died(self, actor_id: str) -> None:
@@ -989,10 +999,13 @@ class GcsServer:
         self._persist_durable()
 
     def _monitor_loop(self) -> None:
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        mem_monitor = MemoryMonitor(self)
         last_pump = 0.0
         while not self._shutdown:
             time.sleep(0.1)
             self._restore_grace_check()
+            mem_monitor.maybe_kill(time.monotonic())
             # free rc-0-at-seal objects whose grace expired with no
             # add_refs having landed (see _seal_object)
             if self._graceful_free:

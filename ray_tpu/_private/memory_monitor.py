@@ -1,0 +1,117 @@
+"""Memory monitor: kill tasks under node memory pressure.
+
+Reference: ``MemoryMonitor`` (``src/ray/util/``, wired into the raylet —
+SURVEY.md §2.1 Util row): when a node's memory usage crosses a threshold,
+the worker running the most-recently-started retriable task is killed and
+the task fails with an OOM error that counts against ``max_retries`` —
+preferring a targeted, retriable kill over the kernel OOM killer taking
+out the raylet or an actor.
+
+Policy here (matching the reference's task-killing policy shape):
+- usage = used/total from cgroup v2 (``memory.current``/``memory.max``)
+  when限 bounded, else ``/proc/meminfo`` (MemTotal - MemAvailable).
+- above ``memory_usage_threshold`` → kill the LAST-STARTED running task's
+  worker (newest-first: it has made the least progress and is likeliest
+  part of the pressure spike); actors are never chosen (reference
+  behavior: workers running retriable work first).
+- the killed task is failed with ``OutOfMemoryError`` (retriable if the
+  task has retries left — at-least-once, like any worker death).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from ray_tpu._private import rtlog
+
+logger = rtlog.get("memory-monitor")
+
+_CGROUP = "/sys/fs/cgroup"
+
+
+def node_memory_usage() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) — cgroup v2 when memory-limited, else
+    system-wide from /proc/meminfo."""
+    try:
+        raw_max = open(os.path.join(_CGROUP, "memory.max")).read().strip()
+        if raw_max != "max":
+            used = int(open(os.path.join(_CGROUP,
+                                         "memory.current")).read())
+            return used, int(raw_max)
+    except (OSError, ValueError):
+        pass
+    total = avail = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+    except OSError:
+        return 0, 0
+    return max(0, total - avail), total
+
+
+class MemoryMonitor:
+    """Periodic check invoked from the GCS monitor loop."""
+
+    def __init__(self, gcs):
+        self.gcs = gcs
+        self._last_check = 0.0
+        self.kills = 0
+
+    def maybe_kill(self, now: float) -> None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        threshold = GLOBAL_CONFIG.memory_usage_threshold
+        if threshold >= 1.0 or threshold <= 0:
+            return  # disabled
+        if now - self._last_check < GLOBAL_CONFIG.memory_monitor_interval_s:
+            return
+        self._last_check = now
+        used, total = node_memory_usage()
+        if not total or used / total < threshold:
+            return
+        victim = self._pick_victim()
+        if victim is None:
+            logger.warning(
+                "memory pressure %.0f%% above threshold %.0f%% but no "
+                "killable task worker (actors are exempt)",
+                100 * used / total, 100 * threshold)
+            return
+        w, spec = victim
+        logger.warning(
+            "node memory %.0f%% >= %.0f%%: killing newest task %s "
+            "(worker %s pid=%s) — reference MemoryMonitor policy",
+            100 * used / total, 100 * threshold,
+            spec.get("name", spec["task_id"]), w.worker_id[:8], w.pid)
+        self.kills += 1
+        spec["_oom_killed"] = True
+        try:
+            if w.proc is not None:
+                w.proc.kill()
+            elif w.pid:
+                os.kill(w.pid, 9)
+        except OSError:
+            pass
+        # death handling (retry bookkeeping, resource release, respawn)
+        # rides the normal worker-death path via the monitor loop
+
+    def _pick_victim(self):
+        """Newest-started plain task (never actors, never the driver)."""
+        with self.gcs.lock:
+            candidates = []
+            for w in self.gcs.workers.values():
+                if w.state != "busy" or w.current_task is None:
+                    continue
+                spec = w.current_task
+                if spec.get("is_actor_creation"):
+                    continue
+                candidates.append((spec.get("_started_at", 0.0), w, spec))
+            if not candidates:
+                return None
+            candidates.sort(key=lambda c: c[0])
+            _, w, spec = candidates[-1]
+            return w, spec

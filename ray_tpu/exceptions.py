@@ -103,6 +103,11 @@ class PlacementGroupSchedulingError(RayTpuError):
     """Placement group bundles cannot be satisfied by the cluster."""
 
 
+class OutOfMemoryError(RayTpuError):
+    """Task killed by the memory monitor under node memory pressure
+    (reference: ray.exceptions.OutOfMemoryError)."""
+
+
 class RaySystemError(RayTpuError):
     """Internal control-plane failure."""
 

@@ -1,0 +1,133 @@
+"""Memory monitor (reference: MemoryMonitor OOM killing, SURVEY.md §2.1
+Util row): under node memory pressure the newest running task's worker is
+killed and the task fails with a retriable OutOfMemoryError."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import MemoryMonitor, node_memory_usage
+
+
+def test_node_memory_usage_sane():
+    used, total = node_memory_usage()
+    assert total > 0 and 0 <= used <= total
+
+
+def test_victim_policy_prefers_newest_task_never_actors(ray_start_regular):
+    head = ray_tpu._head
+    mon = MemoryMonitor(head)
+
+    @ray_tpu.remote
+    class A:
+        def spin(self):
+            time.sleep(5)
+            return 1
+
+    @ray_tpu.remote
+    def slow(tag):
+        time.sleep(5)
+        return tag
+
+    a = A.remote()
+    spin_ref = a.spin.remote()
+    r1 = slow.remote("old")
+    time.sleep(0.4)
+    r2 = slow.remote("new")
+    deadline = time.time() + 30
+    victim = None
+    while time.time() < deadline:
+        victim = mon._pick_victim()
+        if victim is not None and len([
+                w for w in head.workers.values()
+                if w.state == "busy"]) >= 2:
+            break
+        time.sleep(0.1)
+    assert victim is not None
+    w, spec = victim
+    assert not spec.get("is_actor_creation")
+    # newest-started plain task picked; actor untouched
+    assert spec.get("name") == "slow"
+    del spin_ref, r1, r2, a
+
+
+def test_oom_kill_fails_task_with_retriable_error(ray_start_regular):
+    """Force the threshold to 0 → the monitor kills the running task; with
+    max_retries=0 the caller sees OutOfMemoryError."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    @ray_tpu.remote(max_retries=0)
+    def hog():
+        time.sleep(30)
+        return 1
+
+    ref = hog.remote()
+    # wait until it is actually running, then drop the threshold
+    head = ray_tpu._head
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with head.lock:
+            if any(w.state == "busy" and w.current_task is not None
+                   and w.current_task.get("name") == "hog"
+                   for w in head.workers.values()):
+                break
+        time.sleep(0.1)
+    old = GLOBAL_CONFIG.memory_usage_threshold
+    GLOBAL_CONFIG.apply_system_config({"memory_usage_threshold": 0.0001,
+                                       "memory_monitor_interval_s": 0.1})
+    try:
+        with pytest.raises(ray_tpu.exceptions.OutOfMemoryError):
+            ray_tpu.get(ref, timeout=60)
+    finally:
+        GLOBAL_CONFIG.apply_system_config({"memory_usage_threshold": old,
+                                           "memory_monitor_interval_s": 1.0})
+
+
+def test_oom_kill_retries_when_budget_allows(ray_start_regular):
+    """An OOM-killed task with retries left is rescheduled (at-least-once,
+    same contract as any worker death)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    import os
+
+    marker = f"/tmp/rtpu_oom_retry_{os.getpid()}"
+
+    @ray_tpu.remote(max_retries=2)
+    def once():
+        import pathlib
+        p = pathlib.Path(marker)
+        if not p.exists():
+            p.write_text("1")
+            time.sleep(30)   # first attempt: hang until OOM-killed
+        return "retried"
+
+    ref = once.remote()
+    deadline = time.time() + 30
+    head = ray_tpu._head
+    while time.time() < deadline:
+        with head.lock:
+            if any(w.state == "busy" and w.current_task is not None
+                   and w.current_task.get("name") == "once"
+                   for w in head.workers.values()):
+                break
+        time.sleep(0.1)
+    time.sleep(0.5)  # let the first attempt write its marker
+    GLOBAL_CONFIG.apply_system_config({"memory_usage_threshold": 0.0001,
+                                       "memory_monitor_interval_s": 0.1})
+    try:
+        # restore the threshold once the kill has happened so the retry
+        # itself isn't killed
+        killed = False
+        deadline = time.time() + 30
+        while time.time() < deadline and not killed:
+            time.sleep(0.2)
+            import pathlib
+            killed = pathlib.Path(marker).exists()
+        GLOBAL_CONFIG.apply_system_config({"memory_usage_threshold": 1.0})
+        assert ray_tpu.get(ref, timeout=60) == "retried"
+    finally:
+        GLOBAL_CONFIG.apply_system_config({"memory_usage_threshold": 1.0,
+                                           "memory_monitor_interval_s": 1.0})
+        import pathlib
+        pathlib.Path(marker).unlink(missing_ok=True)
