@@ -46,29 +46,65 @@ def write_spool(spool_dir: str, object_id: str, wire) -> int:
     store capacity — the replaced head-upload path enforced the head
     store's bound; an unbounded spool on a tmpfs-backed /tmp would OOM
     the host with no backpressure).  The scan is O(spooled files);
-    spooled objects are large, so counts stay small."""
+    spooled objects are large, so counts stay small.
+
+    Admission (scan + reservation) runs under a per-spool flock so N
+    concurrent producers can't each pass the check and collectively
+    overshoot the capacity; the reservation is an ftruncate of the .tmp
+    file to full size, which later scanners count, so the bulk data copy
+    itself happens outside the lock."""
+    import fcntl
+
     size = len(wire)
     cap = spool_capacity_bytes()
-    used = 0
-    try:
-        with os.scandir(spool_dir) as it:
-            for e in it:
-                try:
-                    used += e.stat().st_size
-                except OSError:
-                    pass
-    except OSError:
-        pass
-    if used + size > cap:
-        from ray_tpu.exceptions import ObjectStoreFullError
-        raise ObjectStoreFullError(
-            f"host spool full: {used + size} > {cap} bytes "
-            f"(RTPU_SPOOL_CAPACITY_MB to raise)")
     path = spool_path(spool_dir, object_id)
     tmp = path.with_suffix(".tmp")
-    with open(tmp, "wb") as f:
+    with open(Path(spool_dir) / ".admission.lock", "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        used = 0
+        import time as _time
+        now = _time.time()
+        try:
+            with os.scandir(spool_dir) as it:
+                for e in it:
+                    if e.name == ".admission.lock":
+                        continue
+                    try:
+                        st = e.stat()
+                        if e.name.endswith(".tmp") and \
+                                now - st.st_mtime > 300:
+                            # orphaned reservation: a writer SIGKILLed
+                            # mid-write (e.g. by the per-node OOM killer)
+                            # never runs its cleanup — sweep it here or it
+                            # counts against capacity forever
+                            os.unlink(e.path)
+                            continue
+                        used += st.st_size
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        if used + size > cap:
+            from ray_tpu.exceptions import ObjectStoreFullError
+            raise ObjectStoreFullError(
+                f"host spool full: {used + size} > {cap} bytes "
+                f"(RTPU_SPOOL_CAPACITY_MB to raise)")
+        f = open(tmp, "wb")
+        try:
+            f.truncate(size)  # reserve while still under the lock
+        except OSError:
+            pass
+    try:
         f.write(wire)
-    os.replace(tmp, path)
+        f.close()
+        os.replace(tmp, path)
+    except BaseException:
+        f.close()
+        try:
+            os.unlink(tmp)  # failed write must not hold its reservation
+        except OSError:
+            pass
+        raise
     return size
 
 
@@ -189,15 +225,37 @@ def pull_from_peer(open_conn, addr: str, object_id: str) -> bytearray:
 
 def delete_on_peer(addr: str, object_id: str) -> None:
     """Best-effort spool delete on the holder (refcount reached zero)."""
+    delete_batch_on_peer(addr, [object_id])
+
+
+def delete_batch_on_peer(addr: str, object_ids) -> None:
+    """Best-effort spool delete of many objects over ONE connection —
+    bulk releases (driver exit, 64-wide release batches) must not pay a
+    TCP connect per object.  A mid-batch hiccup drops only that object's
+    delete and reconnects for the rest (narrower blast radius than
+    aborting the batch); an unreachable peer gives up immediately."""
     tcp = protocol.parse_tcp_addr(addr)
-    if tcp is None:
+    if tcp is None or not object_ids:
         return
+    conn = None
     try:
-        conn = protocol.connect_tcp(*tcp, timeout=3.0)
-        try:
-            conn.send({"op": "delete_object", "object_id": object_id})
-            conn.recv()
-        finally:
-            conn.close()
-    except (OSError, EOFError, ConnectionError):
-        pass
+        for oid in object_ids:
+            try:
+                if conn is None:
+                    conn = protocol.connect_tcp(*tcp, timeout=3.0)
+                conn.send({"op": "delete_object", "object_id": oid})
+                conn.recv()
+            except (OSError, EOFError, ConnectionError):
+                if conn is None:
+                    return  # connect itself failed: peer unreachable
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = None  # reconnect for the remaining objects
+    finally:
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -32,7 +32,7 @@ import subprocess
 import sys
 import threading
 import time
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import protocol, rtlog
@@ -205,10 +205,24 @@ class GcsServer:
         self._staging: Dict[str, dict] = {}   # in-flight chunked uploads
         self._remote_pulls: Dict[str, threading.Event] = {}  # relay dedup
         self._graceful_free: Dict[str, float] = {}  # rc-0-at-seal grace
+        # reply cache for client-supplied request ids: makes the worker's
+        # one post-reconnect retry exactly-once against a still-live GCS
+        # (non-idempotent mutations must not double-apply when only the
+        # channel broke, not the server)
+        self._dedup_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._dedup_pending: Dict[tuple, threading.Event] = {}
+        self._dedup_lock = threading.Lock()
+        # remote-spool deletions, batched per holder node (see _decref);
+        # the drain thread starts below, after _shutdown exists
+        self._peer_delete_q: Dict[str, List[str]] = defaultdict(list)
+        self._peer_delete_lock = threading.Lock()
+        self._peer_delete_event = threading.Event()
         self.driver_ids: Set[str] = set()
         self.log_sink = None                              # callable(line)
         self._shutdown = False
         self._spawn_counter = 0
+        threading.Thread(target=self._peer_delete_loop, daemon=True,
+                         name="gcs-peer-delete").start()
 
         self.head_node_id = NodeID.new()
         self.add_node_internal(self.head_node_id, head_resources, is_head=True)
@@ -537,11 +551,44 @@ class GcsServer:
             elif meta.loc == "remote":
                 node = self.nodes.get(meta.node_id)
                 if node is not None and node.data_addr:
-                    from ray_tpu._private.data_plane import delete_on_peer
-                    threading.Thread(
-                        target=delete_on_peer,
-                        args=(node.data_addr, oid), daemon=True).start()
+                    # batched per holder on one background worker: a bulk
+                    # release of N remote objects must not fork N threads
+                    # each paying a TCP connect (mirrors the debounced
+                    # snapshot writer's shape)
+                    with self._peer_delete_lock:
+                        self._peer_delete_q[node.data_addr].append(oid)
+                    self._peer_delete_event.set()
             del self.objects[oid]
+
+    def _peer_delete_loop(self) -> None:
+        """Drain queued remote-spool deletions, one connection per holder
+        per drain (reference: ObjectManager frees remote copies without a
+        per-object connection storm).  Holders drain concurrently so one
+        dead/unreachable host's 3s connect timeout can't head-of-line
+        block frees on healthy nodes; batches for addresses no live node
+        advertises are dropped (the agent's shutdown rmtree already freed
+        that spool)."""
+        from ray_tpu._private.data_plane import delete_batch_on_peer
+        while not self._shutdown:
+            self._peer_delete_event.wait(1.0)
+            if self._shutdown:
+                return
+            self._peer_delete_event.clear()
+            with self._peer_delete_lock:
+                if not self._peer_delete_q:
+                    continue
+                batches = dict(self._peer_delete_q)
+                self._peer_delete_q.clear()
+            with self.lock:
+                live = {n.data_addr for n in self.nodes.values()
+                        if n.alive and n.data_addr}
+            threads = [threading.Thread(target=delete_batch_on_peer,
+                                        args=(addr, oids), daemon=True)
+                       for addr, oids in batches.items() if addr in live]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10.0)
 
     # ------------------------------------------------------------- scheduling
     def _task_resources(self, spec: dict) -> Dict[str, float]:
@@ -1080,25 +1127,77 @@ class GcsServer:
                 if kind == "agent_attach":
                     self._attach_agent_conn(msg["node_id"], conn)
                     return  # thread parks until the agent disconnects
+                if client_id is None and "client_id" in msg:
+                    client_id = msg["client_id"]
+                dedup = msg.get("_dedup")
+                key = (msg.get("client_id"), dedup) if dedup else None
+                if key is not None:
+                    replay = self._dedup_begin(key)
+                    if replay is not None:
+                        # retry of an already-applied mutation (channel
+                        # broke after apply, before the reply): replay the
+                        # recorded reply, don't double-apply
+                        if rid is not None:
+                            try:
+                                conn.send({"rid": rid, **replay})
+                            except (OSError, ValueError):
+                                break
+                        continue
+                reply = None
                 try:
-                    if client_id is None and "client_id" in msg:
-                        client_id = msg["client_id"]
                     resp = self._dispatch(kind, msg)
-                    if rid is not None:
-                        conn.send({"rid": rid, "error": None, **(resp or {})})
+                    reply = {"error": None, **(resp or {})}
                 except Exception as e:  # noqa: BLE001 - report to caller
-                    if rid is not None:
-                        try:
-                            conn.send({"rid": rid, "error": dumps_call(e)})
-                        except (OSError, ValueError):
-                            break
-                    else:
+                    try:
+                        reply = {"error": dumps_call(e)}
+                    except Exception:  # noqa: BLE001 - unpicklable error
+                        reply = {"error": dumps_call(
+                            exc.RaySystemError(repr(e)))}
+                    if rid is None:
                         logger.exception("one-way rpc %s failed", kind)
+                finally:
+                    if key is not None:
+                        self._dedup_commit(key, reply)
+                if rid is not None:
+                    try:
+                        conn.send({"rid": rid, **reply})
+                    except (OSError, ValueError):
+                        break
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _dedup_begin(self, key) -> Optional[dict]:
+        """Returns the recorded reply for a retried mutation, or None when
+        this thread should apply it.  The pending marker makes lookup
+        atomic with apply: a retry arriving while the original dispatch is
+        still blocked (e.g. on gcs.lock) must WAIT for its outcome, not
+        miss the cache and double-apply."""
+        while True:
+            with self._dedup_lock:
+                cached = self._dedup_cache.get(key)
+                if cached is not None:
+                    return cached
+                ev = self._dedup_pending.get(key)
+                if ev is None:
+                    self._dedup_pending[key] = threading.Event()
+                    return None
+            if not ev.wait(30.0):
+                # original thread wedged: degrade to at-least-once rather
+                # than hanging the retry forever
+                return None
+
+    def _dedup_commit(self, key, reply: Optional[dict]) -> None:
+        with self._dedup_lock:
+            if reply is not None:
+                self._dedup_cache[key] = reply
+                while len(self._dedup_cache) > 8192:
+                    self._dedup_cache.popitem(last=False)
+            ev = self._dedup_pending.pop(key, None)
+        if ev is not None:
+            ev.set()
 
     def _attach_agent_conn(self, node_id: str, conn) -> None:
         """Park on the NodeAgent's control connection; its EOF means the
@@ -1132,24 +1231,34 @@ class GcsServer:
                 if node_id not in self.nodes:
                     node_id = self.head_node_id
                 w = WorkerState(worker_id, node_id, reattach.get("pid", 0))
-                w.tpu_capable = bool(reattach.get("tpu"))
-                if reattach.get("actor_id"):
-                    # actor worker: its main thread sits in serve_forever —
-                    # it must never enter the idle pool or the scheduler
-                    # would dispatch plain tasks that can't run.  The
-                    # follow-up actor_ready(reattach) event completes the
-                    # actor linkage (addr, resources, ALIVE).
-                    w.state = "actor"
-                    w.actor_id = reattach["actor_id"]
                 self.workers[worker_id] = w
                 node = self.nodes.get(node_id)
                 if node is not None:
                     node.workers.add(worker_id)
-                logger.info("worker %s reattached after GCS restart",
-                            worker_id[:8])
             if w is None:
                 conn.close()
                 return
+            if reattach is not None:
+                # The WorkerState usually ALREADY exists here: the worker's
+                # _reconnect_pool() re-registered it (state "starting")
+                # before this attach arrived.  Apply the reattach metadata
+                # unconditionally — before the starting→idle transition
+                # below — or an actor worker would be marked idle and the
+                # scheduler would dispatch a plain task into a process
+                # blocked in serve_forever (and tpu_capable would be lost).
+                w.tpu_capable = w.tpu_capable or bool(reattach.get("tpu"))
+                if reattach.get("actor_id"):
+                    # actor worker: its main thread sits in serve_forever —
+                    # it must never enter the idle pool.  The follow-up
+                    # actor_ready(reattach) event completes the actor
+                    # linkage (addr, resources, ALIVE).
+                    w.state = "actor"
+                    w.actor_id = reattach["actor_id"]
+                    node = self.nodes.get(w.node_id)
+                    if node is not None and worker_id in node.idle_workers:
+                        node.idle_workers.remove(worker_id)
+                logger.info("worker %s reattached after GCS restart",
+                            worker_id[:8])
             w.task_conn = conn
             if w.state == "starting":
                 w.state = "idle"
@@ -2002,6 +2111,38 @@ class GcsServer:
     def _h_remove_node(self, msg: dict) -> dict:
         self.remove_node_internal(msg["node_id"])
         return {}
+
+    def _h_pick_oom_victim(self, msg: dict) -> dict:
+        """A NodeAgent reports local memory pressure; the head picks the
+        newest plain-task worker ON THAT NODE (policy stays central, the
+        kill stays local to the pid's own namespace — reference: per-node
+        MemoryMonitor inside the raylet).  The task is NOT marked here:
+        the agent verifies the pid is one it owns and still alive, then
+        calls confirm_oom_kill immediately before killing — a skipped kill
+        (stale head view, already-exited proc) must not mislabel a later
+        unrelated death as OOM."""
+        from ray_tpu._private.memory_monitor import pick_oom_victim
+        victim = pick_oom_victim(self, node_id=msg["node_id"])
+        if victim is None:
+            return {"pid": None, "worker_id": None}
+        w, spec = victim
+        logger.warning(
+            "node %s reports memory pressure (%.0f%%): designating newest "
+            "task %s (worker %s pid=%s) for OOM kill",
+            msg["node_id"][:8], 100 * msg.get("frac", 0),
+            spec.get("name", spec["task_id"]), w.worker_id[:8], w.pid)
+        return {"pid": w.pid, "worker_id": w.worker_id}
+
+    def _h_confirm_oom_kill(self, msg: dict) -> dict:
+        """The agent is about to kill this pid: mark the worker's current
+        task so its death surfaces as a retriable OutOfMemoryError."""
+        with self.lock:
+            w = self.workers.get(msg["worker_id"])
+            if w is not None and w.pid == msg["pid"] \
+                    and w.current_task is not None:
+                w.current_task["_oom_killed"] = True
+                return {"ok": True}
+        return {"ok": False}
 
     def _h_cluster_resources(self, msg: dict) -> dict:
         with self.lock:

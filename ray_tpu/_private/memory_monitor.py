@@ -54,8 +54,43 @@ def node_memory_usage() -> Tuple[int, int]:
     return max(0, total - avail), total
 
 
+def pick_oom_victim(gcs, node_id=None, require_proc=False):
+    """Newest-started plain task worker (never actors, never the driver),
+    optionally restricted to one node / to head-spawned (proc-backed)
+    workers.  Shared by the head-local monitor and the per-node agent
+    path (reference: MemoryMonitor runs per-node inside the raylet)."""
+    with gcs.lock:
+        candidates = []
+        for w in gcs.workers.values():
+            if w.state != "busy" or w.current_task is None:
+                continue
+            if node_id is not None and w.node_id != node_id:
+                continue
+            if require_proc and w.proc is None:
+                continue
+            spec = w.current_task
+            if spec.get("is_actor_creation"):
+                continue
+            candidates.append((spec.get("_started_at", 0.0), w, spec))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: c[0])
+        _, w, spec = candidates[-1]
+        return w, spec
+
+
 class MemoryMonitor:
-    """Periodic check invoked from the GCS monitor loop."""
+    """Periodic check invoked from the GCS monitor loop.
+
+    Scope: the HEAD machine only.  The usage signal below is read from
+    this host's cgroup//proc/meminfo, so only workers the head itself
+    spawned (``w.proc is not None``) are eligible victims — a proc-less
+    WorkerState can belong to a remote NodeAgent whose pid lives in
+    another host's pid namespace; ``os.kill`` on it from here would hit
+    an arbitrary unrelated local process.  Remote hosts run their own
+    monitor inside the NodeAgent (node_agent.py), which measures local
+    pressure and kills pids it owns, with victim policy still decided
+    here via the ``pick_oom_victim`` RPC."""
 
     def __init__(self, gcs):
         self.gcs = gcs
@@ -74,11 +109,12 @@ class MemoryMonitor:
         used, total = node_memory_usage()
         if not total or used / total < threshold:
             return
-        victim = self._pick_victim()
+        victim = pick_oom_victim(self.gcs, require_proc=True)
         if victim is None:
             logger.warning(
                 "memory pressure %.0f%% above threshold %.0f%% but no "
-                "killable task worker (actors are exempt)",
+                "killable head-spawned task worker (actors are exempt; "
+                "remote workers are their agent's responsibility)",
                 100 * used / total, 100 * threshold)
             return
         w, spec = victim
@@ -90,28 +126,12 @@ class MemoryMonitor:
         self.kills += 1
         spec["_oom_killed"] = True
         try:
-            if w.proc is not None:
-                w.proc.kill()
-            elif w.pid:
-                os.kill(w.pid, 9)
+            w.proc.kill()
         except OSError:
             pass
         # death handling (retry bookkeeping, resource release, respawn)
         # rides the normal worker-death path via the monitor loop
 
     def _pick_victim(self):
-        """Newest-started plain task (never actors, never the driver)."""
-        with self.gcs.lock:
-            candidates = []
-            for w in self.gcs.workers.values():
-                if w.state != "busy" or w.current_task is None:
-                    continue
-                spec = w.current_task
-                if spec.get("is_actor_creation"):
-                    continue
-                candidates.append((spec.get("_started_at", 0.0), w, spec))
-            if not candidates:
-                return None
-            candidates.sort(key=lambda c: c[0])
-            _, w, spec = candidates[-1]
-            return w, spec
+        """Back-compat shim for tests: head-side victim policy."""
+        return pick_oom_victim(self.gcs, require_proc=True)

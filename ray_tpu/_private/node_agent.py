@@ -69,8 +69,67 @@ class NodeAgent:
         # keep an orphaned pool running, silently detached
         threading.Thread(target=self._liveness_watch, daemon=True,
                          name="agent-liveness").start()
+        # per-node OOM killer (reference: MemoryMonitor runs inside each
+        # raylet): THIS host's pressure, THIS host's pids.  Victim policy
+        # stays with the head (pick_oom_victim RPC) which pre-marks the
+        # task so the death surfaces as a retriable OutOfMemoryError.
+        threading.Thread(target=self._memory_watch, daemon=True,
+                         name="agent-memory-monitor").start()
         logger.info("joined head %s:%s as node %s (%d workers)",
                     head_host, head_port, self.node_id[:8], self.num_workers)
+
+    def _memory_watch(self) -> None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.memory_monitor import node_memory_usage
+        while not self._stop.is_set():
+            self._stop.wait(max(GLOBAL_CONFIG.memory_monitor_interval_s, 0.1))
+            threshold = GLOBAL_CONFIG.memory_usage_threshold
+            if threshold >= 1.0 or threshold <= 0:
+                continue
+            used, total = node_memory_usage()
+            if not total or used / total < threshold:
+                continue
+            # catch broadly: RpcChannel.call re-raises arbitrary
+            # deserialized server-side exceptions, and this daemon thread
+            # dying would silently strip the node of OOM protection
+            ch = None
+            try:
+                ch = protocol.RpcChannel(
+                    protocol.tunnel_connect(*self.head, "gcs"))
+                resp = ch.call("pick_oom_victim", node_id=self.node_id,
+                               frac=used / total)
+                pid = resp.get("pid")
+                # only kill pids of processes THIS agent spawned — the
+                # head's view may be stale, and a recycled pid must never
+                # be signaled
+                for p in self._procs:
+                    if pid and p.pid == pid and p.poll() is None:
+                        logger.warning(
+                            "memory %.0f%% >= %.0f%%: OOM-killing worker "
+                            "pid=%d", 100 * used / total,
+                            100 * threshold, pid)
+                        try:
+                            # confirm first: the head marks the task as
+                            # OOM-killed only when the kill actually
+                            # happens (a skipped kill must not mislabel a
+                            # later unrelated death)
+                            ch.call("confirm_oom_kill", pid=pid,
+                                    worker_id=resp.get("worker_id"))
+                        except Exception:  # noqa: BLE001
+                            pass
+                        try:
+                            p.kill()
+                        except OSError:
+                            pass
+                        break
+            except Exception:  # noqa: BLE001 - keep the monitor alive
+                logger.exception("memory watch pass failed")
+            finally:
+                if ch is not None:
+                    try:
+                        ch.close()
+                    except OSError:
+                        pass
 
     def _liveness_watch(self) -> None:
         try:

@@ -22,6 +22,7 @@ import sys
 import threading
 import time
 import traceback
+import uuid
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -211,15 +212,30 @@ class Worker:
             ch.call("register_client", role=self.role, client_id=self.worker_id,
                     pid=os.getpid(), node_id=self.node_id)
 
+    # Two-way RPC kinds that MUTATE server state: these carry a _dedup id
+    # so the one post-reconnect retry is exactly-once against a still-live
+    # GCS (channel broke after apply, before the reply).  Reads are
+    # idempotent and excluded — caching their replies would pin bulk data
+    # (fetch_chunk carries multi-MB payloads) on the head for no benefit.
+    # One-way mutations (submit_task/add_refs/release*) are never retried
+    # by this path and need no dedup.
+    _DEDUP_KINDS = frozenset({
+        "put_object", "put_chunk", "create_actor", "kill_actor",
+        "export_function", "seal_errors", "kv_put", "kv_del",
+        "pg_create", "pg_remove", "add_node", "remove_node"})
+
     def rpc(self, kind: str, **fields: Any) -> dict:
+        # Across a true GCS restart the dedup cache is empty and the retry
+        # re-applies — the documented at-least-once contract for head
+        # fault tolerance (fresh object table).
+        if kind in self._DEDUP_KINDS:
+            fields["_dedup"] = uuid.uuid4().hex
         try:
             return self.pool.call(kind, client_id=self.worker_id, **fields)
         except (EOFError, OSError, ConnectionError):
             # GCS conn lost (head crash/restart).  Reconnect with grace and
-            # re-issue ONCE: GCS fault tolerance is at-least-once for
-            # control-plane ops, the same contract worker-death retries
-            # already impose on tasks (reference: retryable gRPC clients +
-            # raylets reconnecting to a restarted GCS).
+            # re-issue ONCE (reference: retryable gRPC clients + raylets
+            # reconnecting to a restarted GCS).
             if self.is_client or self._stop.is_set():
                 raise
             self._reconnect_pool()

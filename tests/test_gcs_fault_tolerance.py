@@ -134,6 +134,17 @@ def test_gcs_restart_preserves_actors_pgs_and_objects():
                 return x * 2
 
             assert ray_tpu.get(f.remote(21), timeout=60) == 42
+
+            # regression (r2 advisor): the reattach metadata must be
+            # applied even though register_client already recreated the
+            # WorkerState — the reattached ACTOR worker must be in state
+            # "actor" (its main thread sits in serve_forever), never
+            # "idle", or the scheduler would dispatch a plain task into
+            # it that hangs forever
+            workers = state._rpc("list_workers")["workers"]
+            actor_workers = [w for w in workers if w["actor_id"]]
+            assert actor_workers, workers
+            assert all(w["state"] == "actor" for w in actor_workers), workers
         finally:
             head2.kill()
             head2.wait(timeout=10)
