@@ -120,8 +120,13 @@ def main() -> None:
     if on_tpu:
         import dataclasses
         # flash (Pallas, block=512 via pick_block_size) beats XLA dense by
-        # ~31% at this config on v5e: 347 vs 502 ms/step (r2 sweep).
-        cfg = dataclasses.replace(gpt2.gpt2_small(), attn_impl="flash")
+        # ~35% at this config on v5e.  remat_policy="attn_qkv" pins the
+        # flash out/lse residuals + the qkv projection across the remat
+        # boundary — the backward re-runs neither the attention kernel nor
+        # the qkv matmul (r3 device-trace work; full decomposition in
+        # benchmarks/results/step_breakdown_r03.md).
+        cfg = dataclasses.replace(gpt2.gpt2_small(), attn_impl="flash",
+                                  remat_policy="attn_qkv")
         batch, seq, steps = 32, 1024, 20
     else:  # CI smoke: tiny model so the bench contract stays testable
         cfg = gpt2.tiny(vocab=512, seq=128)

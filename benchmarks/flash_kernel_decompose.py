@@ -1,0 +1,82 @@
+"""Time individual flash-attention kernels at the flagship bench shape.
+
+The step decomposition (step_decompose.py probe_attn_identity) shows
+attention costs ~40% of the train step while carrying ~13% of its FLOPs;
+this isolates which kernel (fwd, bwd-dq, bwd-dkv) and which block size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+
+def timeit(jax, fn, *args, iters=20):
+    import jax.numpy as jnp
+    out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jax.device_get(jnp.ravel(leaf)[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jax.device_get(jnp.ravel(leaf)[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--blocks", default="256,512,1024")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import importlib
+    fa = importlib.import_module("ray_tpu.ops.flash_attention")
+
+    B, H, T, D = args.batch, args.heads, args.seq, args.dim
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+    k = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+    v = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+    g = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+
+    for bs in [int(b) for b in args.blocks.split(",")]:
+        if T % bs:
+            continue
+        fwd_nolse = jax.jit(functools.partial(
+            fa._flash_forward_lse, causal=True, block_size=bs,
+            interpret=False, want_lse=False))
+        fwd_lse = jax.jit(functools.partial(
+            fa._flash_forward_lse, causal=True, block_size=bs,
+            interpret=False, want_lse=True))
+
+        def bwd(q, k, v, out, lse, g, bs=bs):
+            return fa._flash_backward(q, k, v, out, lse, g, causal=True,
+                                      block_size=bs, interpret=False)
+
+        out, lse = fwd_lse(q, k, v)
+        bwd_j = jax.jit(bwd)
+        ms_fwd = timeit(jax, fwd_nolse, q, k, v)
+        ms_fwd_lse = timeit(jax, fwd_lse, q, k, v)
+        ms_bwd = timeit(jax, bwd_j, q, k, v, out, lse, g)
+        print(json.dumps({
+            "block": bs,
+            "fwd_ms": round(ms_fwd, 2),
+            "fwd_lse_ms": round(ms_fwd_lse, 2),
+            "bwd_ms": round(ms_bwd, 2),
+            "per_step_x12_ms": round(12 * (ms_fwd_lse + ms_bwd), 1),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,8 +57,13 @@ def run_variant(name: str, cfg, batch: int, seq: int, steps: int):
               flush=True)
         return
     tok_s = batch * seq / step_s
+    fpt = gpt2.flops_per_token(cfg, seq)
+    import bench as bench_mod
+    peak = bench_mod._platform_peak(dev) * 1e12
     print(json.dumps({"variant": name, "step_ms": round(step_s * 1e3, 2),
                       "tokens_per_s": round(tok_s, 1),
+                      "model_tflops": round(tok_s * fpt / 1e12, 1),
+                      "mfu": round(tok_s * fpt / peak, 4),
                       "compile_s": round(compile_s, 1),
                       "loss": round(loss, 4)}), flush=True)
     del state, prog
@@ -71,11 +76,13 @@ def main():
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--only", type=str, default=None,
                     help="comma-separated variant names")
+    ap.add_argument("--model", default="gpt2",
+                    help="preset name (gpt2|gpt2-medium|gpt2-large|...)")
     args = ap.parse_args()
 
     from ray_tpu.models import gpt2
 
-    base = gpt2.gpt2_small()
+    base = gpt2.PRESETS[args.model]()
 
     def mk(**kw):
         return gpt2.GPT2Config(**{**base.__dict__, **kw})
@@ -84,9 +91,13 @@ def main():
         "dense_full": mk(),
         "dense_dots": mk(remat_policy="dots"),
         "flash_full": mk(attn_impl="flash"),
+        "flash_attn": mk(attn_impl="flash", remat_policy="attn"),
+        "flash_attn_qkv": mk(attn_impl="flash", remat_policy="attn_qkv"),
         "flash_dots": mk(attn_impl="flash", remat_policy="dots"),
         "dense_dots_ce8": mk(remat_policy="dots", loss_chunks=8),
         "flash_dots_ce8": mk(attn_impl="flash", remat_policy="dots",
+                             loss_chunks=8),
+        "flash_attn_ce8": mk(attn_impl="flash", remat_policy="attn",
                              loss_chunks=8),
         "dense_full_ce8": mk(loss_chunks=8),
         "dense_noremat_ce8": mk(remat=False, loss_chunks=8),

@@ -573,22 +573,27 @@ class GcsServer:
             self._peer_delete_event.wait(1.0)
             if self._shutdown:
                 return
-            self._peer_delete_event.clear()
-            with self._peer_delete_lock:
-                if not self._peer_delete_q:
-                    continue
-                batches = dict(self._peer_delete_q)
-                self._peer_delete_q.clear()
-            with self.lock:
-                live = {n.data_addr for n in self.nodes.values()
-                        if n.alive and n.data_addr}
-            threads = [threading.Thread(target=delete_batch_on_peer,
-                                        args=(addr, oids), daemon=True)
-                       for addr, oids in batches.items() if addr in live]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(10.0)
+            try:
+                self._peer_delete_event.clear()
+                with self._peer_delete_lock:
+                    if not self._peer_delete_q:
+                        continue
+                    batches = dict(self._peer_delete_q)
+                    self._peer_delete_q.clear()
+                with self.lock:
+                    live = {n.data_addr for n in self.nodes.values()
+                            if n.alive and n.data_addr}
+                threads = [threading.Thread(target=delete_batch_on_peer,
+                                            args=(addr, oids), daemon=True)
+                           for addr, oids in batches.items() if addr in live]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(10.0)
+            except Exception:  # noqa: BLE001 - the only drain thread:
+                # an unexpected error (e.g. thread exhaustion) must not
+                # kill it, or remote spools leak forever
+                logger.exception("peer-delete drain pass failed")
 
     # ------------------------------------------------------------- scheduling
     def _task_resources(self, spec: dict) -> Dict[str, float]:
@@ -2131,15 +2136,20 @@ class GcsServer:
             "task %s (worker %s pid=%s) for OOM kill",
             msg["node_id"][:8], 100 * msg.get("frac", 0),
             spec.get("name", spec["task_id"]), w.worker_id[:8], w.pid)
-        return {"pid": w.pid, "worker_id": w.worker_id}
+        return {"pid": w.pid, "worker_id": w.worker_id,
+                "task_id": spec["task_id"]}
 
     def _h_confirm_oom_kill(self, msg: dict) -> dict:
         """The agent is about to kill this pid: mark the worker's current
-        task so its death surfaces as a retriable OutOfMemoryError."""
+        task so its death surfaces as a retriable OutOfMemoryError.  The
+        task_id must still match the pick — the picked task may have
+        completed and the pooled worker started an unrelated one during
+        the pick→confirm window; that task must not be doomed as OOM."""
         with self.lock:
             w = self.workers.get(msg["worker_id"])
             if w is not None and w.pid == msg["pid"] \
-                    and w.current_task is not None:
+                    and w.current_task is not None \
+                    and w.current_task.get("task_id") == msg.get("task_id"):
                 w.current_task["_oom_killed"] = True
                 return {"ok": True}
         return {"ok": False}

@@ -54,6 +54,18 @@ def node_memory_usage() -> Tuple[int, int]:
     return max(0, total - avail), total
 
 
+def _pid_is_local_worker(pid: int) -> bool:
+    """True only when ``pid`` is a ray_tpu worker process on THIS host —
+    the proof required before os.kill'ing a pid the head didn't spawn."""
+    if not pid:
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"ray_tpu._private.worker_main" in f.read()
+    except OSError:
+        return False
+
+
 def pick_oom_victim(gcs, node_id=None, require_proc=False):
     """Newest-started plain task worker (never actors, never the driver),
     optionally restricted to one node / to head-spawned (proc-backed)
@@ -83,14 +95,16 @@ class MemoryMonitor:
     """Periodic check invoked from the GCS monitor loop.
 
     Scope: the HEAD machine only.  The usage signal below is read from
-    this host's cgroup//proc/meminfo, so only workers the head itself
-    spawned (``w.proc is not None``) are eligible victims — a proc-less
-    WorkerState can belong to a remote NodeAgent whose pid lives in
-    another host's pid namespace; ``os.kill`` on it from here would hit
-    an arbitrary unrelated local process.  Remote hosts run their own
-    monitor inside the NodeAgent (node_agent.py), which measures local
-    pressure and kills pids it owns, with victim policy still decided
-    here via the ``pick_oom_victim`` RPC."""
+    this host's cgroup//proc/meminfo, so eligible victims are workers the
+    head itself spawned (``w.proc is not None``) — plus proc-less workers
+    on the head node whose pid is VERIFIED to be a local worker process
+    (reattached survivors of a GCS restart; ``_pid_is_local_worker``).
+    A proc-less WorkerState can otherwise belong to a remote NodeAgent
+    whose pid lives in another host's pid namespace; ``os.kill`` on it
+    from here would hit an arbitrary unrelated local process.  Remote
+    hosts run their own monitor inside the NodeAgent (node_agent.py),
+    which measures local pressure and kills pids it owns, with victim
+    policy still decided here via the ``pick_oom_victim`` RPC."""
 
     def __init__(self, gcs):
         self.gcs = gcs
@@ -111,9 +125,20 @@ class MemoryMonitor:
             return
         victim = pick_oom_victim(self.gcs, require_proc=True)
         if victim is None:
+            # Workers that reattached after a GCS restart are proc-less
+            # but still local to this host: their pid is killable IF we
+            # can prove it is really one of our worker processes (guards
+            # against remote-agent pids from another host's namespace,
+            # which reattach may have adopted onto the head node).
+            victim = pick_oom_victim(self.gcs,
+                                     node_id=self.gcs.head_node_id)
+            if victim is not None and not _pid_is_local_worker(
+                    victim[0].pid):
+                victim = None
+        if victim is None:
             logger.warning(
                 "memory pressure %.0f%% above threshold %.0f%% but no "
-                "killable head-spawned task worker (actors are exempt; "
+                "killable head-local task worker (actors are exempt; "
                 "remote workers are their agent's responsibility)",
                 100 * used / total, 100 * threshold)
             return
@@ -126,7 +151,10 @@ class MemoryMonitor:
         self.kills += 1
         spec["_oom_killed"] = True
         try:
-            w.proc.kill()
+            if w.proc is not None:
+                w.proc.kill()
+            else:  # verified-local reattached worker (see above)
+                os.kill(w.pid, 9)
         except OSError:
             pass
         # death handling (retry bookkeeping, resource release, respawn)

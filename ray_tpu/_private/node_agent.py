@@ -108,19 +108,24 @@ class NodeAgent:
                             "memory %.0f%% >= %.0f%%: OOM-killing worker "
                             "pid=%d", 100 * used / total,
                             100 * threshold, pid)
+                        confirmed = False
                         try:
                             # confirm first: the head marks the task as
                             # OOM-killed only when the kill actually
                             # happens (a skipped kill must not mislabel a
-                            # later unrelated death)
-                            ch.call("confirm_oom_kill", pid=pid,
-                                    worker_id=resp.get("worker_id"))
+                            # later unrelated death), and only if the
+                            # picked task is STILL the one running
+                            confirmed = ch.call(
+                                "confirm_oom_kill", pid=pid,
+                                worker_id=resp.get("worker_id"),
+                                task_id=resp.get("task_id")).get("ok")
                         except Exception:  # noqa: BLE001
                             pass
-                        try:
-                            p.kill()
-                        except OSError:
-                            pass
+                        if confirmed:
+                            try:
+                                p.kill()
+                            except OSError:
+                                pass
                         break
             except Exception:  # noqa: BLE001 - keep the monitor alive
                 logger.exception("memory watch pass failed")

@@ -140,6 +140,15 @@ def init_params(rng: jax.Array, cfg: GPT2Config) -> Params:
 
 # ------------------------------------------------------------------ forward
 def _layer_norm(x, scale, bias, eps=1e-5):
+    # Pallas fused LN (ops/layer_norm.py) when the lane tiling allows it:
+    # pins the residual stream to its natural E-minor layout and collapses
+    # the LN fwd+bwd chain to one VMEM pass each (~4ms/step total at the
+    # flagship bench shape; step-level impact there is ~neutral — XLA was
+    # already fusing LN into neighbors — but the pinned layout keeps the
+    # trace legible and protects shapes where XLA picks T-minor).
+    if x.shape[-1] % 128 == 0:
+        from ray_tpu.ops.layer_norm import layer_norm
+        return layer_norm(x, scale, bias, eps)
     x32 = x.astype(jnp.float32)
     mu = x32.mean(-1, keepdims=True)
     var = x32.var(-1, keepdims=True)
@@ -186,6 +195,11 @@ def _block(x: jax.Array, lp: Params, cfg: GPT2Config,
     qkv = jnp.einsum("bte,eck->btck",
                      h, lp["attn_qkv"]["kernel"].astype(cfg.dtype))
     qkv = qkv + lp["attn_qkv"]["bias"].astype(cfg.dtype)
+    # Named so remat_policy="attn" can pin it: re-projecting qkv is the one
+    # matmul the rematerialized backward would otherwise re-run (the flash
+    # kernel's q/k/v residuals flow from here).
+    from jax.ad_checkpoint import checkpoint_name
+    qkv = checkpoint_name(qkv, "attn_qkv")
     q, k, v = [qkv[:, :, i, :].reshape(B, T, H, D) for i in range(3)]
     a = attn(q, k, v, cfg).reshape(B, T, E)
     a = a @ lp["attn_out"]["kernel"].astype(cfg.dtype) \
@@ -217,17 +231,25 @@ def forward_hidden(params: Params, tokens: jax.Array,
             block = jax.checkpoint(
                 block,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-        elif cfg.remat_policy == "attn":
+        elif cfg.remat_policy in ("attn", "attn_qkv"):
             if cfg.attn_impl != "flash":
                 # the saved names are tagged only inside the flash vjp;
                 # with any other impl this policy would silently behave
                 # as full remat
                 raise ValueError(
                     "remat_policy='attn' requires attn_impl='flash'")
+            # "attn": save the flash out + compact lse residuals so the
+            # backward never re-runs the attention kernel (cheap: ~52MB
+            # per GPT-2-small layer at b32/s1024).  "attn_qkv" also pins
+            # the qkv projection — the one matmul the replay would re-run
+            # — at (B,T,3E) bf16 per layer; right for small models,
+            # OOMs ≥ gpt2-medium at b32/s1024 on 16GB chips.
+            names = ["flash_attn_out", "flash_attn_lse"]
+            if cfg.remat_policy == "attn_qkv":
+                names.append("attn_qkv")
             block = jax.checkpoint(
                 block,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "flash_attn_out", "flash_attn_lse"))
+                policy=jax.checkpoint_policies.save_only_these_names(*names))
         elif cfg.remat_policy == "full":
             block = jax.checkpoint(block)
         else:
@@ -319,10 +341,17 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
         x = forward_hidden(params, inp, cfg)
         return _chunked_ce(x, params["wte"].astype(cfg.dtype), tgt,
                            cfg.loss_chunks)
-    logits = forward(params, inp, cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    # CE via logsumexp, NOT log_softmax: log_softmax materializes a second
+    # (B,T,V) f32 tensor (6.6GB at the flagship bench shape) just to read
+    # one element per row.  The correct-class logit is gathered from the
+    # bf16 logits so the f32 convert has exactly one consumer (the lse
+    # reduce) and XLA fuses it without materializing f32 logits at all
+    # (trace-measured ~14ms/step, benchmarks/step_decompose.py).
+    x = forward_hidden(params, inp, cfg)
+    logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(cfg.dtype))
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    correct = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
+    return (lse - correct.astype(jnp.float32)).mean()
 
 
 def flops_per_token(cfg: GPT2Config, seq_len: int) -> float:

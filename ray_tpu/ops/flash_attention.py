@@ -1,4 +1,4 @@
-"""Pallas flash attention (TPU kernel) with a fused Pallas backward.
+"""Pallas flash attention (TPU kernel) with a fused one-pass backward.
 
 Greenfield TPU component (SURVEY.md §5.7): tiled online-softmax attention
 that never materializes the T×T score matrix in HBM.  Each grid step owns
@@ -7,16 +7,27 @@ MXU with running (m, l, acc) accumulators — the classic flash schedule,
 expressed the Pallas way (grid + BlockSpecs; see
 /opt/skills/guides/pallas_guide.md).
 
-Differentiation: the forward kernel additionally emits the per-row
-logsumexp (lane-replicated to a 128-wide minor dim — Mosaic's tiling
-needs ≥(8,128) blocks, so row stats ride a broadcast lane axis, same
-trick as jax.experimental.pallas.ops.tpu.flash_attention); the backward
-is two Pallas kernels (dQ gridded over q-blocks, dK/dV gridded over
-k-blocks) that recompute probabilities from the saved logsumexp and
-compute delta = rowsum(dO·O) in-kernel from the saved output — O(T·block)
-memory, no (B,H,T,T) temporaries, all matmuls on the MXU in the storage
-dtype.  On non-TPU backends the kernels run in interpret mode (CI
-exercises the same code paths).
+Design notes (r3 device-trace driven — benchmarks/step_decompose.py,
+flash_kernel_decompose.py):
+- Probabilities use ``exp2`` with the 1/sqrt(D) scale and log2(e) folded
+  into the score matmul's epilogue multiply — the VPU transcendental is
+  the kernel's throughput bound, so no extra multiplies ride with it.
+- Causal masking is specialized: only the diagonal (q-block == k-block)
+  tile pays the iota/compare/select chain; strictly-lower tiles skip it.
+- The row-statistics residual (logsumexp) is stored COMPACT as (B·H, T)
+  f32 — the r2 kernel lane-replicated it to (B·H, T, 128), which cost
+  128× the HBM (200MB/layer at the flagship shape) and made saving it
+  across a remat boundary pointless.  The (1, block) lane-vector ↔
+  (block, 1) sublane-vector relayout this needs is a few hundred elements
+  per tile — noise next to the exp chain.
+- The backward is ONE kernel, gridded over (batch·head, k-block): k/v
+  tiles stay resident while an inner loop walks q-blocks ≥ the diagonal;
+  each (q,k) tile computes probabilities ONCE (the r2 two-kernel design
+  re-ran the exp chain in both dQ and dK/dV passes) and emits all three
+  gradient contributions: dk/dv accumulate in VMEM scratch for the
+  resident k-block; dq accumulates into a full-T f32 output block whose
+  index map is constant in the k-grid axis, so Mosaic keeps it VMEM-
+  resident across k-steps and writes it back once.
 """
 
 from __future__ import annotations
@@ -33,15 +44,7 @@ from jax.experimental import pallas as pl
 from ray_tpu.ops.attention import NEG_INF
 
 DEFAULT_BLOCK = 128
-LANES = 128  # minor-dim replication for row statistics (Mosaic tiling)
-
-
-def _expand_rows(stat: jax.Array, n: int) -> jax.Array:
-    """(rows, LANES) lane-replicated stats → (rows, n) for elementwise use
-    against an (rows, n) score tile."""
-    if n % LANES == 0:
-        return jnp.tile(stat, (1, n // LANES))
-    return jnp.broadcast_to(stat[:, :1], (stat.shape[0], n))
+LOG2E = math.log2(math.e)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *lse_out, block_q: int,
@@ -49,25 +52,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *lse_out, block_q: int,
     qi = pl.program_id(1)
     # Keep q/k/v in their storage dtype (bf16) for the MXU — f32 inputs
     # would quarter matmul throughput; accumulation stays f32 via
-    # preferred_element_type.  The scale folds into f32 scores.
+    # preferred_element_type.  scale*log2(e) folds into the score
+    # multiply so the exp2 chain carries no extra VPU work.
     q = q_ref[0]                                      # (block_q, D) bf16
     D = q.shape[-1]
+    s_scale = scale * LOG2E
 
-    def body(j, carry):
+    def tile(j, carry, masked):
         acc, m, l = carry
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32) * s_scale
+        if masked:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new[:, None])
+        corr = jnp.exp2(m - m_new)
         l = l * corr + p.sum(axis=-1)
         acc = acc * corr[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -77,98 +82,93 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *lse_out, block_q: int,
     acc0 = jnp.zeros((block_q, D), jnp.float32)
     m0 = jnp.full((block_q,), NEG_INF)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    # Causal: block row qi only attends K blocks 0..qi (block_q == block_k).
     nblocks = seq_len // block_k
-    upper = jnp.minimum(qi + 1, nblocks) if causal else nblocks
-    acc, m, l = lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    if causal:
+        # Strictly-lower tiles (j < qi) are fully visible: no mask chain.
+        acc, m, l = lax.fori_loop(
+            0, qi, lambda j, c: tile(j, c, masked=False), (acc0, m0, l0))
+        acc, m, l = tile(qi, (acc, m, l), masked=True)  # diagonal tile
+    else:
+        acc, m, l = lax.fori_loop(
+            0, nblocks, lambda j, c: tile(j, c, masked=False),
+            (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
     if lse_out:                                       # vjp forward only
-        lse = m + jnp.log(l)                          # (block_q,)
-        lse_out[0][0] = jnp.broadcast_to(lse[:, None], (block_q, LANES))
+        # lse in base-2 units (m + log2 l); consumers stay in base 2.
+        lse = m + jnp.log2(l)                         # (block_q,)
+        lse_out[0][0, 0] = lse                        # lse rides the lanes
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
-                   block_q: int, block_k: int, seq_len: int, causal: bool,
-                   scale: float):
-    qi = pl.program_id(1)
-    q = q_ref[0]                                      # (block_q, D)
-    do = do_ref[0]
-    lse = lse_ref[0]                                  # (block_q, LANES) f32
-    # delta_i = rowsum(dO_i · O_i), computed here from the saved output —
-    # no separate lane-replicated delta tensor in HBM.
-    delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
-                    axis=-1)                          # (block_q,)
-    D = q.shape[-1]
+def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                dq_ref, dk_ref, dv_ref, *,
+                block_q: int, block_k: int, seq_len: int, causal: bool,
+                scale: float):
+    """One-pass backward: grid (B·H, k-block); inner loop over q-blocks.
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - _expand_rows(lse, block_k))   # (block_q, block_k)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    nblocks = seq_len // block_k
-    upper = jnp.minimum(qi + 1, nblocks) if causal else nblocks
-    dq = lax.fori_loop(0, upper, body, jnp.zeros((block_q, D), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref,
-                    dv_ref, *, block_q: int, block_k: int, seq_len: int,
-                    causal: bool, scale: float):
+    Each (q, k) tile: recompute s and p (one exp2 chain), then
+      dv += pᵀ·do        dp = do·vᵀ        ds = p*(dp-delta)*scale
+      dk += dsᵀ·q        dq[i] += ds·k
+    dq lives in a full-T f32 output block revisited (same index) across
+    the k grid axis — accumulated in VMEM, flushed once per (B·H) row.
+    """
     kj = pl.program_id(1)
+    nq = seq_len // block_q
     k = k_ref[0]                                      # (block_k, D)
     v = v_ref[0]
     D = k.shape[-1]
+    s_scale = scale * LOG2E
 
-    def body(i, carry):
+    @pl.when(kj == 0)
+    def _init_dq():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    def tile(i, carry, masked):
         dk, dv = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :]
         do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]
         o = o_ref[0, pl.ds(i * block_q, block_q), :]
+        lse_lanes = lse_ref[0, 0, pl.ds(i * block_q, block_q)]  # lanes
+        lse_rows = jnp.transpose(lse_lanes[None, :])         # (block_q, 1)
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                        axis=-1)                      # (block_q,)
+                        axis=-1, keepdims=True)              # (block_q, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32) * s_scale
+        if masked:
             q_pos = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kj * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - _expand_rows(lse, block_k))   # (block_q, block_k)
+        p = jnp.exp2(s - lse_rows)                    # (block_q, block_k)
         dv = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale                 # d/ds in natural units
+        dsl = ds.astype(k.dtype)
         dk = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            dsl, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        dq_tile = jax.lax.dot_general(
+            dsl, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        sl = pl.ds(i * block_q, block_q)
+        dq_ref[0, sl, :] = dq_ref[0, sl, :] + dq_tile
         return dk, dv
 
-    nblocks = seq_len // block_q
-    # Causal: k block kj is only seen by q blocks i ≥ kj (block_q==block_k).
-    lower = jnp.minimum(kj, nblocks) if causal else 0
-    dk, dv = lax.fori_loop(
-        lower, nblocks, body,
-        (jnp.zeros((block_k, D), jnp.float32),
-         jnp.zeros((block_k, D), jnp.float32)))
+    dk0 = jnp.zeros((block_k, D), jnp.float32)
+    dv0 = jnp.zeros((block_k, D), jnp.float32)
+    if causal:
+        # k-block kj is seen by q-blocks i ≥ kj: diagonal first (masked),
+        # then the fully-visible strictly-lower rows.
+        dk, dv = tile(kj, (dk0, dv0), masked=True)
+        dk, dv = lax.fori_loop(
+            kj + 1, nq, lambda i, c: tile(i, c, masked=False), (dk, dv))
+    else:
+        dk, dv = lax.fori_loop(
+            0, nq, lambda i, c: tile(i, c, masked=False), (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -197,8 +197,8 @@ def _resolve(block_size, T, interpret):
 def _flash_forward_lse(q, k, v, *, causal: bool, block_size: int,
                        interpret: Optional[bool], want_lse: bool = True):
     """``want_lse=False`` (the primal / inference path) skips computing
-    and writing the lane-replicated lse tensor — it is only a residual
-    for the fused backward, and Pallas cannot DCE a declared output."""
+    and writing the lse tensor — it is only a residual for the fused
+    backward, and Pallas cannot DCE a declared output."""
     B, T, H, D = q.shape
     bs, interpret = _resolve(block_size, T, interpret)
     scale = 1.0 / math.sqrt(D)
@@ -209,10 +209,11 @@ def _flash_forward_lse(q, k, v, *, causal: bool, block_size: int,
     out_specs = [pl.BlockSpec((1, bs, D), lambda bh, qi: (bh, qi, 0))]
     out_shape = [jax.ShapeDtypeStruct((B * H, T, D), q.dtype)]
     if want_lse:
+        # Compact (B·H, 1, T) f32 — lse rides the lane axis; the unit
+        # middle dim satisfies Mosaic's (8,128) last-two-dims tiling rule.
         out_specs.append(
-            pl.BlockSpec((1, bs, LANES), lambda bh, qi: (bh, qi, 0)))
-        out_shape.append(
-            jax.ShapeDtypeStruct((B * H, T, LANES), jnp.float32))
+            pl.BlockSpec((1, 1, bs), lambda bh, qi: (bh, 0, qi)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32))
     res = pl.pallas_call(
         kernel,
         grid=(B * H, T // bs),
@@ -238,28 +239,20 @@ def _flash_backward(q, k, v, out, lse, g, *, causal: bool, block_size: int,
     of = _flatten(out)
     dof = _flatten(g.astype(q.dtype))
 
-    common = dict(block_q=bs, block_k=bs, seq_len=T, causal=causal,
-                  scale=scale)
-    qspec = pl.BlockSpec((1, bs, D), lambda bh, i: (bh, i, 0))
-    fullspec = pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0))
-    lsespec = pl.BlockSpec((1, bs, LANES), lambda bh, i: (bh, i, 0))
-    lsefull = pl.BlockSpec((1, T, LANES), lambda bh, i: (bh, 0, 0))
+    kspec = pl.BlockSpec((1, bs, D), lambda bh, kj: (bh, kj, 0))
+    fullspec = pl.BlockSpec((1, T, D), lambda bh, kj: (bh, 0, 0))
+    # dq: constant index along the k grid axis → VMEM-resident accumulator.
+    dqspec = pl.BlockSpec((1, T, D), lambda bh, kj: (bh, 0, 0))
+    lsespec = pl.BlockSpec((1, 1, T), lambda bh, kj: (bh, 0, 0))
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, block_q=bs, block_k=bs, seq_len=T,
+                          causal=causal, scale=scale),
         grid=(B * H, T // bs),
-        in_specs=[qspec, fullspec, fullspec, qspec, qspec, lsespec],
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        interpret=interpret,
-    )(qf, kf, vf, of, dof, lse)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(B * H, T // bs),
-        in_specs=[fullspec, qspec, qspec, fullspec, fullspec, lsefull],
-        out_specs=[qspec, qspec],
-        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+        in_specs=[fullspec, kspec, kspec, fullspec, fullspec, lsespec],
+        out_specs=[dqspec, kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), jnp.float32),
+                   jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
                    jax.ShapeDtypeStruct((B * H, T, D), v.dtype)],
         interpret=interpret,
     )(qf, kf, vf, of, dof, lse)
@@ -290,10 +283,10 @@ def _fwd(q, k, v, causal, block_size, interpret):
                                   block_size=block_size, interpret=interpret)
     # Name the backward residuals so a jax.checkpoint policy
     # (save_only_these_names, models/gpt2.py remat_policy="attn") can pin
-    # them across the remat boundary: saving out+lse (~52MB + ~200MB per
-    # GPT-2-small layer at b32/s1024) lets the rematerialized backward skip
-    # re-running the whole flash forward kernel — the single largest
-    # recompute in the step.
+    # them across the remat boundary: saving out + the compact lse
+    # (~50MB + 1.6MB per GPT-2-small layer at b32/s1024) lets the
+    # rematerialized backward skip re-running the whole flash forward
+    # kernel.
     from jax.ad_checkpoint import checkpoint_name
     out = checkpoint_name(out, "flash_attn_out")
     lse = checkpoint_name(lse, "flash_attn_lse")
@@ -312,9 +305,9 @@ flash_attention.defvjp(_fwd, _bwd)
 def pick_block_size(T: int) -> int:
     """Largest block in {512, 256, 128} dividing T.  Measured on v5e
     (benchmarks/attention_bench.py --seqs 1024 --tokens 32768): fwd+bwd
-    per-call 33.6/25.0/21.5 ms at blocks 128/256/512 — bigger q/k tiles
-    amortize the per-grid-step VPU chain (mask iota, exp, rescale) and
-    feed the MXU (block, D)x(D, block) dots with fuller tiles."""
+    per-call improves monotonically 128→512 — bigger q/k tiles amortize
+    the per-grid-step VPU chain (mask iota, exp, rescale) and feed the
+    MXU (block, D)x(D, block) dots with fuller tiles."""
     for bs in (512, 256, 128):
         if T % bs == 0:
             return bs

@@ -1,0 +1,63 @@
+"""Pallas fused LayerNorm vs the reference f32 formula (values + grads).
+
+Reference analog: none — the upstream framework ships no kernels
+(SURVEY.md §5.7); this is a TPU-native component, validated against the
+plain-XLA formula it replaces (models/gpt2._layer_norm fallback path).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.layer_norm import layer_norm
+
+
+def ref_ln(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape", [(4, 16, 256), (32, 128), (3, 5, 384)])
+def test_forward_matches_reference(shape):
+    key = jax.random.key(0)
+    x = jax.random.normal(key, shape, jnp.bfloat16) * 3 + 1
+    scale = jax.random.normal(jax.random.key(1), shape[-1:], jnp.float32)
+    bias = jax.random.normal(jax.random.key(2), shape[-1:], jnp.float32)
+    got = layer_norm(x, scale, bias)
+    want = ref_ln(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=0.05, atol=0.05)
+
+
+def test_grads_match_reference():
+    key = jax.random.key(3)
+    x = jax.random.normal(key, (8, 64, 256), jnp.float32)
+    scale = jnp.ones((256,), jnp.float32) * 1.3
+    bias = jnp.zeros((256,), jnp.float32)
+
+    def loss_fused(x, s, b):
+        return (layer_norm(x, s, b).astype(jnp.float32) ** 2).mean()
+
+    def loss_ref(x, s, b):
+        return (ref_ln(x, s, b).astype(jnp.float32) ** 2).mean()
+
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_odd_row_count_single_block():
+    # N not divisible by the default row block → falls back to one block
+    x = jax.random.normal(jax.random.key(4), (7, 11, 128), jnp.float32)
+    scale = jnp.ones((128,), jnp.float32)
+    bias = jnp.zeros((128,), jnp.float32)
+    np.testing.assert_allclose(np.asarray(layer_norm(x, scale, bias)),
+                               np.asarray(ref_ln(x, scale, bias)),
+                               rtol=1e-5, atol=1e-5)
