@@ -2,10 +2,15 @@
 
 PY ?= python
 
-.PHONY: test sanitize fuzz bench
+.PHONY: test sanitize fuzz bench lint
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# Lint gate (SURVEY.md §4 CI row): dependency-free flake8/clang-format
+# stand-in — ast checks for Python, g++ -fsyntax-only -Wall for C++.
+lint:
+	$(PY) tools/lint.py
 
 # ASAN + TSAN over the native slab store (SURVEY.md §5.2): longer runs
 # than the in-suite smoke (tests/test_native_sanitizers.py).

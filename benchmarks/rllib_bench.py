@@ -98,12 +98,97 @@ def bench_impala_pixel() -> None:
     algo.stop()
 
 
+def _env_only_rate(pixel: bool, seconds: float = 5.0) -> float:
+    """Per-component ceiling: raw env.step rate on one process (no RL)."""
+    from ray_tpu.rllib.env import create_env
+    if pixel:
+        env = create_env("RandomPixelEnv",
+                       {"size": 84, "frames": 4, "num_actions": 6})
+    else:
+        env = create_env("CartPole-v1", {})
+    env.reset(seed=0)
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        _, _, term, trunc, _ = env.step(env.action_space.sample())
+        if term or trunc:
+            env.reset()
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def bench_scaling(out: str = None) -> None:
+    """frames/s vs n_rollout_workers (VERDICT r2 next-round #6): vector +
+    pixel envs, batched-inference vectorized rollout actors, plus the
+    per-component ceilings (raw env step rate; learner consume rate)."""
+    import os
+
+    doc = {"baseline_row": "BASELINE.md #1/#3 (RLlib throughput + scaling)",
+           "date": time.strftime("%Y-%m-%d"),
+           "cpus": os.cpu_count(),
+           "note": ("rollout actors time-share this host's physical "
+                    "cores; scaling is near-linear until n_workers "
+                    "exceeds them"),
+           "env_only_steps_per_s": {
+               "vector": round(_env_only_rate(False), 1),
+               "pixel": round(_env_only_rate(True), 1)},
+           "scaling": {"vector": [], "pixel": []}}
+    for kind in ("vector", "pixel"):
+        for n in (1, 2, 4, 8):
+            cfg = IMPALAConfig()
+            if kind == "pixel":
+                cfg = cfg.environment(
+                    "RandomPixelEnv",
+                    env_config={"size": 84, "frames": 4, "num_actions": 6})
+                frag = 32
+            else:
+                cfg = cfg.environment("CartPole-v1")
+                frag = 64
+            algo = (cfg.rollouts(num_workers=n, num_envs_per_worker=4,
+                                 rollout_fragment_length=frag)
+                    .training(learner_device="cpu")
+                    .debugging(seed=0).build())
+            # warm: spawn the whole worker fleet + first weight broadcast
+            # BEFORE the timed window (on small hosts fleet spawn costs
+            # seconds and would dominate a cold measurement)
+            r = algo.train()
+            frames0 = r["timesteps_total"]
+            trained0 = int((r.get("info") or {})
+                           .get("num_env_steps_trained", frames0))
+            t0 = time.perf_counter()
+            frames = frames0
+            while time.perf_counter() - t0 < 20:
+                r = algo.train()
+                frames = r["timesteps_total"]
+            wall = time.perf_counter() - t0
+            trained = int((r.get("info") or {})
+                          .get("num_env_steps_trained", frames))
+            doc["scaling"][kind].append({
+                "num_workers": n,
+                "frames_per_s": round((frames - frames0) / wall, 1),
+                "learner_frames_per_s":
+                    round((trained - trained0) / wall, 1)})
+            algo.stop()
+            print(json.dumps({"kind": kind, "n": n,
+                              **doc["scaling"][kind][-1]}), flush=True)
+    base_v = doc["scaling"]["vector"][0]["frames_per_s"]
+    doc["vs_baseline"] = round(
+        doc["scaling"]["vector"][-1]["frames_per_s"] / max(base_v, 1), 2)
+    print(json.dumps(doc))
+    if out:
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+
+
 if __name__ == "__main__":
     import os
     # logical CPUs: rollout actors + learner oversubscribe small hosts fine
-    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 1),
+    ray_tpu.init(num_cpus=max(10, os.cpu_count() or 1),
                  ignore_reinit_error=True)
     which = sys.argv[1] if len(sys.argv) > 1 else "ppo"
-    {"ppo": bench_ppo, "impala": bench_impala,
-     "impala_pixel": bench_impala_pixel}[which]()
+    if which == "scaling":
+        bench_scaling(sys.argv[2] if len(sys.argv) > 2 else None)
+    else:
+        {"ppo": bench_ppo, "impala": bench_impala,
+         "impala_pixel": bench_impala_pixel}[which]()
     ray_tpu.shutdown()

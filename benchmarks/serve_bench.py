@@ -30,7 +30,12 @@ def main() -> None:
     ap.add_argument("--seq", type=int, default=128)
     args = ap.parse_args()
 
-    ray_tpu.init(ignore_reinit_error=True)
+    import os
+    # logical CPUs: replicas are IO/compute-light here and oversubscribe
+    # small hosts fine; a 1-CPU default would make num_replicas=3
+    # infeasible and the scale-up measurement vacuous
+    ray_tpu.init(num_cpus=max(6, os.cpu_count() or 1),
+                 ignore_reinit_error=True)
 
     preset = "tiny" if args.tiny else "bert-base"
 

@@ -16,7 +16,7 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, List, Optional
 
 from ray_tpu._private import protocol, rtlog
 from ray_tpu.util import tracing

@@ -61,7 +61,8 @@ _FLAG_DEFS = [
           "Objects <= this are inlined in the control plane (in-memory store) "
           "instead of shared memory (reference: core worker memory store)."),
     _flag("object_spill_dir", "", "Directory for spilled objects ('' = <session>/spill)."),
-    _flag("object_store_eviction", True, "LRU-evict sealed unreferenced objects to disk when full."),
+    _flag("object_store_eviction", True,
+          "LRU-evict sealed unreferenced objects to disk when full."),
     _flag("use_native_store", True, "Use the C++ shm store if the extension builds."),
     _flag("slab_memory_mb", 512, "Capacity of the native slab store (small-object plane)."),
     _flag("slab_object_max_bytes", 1024 * 1024,

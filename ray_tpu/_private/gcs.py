@@ -33,7 +33,7 @@ import sys
 import threading
 import time
 from collections import OrderedDict, defaultdict, deque
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import protocol, rtlog
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -185,6 +185,9 @@ class GcsServer:
         self.objects: Dict[str, ObjMeta] = {}
         self.client_refs: Dict[str, Dict[str, int]] = defaultdict(dict)
         self.pending_tasks: deque = deque()
+        # backlog composition by resource class (see _push_pending)
+        self._pending_counts: Dict[str, int] = {
+            "cpu": 0, "tpu": 0, "zero": 0, "special": 0}
         self.dep_waiting: Dict[str, List[dict]] = {}
         # oid → waiter records for blocked get/wait RPCs: seals wake the
         # exact waiters instead of notify_all-storming every blocked call
@@ -223,6 +226,13 @@ class GcsServer:
         self._spawn_counter = 0
         threading.Thread(target=self._peer_delete_loop, daemon=True,
                          name="gcs-peer-delete").start()
+
+        # server incarnation id: clients detect a true head RESTART (vs a
+        # transient channel break) by comparing this across reconnects, and
+        # resubmit their in-flight owned tasks (owner-based lineage — the
+        # reference keeps task lineage in the owning worker's TaskManager)
+        import uuid as _uuid
+        self.epoch = _uuid.uuid4().hex
 
         self.head_node_id = NodeID.new()
         self.add_node_internal(self.head_node_id, head_resources, is_head=True)
@@ -517,7 +527,7 @@ class GcsServer:
             spec = dict(self.lineage[meta.lineage_task])
             spec["is_reconstruction"] = True
             logger.info("reconstructing %s via task %s", oid, spec["task_id"])
-            self.pending_tasks.append(spec)
+            self._push_pending(spec)
         else:
             owner_dead = oid[:16] in self.dead_clients
             e = exc.OwnerDiedError(oid) if owner_dead else exc.ObjectLostError(oid)
@@ -766,10 +776,11 @@ class GcsServer:
                 n += 1
         return n
 
-    def _pump(self) -> None:
-        """Try to dispatch pending work. Call with lock NOT held."""
+    def _pump(self, force: bool = False) -> None:
+        """Try to dispatch pending work. Call with lock NOT held.
+        ``force`` bypasses the capacity pre-check (periodic safety pump)."""
         with self.cv:
-            self._pump_locked()
+            self._pump_locked(force=force)
 
     # Consecutive unplaceable specs tolerated per scan before giving up
     # until the next pump.  Without a cutoff, a deep backlog makes every
@@ -787,7 +798,7 @@ class GcsServer:
             if m is None or m.state == PENDING:
                 waits.add(dep)
         if not waits:
-            self.pending_tasks.append(spec)   # raced: deps arrived already
+            self._push_pending(spec)   # raced: deps arrived already
             return
         spec["_waiting_deps"] = waits
         for dep in waits:
@@ -809,17 +820,133 @@ class GcsServer:
                 self._fail_task_with_dep_error(spec, oid)
             elif not waits:
                 spec.pop("_waiting_deps", None)
-                self.pending_tasks.append(spec)
+                self._push_pending(spec)
 
-    def _pump_locked(self) -> None:
+    @staticmethod
+    def _spec_class(spec: dict) -> str:
+        """cpu | tpu | zero | special — the resource gate in
+        _dispatch_capacity is exact only for the plain-CPU and TPU
+        classes; zero-CPU and special (PG/affinity/custom-resource)
+        specs bypass it (they dispatch on dimensions the cheap check
+        doesn't model)."""
+        st = spec.get("scheduling_strategy")
+        if isinstance(st, dict) or spec.get("resources"):
+            return "special"
+        if spec.get("num_tpus"):
+            return "tpu"
+        if float(spec.get("num_cpus", 1)) <= 0:
+            return "zero"
+        return "cpu"
+
+    def _push_pending(self, spec: dict) -> None:
+        """Lock held.  All pending-queue traffic goes through these
+        helpers so _dispatch_capacity can know, in O(1), what the backlog
+        is waiting for (a fruitless O(backlog) scan per pipelined submit
+        was the measured control-plane bottleneck).  A spec returning to
+        the global queue is no longer held by any worker: strip the
+        prepush mark or a later pipeline pop would skip its push and
+        strand it."""
+        spec.pop("_prepushed", None)
+        self._pending_counts[self._spec_class(spec)] += 1
+        self.pending_tasks.append(spec)
+
+    def _push_pending_left(self, spec: dict) -> None:
+        spec.pop("_prepushed", None)
+        self._pending_counts[self._spec_class(spec)] += 1
+        self.pending_tasks.appendleft(spec)
+
+    def _pop_pending(self) -> dict:
+        spec = self.pending_tasks.popleft()
+        self._pending_counts[self._spec_class(spec)] -= 1
+        return spec
+
+    def _dispatch_capacity(self) -> bool:
+        """Lock held.  Cheap over-approximation of "could anything dispatch
+        right now?" — when False, the scan below is guaranteed fruitless
+        for the cpu/tpu spec classes (no free resources, or no idle
+        worker / spawn headroom / piggyback room), so the pump returns
+        without touching the backlog.  zero-CPU and special specs bypass
+        the resource gate.  Every event that CREATES capacity (task_done,
+        worker death/idle, node add, PG ready, resource release) already
+        triggers its own pump, and the monitor loop force-pumps every
+        0.5s as a predicate-bug safety net."""
+        pc = self._pending_counts
+        # resource gate: scan misses come from node.fits() — skip the scan
+        # when the backlog's resource classes have no free resources
+        if not (pc["special"] or pc["zero"]):
+            # > 0, not >= 1: fits() admits fractional requests (0.5-CPU
+            # actors), so any sliver of free CPU makes the scan worthwhile
+            cpu_ok = pc["cpu"] and any(
+                n.alive and n.resources_avail.get("CPU", 0) > 0
+                for n in self.nodes.values())
+            tpu_ok = pc["tpu"] and any(
+                n.alive and n.resources_avail.get("TPU", 0) > 0
+                for n in self.nodes.values())
+            if not (cpu_ok or tpu_ok):
+                return False
+        return self._worker_capacity(
+            starting_is_capacity=False, piggyback_is_capacity=True,
+            count_pending_actors=True,
+            tpu_headroom=bool(pc["tpu"] or pc["special"]))
+
+    def _worker_capacity(self, *, starting_is_capacity: bool,
+                         piggyback_is_capacity: bool,
+                         count_pending_actors: bool,
+                         tpu_headroom: bool) -> bool:
+        """Lock held.  The ONE worker/node capacity scan, parameterized by
+        what counts as capacity (pump gate vs prepush gate — their rules
+        differ but the tallies must not drift)."""
+        depth = GLOBAL_CONFIG.worker_pipeline_depth
+        counts: Dict[str, List[int]] = {}
+        for node in self.nodes.values():
+            if node.alive and node.idle_workers:
+                return True
+        for w in self.workers.values():
+            if w.blocked or w.state == "dead":
+                continue
+            if w.state == "starting" and starting_is_capacity:
+                # a slot is about to open: booting workers count against
+                # the spawn cap but ARE imminent parallel capacity
+                return True
+            if w.state in ("starting", "idle", "busy"):
+                c = counts.setdefault(w.node_id, [0, 0])
+                c[1 if w.tpu_capable else 0] += 1
+            if (piggyback_is_capacity and w.state == "busy"
+                    and w.actor_id is None and len(w.pipeline) < depth):
+                return True  # piggyback room
+        pending_actors = 0
+        if count_pending_actors:
+            pending_actors = sum(1 for a in self.actors.values()
+                                 if a.state in (A_PENDING, A_RESTARTING))
+        for node in self.nodes.values():
+            if not node.alive or node.is_remote:
+                continue
+            c = counts.get(node.node_id, [0, 0])
+            cap = GLOBAL_CONFIG.num_workers_per_node or \
+                int(max(1, node.resources_total.get("CPU", 1)))
+            if c[0] < cap + pending_actors:
+                return True
+            if tpu_headroom and c[1] < GLOBAL_CONFIG.tpu_workers_per_node:
+                return True
+        return False
+
+    def _pump_locked(self, force: bool = False) -> None:
+        if not force and self.pending_tasks and not self._dispatch_capacity():
+            self.cv.notify_all()
+            return
+        # The miss budget is for the WHOLE pump (not per pass): a typical
+        # capacity event frees room for one task — one dispatch plus a
+        # bounded tail of unplaceable specs, not O(backlog) rescans.
+        misses = 0
         progressed = True
         while progressed:
             progressed = False
-            misses = 0
             for _ in range(len(self.pending_tasks)):
-                if misses >= self._PUMP_MISS_CAP:
+                # prepush (_take_matching_pending) consumes from the same
+                # deque mid-scan: the range() above is only an upper bound
+                if misses >= self._PUMP_MISS_CAP or not self.pending_tasks:
                     break
-                spec = self.pending_tasks.popleft()
+                spec = self._pop_pending()
                 if spec.get("cancelled"):
                     continue
                 status = self._deps_status(spec)
@@ -839,7 +966,7 @@ class GcsServer:
                 else:
                     node = self._pick_node(spec, req)
                 if node is None:
-                    self.pending_tasks.append(spec)
+                    self._push_pending(spec)
                     misses += 1
                     continue
                 need_tpu = req.get("TPU", 0) > 0
@@ -855,7 +982,8 @@ class GcsServer:
                         # inits would fight over the same chips, so one
                         # device-holding worker per node (its actor/tasks
                         # own all the node's declared chips)
-                        if self._count_node_workers(node, tpu=True) <                                 GLOBAL_CONFIG.tpu_workers_per_node:
+                        if self._count_node_workers(node, tpu=True) < \
+                                GLOBAL_CONFIG.tpu_workers_per_node:
                             self._spawn_worker(node.node_id, tpu=True)
                             spawned = True
                     else:
@@ -885,7 +1013,7 @@ class GcsServer:
                             progressed = True
                             misses = 0
                             continue
-                    self.pending_tasks.append(spec)
+                    self._push_pending(spec)
                     misses += 1
                     continue
                 # dispatch
@@ -904,13 +1032,38 @@ class GcsServer:
                 self.running[spec["task_id"]] = (worker.worker_id, spec)
                 kind = ("create_actor" if spec.get("is_actor_creation")
                         else "execute_task")
-                if not worker.push({"kind": kind, "spec": spec}):
+                # prepush: same-shape dep-ready backlog rides THIS dispatch
+                # message and inherits the lease task-by-task — no push,
+                # no pump, no scan per follow-on task (reference: leased
+                # workers stay saturated without re-entering the scheduler)
+                queued: List[dict] = []
+                if kind == "execute_task" and not worker.pipeline \
+                        and self._spec_class(spec) == "cpu" \
+                        and self._pending_counts["cpu"] \
+                        and not self._parallel_capacity():
+                    depth = GLOBAL_CONFIG.worker_pipeline_depth
+                    while len(queued) < depth:
+                        extra = self._take_matching_pending(req)
+                        if extra is None:
+                            break
+                        extra["_prepushed"] = True
+                        queued.append(extra)
+                    worker.pipeline.extend(queued)
+                if not worker.push({"kind": kind, "spec": spec,
+                                    "queued": queued}):
                     # push failed: worker died between idle and now
                     self._handle_worker_death(worker)
-                    self.pending_tasks.append(spec)
+                    self._push_pending(spec)
                     continue
                 progressed = True
                 misses = 0
+                # this dispatch may have consumed the last capacity: stop
+                # scanning instead of burning the miss budget on a backlog
+                # that can no longer place anything
+                if not force and self.pending_tasks and \
+                        not self._dispatch_capacity():
+                    self.cv.notify_all()
+                    return
             self.cv.notify_all()
 
     def _release_task_resources(self, spec: dict) -> None:
@@ -983,7 +1136,7 @@ class GcsServer:
         while w.pipeline:
             qspec = w.pipeline.popleft()
             if not qspec.get("cancelled"):
-                self.pending_tasks.appendleft(qspec)
+                self._push_pending_left(qspec)
         if w.actor_id is not None:
             self._actor_worker_died(w.actor_id)
         elif spec is not None and spec.get("is_actor_creation"):
@@ -1008,7 +1161,7 @@ class GcsServer:
                 logger.info("retrying task %s (attempt %d)%s",
                             spec["task_id"], spec["attempt"],
                             " after OOM kill" if oom else "")
-                self.pending_tasks.append(spec)
+                self._push_pending(spec)
             elif not spec.get("is_actor_creation"):
                 if oom:
                     self._fail_task(spec, exc.OutOfMemoryError(
@@ -1038,7 +1191,7 @@ class GcsServer:
             respec = {k: v for k, v in a.spec.items() if not k.startswith("_")}
             respec["attempt"] = respec.get("attempt", 0) + 1
             a.spec = respec
-            self.pending_tasks.append(respec)
+            self._push_pending(respec)
             logger.info("restarting actor %s (incarnation %d)", actor_id, a.incarnation)
         else:
             a.state = A_DEAD
@@ -1077,7 +1230,8 @@ class GcsServer:
             now = time.monotonic()
             if now - last_pump > 0.5 and self.pending_tasks:
                 last_pump = now
-                self._pump()
+                self._pump(force=True)  # liveness even if the capacity
+                # predicate is ever wrong for an exotic spec shape
             dead: List[WorkerState] = []
             with self.lock:
                 for w in self.workers.values():
@@ -1337,9 +1491,15 @@ class GcsServer:
                     w.blocked = True
                     # a blocked worker can't drain its pipeline (and its
                     # queued tasks could even be what it blocks ON) —
-                    # give them back to the scheduler
+                    # give them back to the scheduler; the worker must
+                    # drop its prepushed copies or a respawned-elsewhere
+                    # spec would also run here after the unblock
+                    dropped = [s["task_id"] for s in w.pipeline
+                               if s.get("_prepushed")]
                     while w.pipeline:
-                        self.pending_tasks.appendleft(w.pipeline.pop())
+                        self._push_pending_left(w.pipeline.pop())
+                    if dropped:
+                        w.push({"kind": "drop_queued", "task_ids": dropped})
                     spec = w.current_task
                     cpu = (spec.get("_req") or {}).get("CPU", 0)
                     if cpu and not spec.get("_cpu_released"):
@@ -1392,6 +1552,43 @@ class GcsServer:
             with self.lock:
                 self.events.extend(msg["events"])
 
+    def _parallel_capacity(self) -> bool:
+        """Lock held.  Could another INDEPENDENT execution slot take work
+        right now (idle worker, booting worker, or spawn headroom — NOT
+        piggyback room)?  Prepush/refill must never serialize onto one
+        lease work that could run concurrently elsewhere (e.g. two Tune
+        trials).  Shares the scan with _dispatch_capacity."""
+        return self._worker_capacity(starting_is_capacity=True,
+                                     piggyback_is_capacity=False,
+                                     count_pending_actors=False,
+                                     tpu_headroom=False)
+
+    def _take_matching_pending(self, req) -> Optional[dict]:
+        """Lock held.  Pop the first dep-ready plain-CPU spec whose
+        resource shape matches ``req`` (lease inheritance candidates);
+        bounded probe so a mismatched backlog costs O(1)."""
+        if req is None:
+            return None
+        skipped = []
+        found = None
+        for _ in range(min(8, len(self.pending_tasks))):
+            spec = self._pop_pending()
+            if spec.get("cancelled"):
+                continue
+            if (self._spec_class(spec) == "cpu"
+                    and not spec.get("is_actor_creation")
+                    and (spec.get("scheduling_strategy") or "DEFAULT")
+                    == "DEFAULT"
+                    and not spec.get("runtime_env")
+                    and self._task_resources(spec) == req
+                    and self._deps_status(spec) == "ready"):
+                found = spec
+                break
+            skipped.append(spec)
+        for spec in reversed(skipped):
+            self._push_pending_left(spec)
+        return found
+
     def _on_task_done(self, worker_id: str, msg: dict) -> None:
         with self.cv:
             w = self.workers.get(worker_id)
@@ -1408,9 +1605,31 @@ class GcsServer:
                 if not cand.get("cancelled"):
                     nxt = cand
                     break
+            if nxt is None and not w.blocked and w.state == "busy" \
+                    and w.actor_id is None and "_req" in spec \
+                    and not spec.get("is_actor_creation") \
+                    and self._pending_counts["cpu"]:
+                # refill from the backlog while the lease is still alive
+                # (reference: lease reuse — the raylet keeps a leased
+                # worker saturated without re-running the scheduler)
+                nxt = self._take_matching_pending(spec["_req"])
             if nxt is not None and "_req" in spec:
                 nxt["_req"] = spec.pop("_req")
                 nxt["_node"] = spec.pop("_node")
+            refill_queued: List[dict] = []
+            if nxt is not None and not nxt.get("_prepushed") \
+                    and not w.pipeline and self._pending_counts["cpu"] \
+                    and not self._parallel_capacity():
+                # refill the pipeline too, and ship it WITH nxt's push
+                # below (prepushed) — one message re-saturates the worker
+                depth = GLOBAL_CONFIG.worker_pipeline_depth
+                while len(refill_queued) < depth:
+                    extra = self._take_matching_pending(nxt["_req"])
+                    if extra is None:
+                        break
+                    extra["_prepushed"] = True
+                    refill_queued.append(extra)
+                w.pipeline.extend(refill_queued)
             self._release_task_resources(spec)
             w.current_task = None
             w.blocked = False
@@ -1440,23 +1659,30 @@ class GcsServer:
                 if retries and spec.get("attempt", 0) < retries:
                     spec2 = dict(spec)
                     spec2["attempt"] = spec.get("attempt", 0) + 1
-                    self.pending_tasks.append(spec2)
+                    self._push_pending(spec2)
                 else:
                     for oid in spec["return_ids"]:
                         self._seal_error(oid, msg["error"])
                     self._release_deps(spec)
             # next leased task, or worker back to pool
-            if nxt is not None and w.state == "busy":
+            if nxt is not None and w.state == "busy" \
+                    and nxt.pop("_prepushed", None):
+                # the worker already holds this spec (prepushed with the
+                # dispatch message) and is running it right now
                 w.current_task = nxt
                 self.running[nxt["task_id"]] = (worker_id, nxt)
-                if not w.push({"kind": "execute_task", "spec": nxt}):
+            elif nxt is not None and w.state == "busy":
+                w.current_task = nxt
+                self.running[nxt["task_id"]] = (worker_id, nxt)
+                if not w.push({"kind": "execute_task", "spec": nxt,
+                               "queued": refill_queued}):
                     # worker died between done and handoff: the task never
                     # STARTED — reschedule it without consuming its retry
                     # budget (same invariant as the queued pipeline)
                     self.running.pop(nxt["task_id"], None)
                     w.current_task = None
                     self._release_task_resources(nxt)
-                    self.pending_tasks.appendleft(nxt)
+                    self._push_pending_left(nxt)
                     self._handle_worker_death(w)
             elif w.state == "busy":
                 w.state = "idle"
@@ -1547,6 +1773,7 @@ class GcsServer:
             if existing is not None:  # extra thread-local channel re-registering
                 return {"node_id": existing.node_id,
                         "head_node_id": self.head_node_id,
+                        "epoch": self.epoch,
                         "store_capacity": self.store.capacity}
             if role == "worker":
                 # find the placeholder created at spawn time by pid, else create
@@ -1578,21 +1805,13 @@ class GcsServer:
                 self.driver_ids.add(wid)
             self.cv.notify_all()
             return {"node_id": w.node_id, "head_node_id": self.head_node_id,
+                    "epoch": self.epoch,
                     "store_capacity": self.store.capacity}
 
     # --- objects
     def _h_put_object(self, msg: dict) -> dict:
         with self.cv:
-            oid = msg["object_id"]
-            meta = self._get_or_create_meta(oid)
-            meta.refcount += 1  # the putting client's reference
-            self.client_refs[msg["client_id"]][oid] = \
-                self.client_refs[msg["client_id"]].get(oid, 0) + 1
-            if msg["loc"] == "shm":
-                self.store.adopt(oid, msg.get("size", 0))
-            self._seal_object(oid, msg["loc"], msg.get("data"),
-                              msg.get("size", 0), msg.get("node_id"),
-                              msg.get("contained", []))
+            self._apply_put_locked(msg["client_id"], msg)
         self._pump()  # a pending task may have been waiting on this object
         return {}
 
@@ -1680,6 +1899,11 @@ class GcsServer:
         waiter = {"left": set(), "ev": ev, "need": None}
         with self.cv:
             pending = self._scan_pending(oids, verify_fs=True)
+            if pending and msg.get("nonblock"):
+                # fast-path probe (see Worker._blocking_get_meta): the
+                # caller avoids the task_blocked CPU-release dance when
+                # everything is already sealed
+                return {"pending": sorted(pending)}
             if pending:
                 waiter["left"].update(pending)
                 for oid in waiter["left"]:
@@ -1788,13 +2012,8 @@ class GcsServer:
         """Batched ObjectRef drops (one lock acquisition + one message for
         up to 64 decrefs — the submit hot loop's GC traffic)."""
         with self.cv:
-            refs = self.client_refs.get(msg["client_id"], {})
             for oid in msg["object_ids"]:
-                if refs.get(oid, 0) > 0:
-                    refs[oid] -= 1
-                    if refs[oid] == 0:
-                        del refs[oid]
-                    self._decref(oid)
+                self._apply_release_locked(msg["client_id"], oid)
         return {}
 
     def _h_release_all(self, msg: dict) -> dict:
@@ -1832,34 +2051,86 @@ class GcsServer:
         return {}
 
     # --- tasks
+    def _register_spec_locked(self, spec: dict) -> None:
+        """Lock held.  Pin returns + deps/borrows and enqueue the spec —
+        the ONE copy of submit registration (unbatched handler and the
+        batched op stream both call here; refcount rules must not drift
+        between them)."""
+        refs = self.client_refs[spec["owner"]]
+        for oid in spec["return_ids"]:
+            meta = self._get_or_create_meta(oid)
+            meta.refcount += 1
+            meta.has_producer = True
+            refs[oid] = refs.get(oid, 0) + 1
+        # pin args (top-level refs) and borrows (refs nested in values)
+        # until the task reaches a terminal state
+        for dep in list(spec.get("deps", ())) + list(spec.get("borrows", ())):
+            meta = self._get_or_create_meta(dep)
+            meta.refcount += 1
+        self._push_pending(spec)
+
+    def _apply_put_locked(self, client_id, msg: dict) -> None:
+        """Lock held.  The ONE copy of object-publication bookkeeping."""
+        oid = msg["object_id"]
+        meta = self._get_or_create_meta(oid)
+        if not msg.get("transient"):
+            meta.refcount += 1  # the putting client's reference
+            self.client_refs[client_id][oid] = \
+                self.client_refs[client_id].get(oid, 0) + 1
+        # transient: a task-arg payload — no client ref at all; the
+        # submit's dep pin (same batch or rc-0-at-seal grace) owns it
+        if msg["loc"] == "shm":
+            self.store.adopt(oid, msg.get("size", 0))
+        self._seal_object(oid, msg["loc"], msg.get("data"),
+                          msg.get("size", 0), msg.get("node_id"),
+                          msg.get("contained", []))
+
+    def _apply_release_locked(self, client_id, oid: str) -> None:
+        """Lock held.  The ONE copy of a single client-ref release."""
+        refs = self.client_refs.get(client_id, {})
+        if refs.get(oid, 0) > 0:
+            refs[oid] -= 1
+            if refs[oid] == 0:
+                del refs[oid]
+            self._decref(oid)
+
     def _h_submit_task(self, msg: dict) -> dict:
         spec = msg["spec"]
         try:
             with self.cv:
-                refs = self.client_refs[spec["owner"]]
-                for oid in spec["return_ids"]:
-                    meta = self._get_or_create_meta(oid)
-                    meta.refcount += 1
-                    meta.has_producer = True
-                    refs[oid] = refs.get(oid, 0) + 1
-                # pin args (top-level refs) and borrows (refs nested in
-                # values) until the task reaches a terminal state
-                for dep in list(spec.get("deps", ())) + list(spec.get("borrows", ())):
-                    meta = self._get_or_create_meta(dep)
-                    meta.refcount += 1
-                self.pending_tasks.append(spec)
+                self._register_spec_locked(spec)
         except Exception as e:  # noqa: BLE001 - submit is one-way: a lost
             # error would strand the caller's get() forever; seal the
             # returns with it instead
             with self.cv:
                 self._fail_task(spec, e)
             raise
-        # Pump only when this task could plausibly dispatch NOW: under a
-        # pipelined submit flood with all workers busy, pumping per submit
-        # is pure scan overhead — the next task_done pumps the backlog.
-        if len(self.pending_tasks) < 8 or \
-                any(n.idle_workers for n in self.nodes.values()):
-            self._pump()
+        # _pump_locked's capacity pre-check makes a no-capacity pump O(1);
+        # no submit-site heuristic needed.
+        self._pump()
+        return {}
+
+    def _h_submit_batch(self, msg: dict) -> dict:
+        """Batched pipelined submission (r3): an ORDERED op stream — up to
+        64 ("put", putmsg) / ("spec", spec) / ("rel", oid) entries in ONE
+        message and ONE pump.  In-order application gives the same FIFO
+        the unbatched path had: an arg-payload put lands before the spec
+        that deps on it; a transient release lands after the spec whose
+        dep pin replaces it."""
+        client_id = msg.get("client_id")
+        with self.cv:
+            for kind, payload in msg["ops"]:
+                if kind == "spec":
+                    try:
+                        self._register_spec_locked(payload)
+                    except Exception as e:  # noqa: BLE001 - see
+                        # _h_submit_task: a lost error strands the getter
+                        self._fail_task(payload, e)
+                elif kind == "put":
+                    self._apply_put_locked(client_id, payload)
+                elif kind == "rel":
+                    self._apply_release_locked(client_id, payload)
+        self._pump()
         return {}
 
     def _iter_queued_specs(self):
@@ -1897,6 +2168,17 @@ class GcsServer:
                 if spec["task_id"] == tid:
                     spec["cancelled"] = True
                     self._fail_task(spec, exc.TaskCancelledError(tid))
+                    if spec.get("_prepushed"):
+                        # a worker already holds a copy of this spec
+                        # (prepushed pipeline): revoke that COPY (skip-
+                        # once) — a plain cancel would only target the
+                        # running task, and a sticky flag would break a
+                        # later legitimate re-dispatch
+                        for w in self.workers.values():
+                            if spec in w.pipeline:
+                                w.push({"kind": "drop_queued",
+                                        "task_ids": [tid]})
+                                break
                     self.cv.notify_all()
                     return {"cancelled": "pending"}
             entry = self.running.get(tid)
@@ -1929,7 +2211,7 @@ class GcsServer:
                             f"namespace {a.namespace!r}")
                 self.named_actors[key] = a.actor_id
             self.actors[a.actor_id] = a
-            self.pending_tasks.append(spec)
+            self._push_pending(spec)
         self._persist_durable()
         self._pump()
         return {"actor_id": a.actor_id, "existing": False}

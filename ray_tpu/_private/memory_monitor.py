@@ -21,7 +21,7 @@ Policy here (matching the reference's task-killing policy shape):
 from __future__ import annotations
 
 import os
-from typing import Optional, Tuple
+from typing import Tuple
 
 from ray_tpu._private import rtlog
 

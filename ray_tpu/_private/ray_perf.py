@@ -10,7 +10,7 @@ Usage: ``python -m ray_tpu.scripts.cli microbenchmark [--quick]``.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 import numpy as np
 

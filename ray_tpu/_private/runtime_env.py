@@ -27,10 +27,29 @@ import zipfile
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
-SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "config"}
+SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+                  "container", "config"}
 _URI_PREFIX = "kv://runtime_env/"
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 _MAX_ZIP_BYTES = 64 * 1024 * 1024
+
+
+def _conda_exe() -> Optional[str]:
+    import shutil
+    for name in ("mamba", "micromamba", "conda"):
+        exe = shutil.which(name)
+        if exe:
+            return exe
+    return None
+
+
+def _container_exe() -> Optional[str]:
+    import shutil
+    for name in ("podman", "docker"):
+        exe = shutil.which(name)
+        if exe:
+            return exe
+    return None
 
 
 def validate(runtime_env: Optional[dict]) -> None:
@@ -40,8 +59,18 @@ def validate(runtime_env: Optional[dict]) -> None:
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)}; supported: "
-            f"{sorted(SUPPORTED_KEYS)} (conda/container isolation is "
-            f"not available in this build)")
+            f"{sorted(SUPPORTED_KEYS)}")
+    # graceful validated-unsupported (reference: plugin validation at
+    # submission): fail at submit with a clear message, not in a worker
+    if runtime_env.get("conda") and _conda_exe() is None:
+        raise ValueError(
+            "runtime_env['conda'] requires a conda/mamba/micromamba "
+            "binary on PATH; none found on this host "
+            "(validated-unsupported)")
+    if runtime_env.get("container") and _container_exe() is None:
+        raise ValueError(
+            "runtime_env['container'] requires a podman or docker binary "
+            "on PATH; none found on this host (validated-unsupported)")
 
 
 # ---------------------------------------------------------------- packaging
@@ -261,6 +290,150 @@ def ensure_pip_env(pip: List[str], worker) -> Path:
     return _venv_site_packages(venv_dir)
 
 
+def _resolve_existing_conda_env(exe: str, name_or_prefix: str) -> Path:
+    """Ray's string form names an EXISTING env (by name or prefix path)."""
+    import json as json_mod
+    import subprocess
+
+    p = Path(name_or_prefix).expanduser()
+    if os.sep in name_or_prefix or p.is_dir():
+        if not p.is_dir():
+            raise FileNotFoundError(
+                f"conda env prefix does not exist: {name_or_prefix}")
+        return p
+    proc = subprocess.run([exe, "env", "list", "--json"],
+                          capture_output=True, text=True)
+    if proc.returncode == 0:
+        try:
+            for env_path in json_mod.loads(proc.stdout).get("envs", []):
+                if Path(env_path).name == name_or_prefix:
+                    return Path(env_path)
+        except ValueError:
+            pass
+    raise FileNotFoundError(
+        f"conda env {name_or_prefix!r} not found (conda env list)")
+
+
+def ensure_conda_env(spec: Any, worker) -> Path:
+    """Create-or-reuse a conda env for this spec; returns the env prefix.
+
+    Spec forms (reference conda plugin semantics):
+    - str: the NAME or PREFIX of an existing env — used as-is, never
+      created;
+    - list of package strings, or a dict in the environment.yml subset
+      {"dependencies": [... , {"pip": [...]}], "channels": [...]}:
+      created with the same cache discipline as pip (one env per
+      sha256(canonical spec incl. channels) under
+      ``<cache>/runtime_env/conda``, built once under an flock,
+      atomically published via rename); nested pip deps install into the
+      env's own python afterwards."""
+    import fcntl
+    import shutil
+    import subprocess
+
+    exe = _conda_exe()
+    if exe is None:
+        raise RuntimeError("no conda/mamba binary on PATH")
+    if isinstance(spec, str):
+        return _resolve_existing_conda_env(exe, spec)
+    channels: List[str] = []
+    pip_deps: List[str] = []
+    if isinstance(spec, dict):
+        channels = [str(c) for c in spec.get("channels", [])]
+        deps = []
+        for d in spec.get("dependencies", []):
+            if isinstance(d, dict):
+                pip_deps += [str(x) for x in d.get("pip", [])]
+            else:
+                deps.append(str(d))
+    else:
+        deps = [str(d) for d in spec]
+    deps = sorted(deps)
+    pip_deps = sorted(pip_deps)
+    canonical = "\n".join(["C:" + c for c in channels] + deps +
+                          ["P:" + p for p in pip_deps])
+    digest = hashlib.sha256(canonical.encode()).hexdigest()[:16]
+    root = _env_cache_root(worker) / "runtime_env" / "conda"
+    env_dir = root / digest
+    if env_dir.exists():
+        return env_dir
+    root.mkdir(parents=True, exist_ok=True)
+    with open(root / f".{digest}.lock", "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        if env_dir.exists():
+            return env_dir
+        tmp = root / f".{digest}.tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        chan_flags = [f for c in channels for f in ("-c", c)]
+        proc = subprocess.run(
+            [exe, "create", "-y", "-p", str(tmp), *chan_flags, *deps],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"conda runtime_env create failed: {proc.stderr[-800:]}")
+        if pip_deps:
+            env_py = tmp / "bin" / "python"
+            pip_cmd = [str(env_py) if env_py.exists() else sys.executable,
+                       "-m", "pip", "install", "--no-index", *pip_deps]
+            proc = subprocess.run(pip_cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"conda runtime_env pip section failed (--no-index; "
+                    f"zero-egress build): {proc.stderr[-800:]}")
+        os.rename(tmp, env_dir)  # atomic publish under the lock
+    return env_dir
+
+
+_CONTAINER_BOOTSTRAP = (
+    "import pickle,sys,traceback\n"
+    "fn,a,k=pickle.load(open('/rtpu_io/in.pkl','rb'))\n"
+    "try:\n"
+    "    out=(True,fn(*a,**k))\n"
+    "except BaseException as e:\n"
+    "    out=(False,e)\n"
+    "pickle.dump(out,open('/rtpu_io/out.pkl','wb'))\n")
+
+
+def run_in_container(container: Any, fn, args, kwargs, worker) -> Any:
+    """Per-task exec prefix (reference: the container runtime-env plugin
+    runs the worker inside the image).  The task body ships as a pickle
+    through a bind-mounted scratch dir; the container runs a one-shot
+    bootstrap and pickles back (ok, result | exception)."""
+    import pickle
+    import subprocess
+    import tempfile
+
+    import cloudpickle
+
+    exe = _container_exe()
+    if exe is None:
+        raise RuntimeError("no podman/docker binary on PATH")
+    if isinstance(container, str):
+        image, run_options = container, []
+    else:
+        image = container["image"]
+        run_options = [str(o) for o in container.get("run_options", [])]
+    with tempfile.TemporaryDirectory(prefix="rtpu_ctr_") as td:
+        (Path(td) / "in.pkl").write_bytes(
+            cloudpickle.dumps((fn, args, kwargs)))
+        cmd = [exe, "run", "--rm", "-v", f"{td}:/rtpu_io", *run_options,
+               image, "python", "-c", _CONTAINER_BOOTSTRAP]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=float(os.environ.get(
+                                  "RTPU_CONTAINER_TASK_TIMEOUT", 3600)))
+        out_path = Path(td) / "out.pkl"
+        if proc.returncode != 0 or not out_path.exists():
+            raise RuntimeError(
+                f"container task failed (rc={proc.returncode}): "
+                f"{proc.stderr[-800:]}")
+        ok, payload = pickle.loads(out_path.read_bytes())
+    if ok:
+        return payload
+    raise payload
+
+
 def apply(runtime_env: Optional[dict], worker) -> Dict[str, Any]:
     """Apply working_dir/py_modules/env_vars; returns restore state.
 
@@ -284,6 +457,20 @@ def apply(runtime_env: Optional[dict], worker) -> Dict[str, Any]:
             # restore() purges modules imported from here so the pooled
             # worker's import state is not polluted for the next task
             saved["module_prefixes"].append(str(site))
+        conda = runtime_env.get("conda")
+        if conda:
+            env_dir = ensure_conda_env(conda, worker)
+            # in-process application mirrors the pip plugin: the env's
+            # site-packages prefixes sys.path (python-version-compatible
+            # packages), its bin prefixes PATH for subprocess tools;
+            # module purge keeps the pooled worker clean
+            for sp in sorted(env_dir.glob("lib/python*/site-packages")):
+                sys.path.insert(0, str(sp))
+                saved["sys_path"].append(str(sp))
+                saved["module_prefixes"].append(str(sp))
+            saved["env"].setdefault("PATH", os.environ.get("PATH"))
+            os.environ["PATH"] = f"{env_dir / 'bin'}:" + \
+                os.environ.get("PATH", "")
         wd = runtime_env.get("working_dir")
         if wd:
             local = ensure_local(wd, worker)

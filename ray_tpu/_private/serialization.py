@@ -21,7 +21,7 @@ from __future__ import annotations
 import io
 import pickle
 import struct
-from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+from typing import Any, Callable, Dict, List, Tuple, Type
 
 import cloudpickle
 

@@ -187,6 +187,27 @@ class Worker:
         # the call complete, so a racing disconnect can't resubmit an
         # already-executed method (see _ActorChannel._on_disconnect)
         self._inflight_calls: Dict[str, Tuple[str, str]] = {}
+        # Pipelined submit batching (reference: lease-cached submission +
+        # the r2 release batching): specs buffer here and ship 64-to-a-
+        # message.  Ordering contract: any release referencing a buffered
+        # spec's deps must flush AFTER the spec — release paths call
+        # _flush_submits() first.  Out-of-order put_object vs submit is
+        # safe (the GCS promotes dep-waiters when the object arrives).
+        self._submit_buf: List[Any] = []   # interleaved specs + releases
+        self._submit_lock = threading.Lock()
+        self._submit_first: float = 0.0
+        self._submit_flusher_on = False
+        self._dropped_ids: set = set()  # revoked prepushed specs (skip once)
+        # Owner-based lineage across head restarts (reference: TaskManager
+        # lives in the OWNING worker): every submitted spec is retained
+        # until one of its returns is observed terminal or its refs are
+        # all released; on reconnect to a RESTARTED head (epoch change)
+        # the owner resubmits the survivors — a head crash must not
+        # strand a caller's get() forever.
+        self._owned_specs: "OrderedDict[str, dict]" = OrderedDict()
+        self._owned_by_ret: Dict[str, str] = {}   # return oid -> task_id
+        self._owned_lock = threading.Lock()
+        self._gcs_epoch: Optional[str] = None
         self._pull_sem = threading.Semaphore(
             max(1, GLOBAL_CONFIG.transfer_max_inflight))
         self.ctx = _TaskContext()
@@ -204,13 +225,26 @@ class Worker:
                               client_id=self.worker_id, pid=os.getpid(),
                               node_id=node_id)
         self.node_id = info["node_id"]
+        if self._gcs_epoch is None:
+            self._gcs_epoch = info.get("epoch")
 
     # ------------------------------------------------------------- plumbing
     def _on_new_channel(self, ch: protocol.RpcChannel) -> None:
         # Every extra thread-local channel re-registers (idempotent server-side)
         if getattr(self, "node_id", None) is not None:
-            ch.call("register_client", role=self.role, client_id=self.worker_id,
-                    pid=os.getpid(), node_id=self.node_id)
+            info = ch.call("register_client", role=self.role,
+                           client_id=self.worker_id,
+                           pid=os.getpid(), node_id=self.node_id)
+            epoch = info.get("epoch")
+            if self._gcs_epoch is None:
+                self._gcs_epoch = epoch
+            elif epoch is not None and epoch != self._gcs_epoch:
+                # a DIFFERENT head: its task table died with the old one —
+                # resubmit every owned in-flight spec (at-least-once; a
+                # surviving worker's late result for the same task seals
+                # the same return ids, which the seal path tolerates)
+                self._gcs_epoch = epoch
+                self._resubmit_owned(ch)
 
     # Two-way RPC kinds that MUTATE server state: these carry a _dedup id
     # so the one post-reconnect retry is exactly-once against a still-live
@@ -225,6 +259,11 @@ class Worker:
         "pg_create", "pg_remove", "add_node", "remove_node"})
 
     def rpc(self, kind: str, **fields: Any) -> dict:
+        # Two-way calls observe prior submits (FIFO illusion): flush the
+        # submit batch first — e.g. a get_meta on a buffered task's return
+        # must find the task registered.
+        if self._submit_buf:
+            self._flush_submits()
         # Across a true GCS restart the dedup cache is empty and the retry
         # re-applies — the documented at-least-once contract for head
         # fault tolerance (fresh object table).
@@ -487,6 +526,11 @@ class Worker:
             for oid, meta in metas.items():
                 if meta.get("state") in ("ready", "error"):
                     self._mark_call_done(oid)
+        if self._owned_by_ret:
+            # terminal returns release the owner-side lineage retention
+            for oid, meta in metas.items():
+                if meta.get("state") in ("ready", "error"):
+                    self._untrack_owned_ret(oid)
         out = []
         for oid in oids:
             for attempt in range(3):
@@ -512,6 +556,14 @@ class Worker:
             remaining = max(0.0, deadline - time.monotonic())
         blocked = self.ctx.in_task
         if blocked:
+            # fast path first: task args are usually already sealed, and
+            # the task_blocked → CPU-release → pump → task_unblocked dance
+            # for a get that never actually waits both over-dispatches the
+            # scheduler (blocked workers don't count against the spawn
+            # cap) and storms the pump (measured on the 100KB-arg loop)
+            resp = self.rpc("get_meta", object_ids=oids, nonblock=True)
+            if "metas" in resp:
+                return resp["metas"]
             self._send_event({"kind": "task_blocked"})
         # deferred decrefs must land before a potentially-long block,
         # or they pin store memory for the whole wait
@@ -570,6 +622,8 @@ class Worker:
         declaration comment for the ordering argument."""
         if self._stop.is_set():
             return
+        if self._owned_by_ret:
+            self._untrack_owned_ret(oid)  # owner dropped the return ref
         buf = self._release_buf()
         with self._release_lock:  # RLock: cyclic-GC re-entry safe
             buf.append(oid)
@@ -577,6 +631,9 @@ class Worker:
                 return
             batch = buf[:]
             del buf[:]
+        # buffered submits pin deps these releases may drop: submits first
+        if self._submit_buf:
+            self._flush_submits()
         self.rpc_oneway("release_batch", object_ids=batch)
 
     def _flush_releases(self, all_threads: bool = False) -> None:
@@ -585,6 +642,8 @@ class Worker:
         ``all_threads`` (shutdown only) drains every thread's buffer on
         the calling thread — cross-channel ordering no longer matters
         once nothing new can be submitted."""
+        if self._submit_buf:
+            self._flush_submits()  # submits pin deps; they must land first
         batches: List[List[str]] = []
         with self._release_lock:  # copy+clear must be atomic vs shutdown
             buf = getattr(self._release_tls, "buf", None)
@@ -646,9 +705,13 @@ class Worker:
         return fn
 
     # ------------------------------------------------------- arg marshalling
-    def _pack_args(self, args: tuple, kwargs: dict
-                   ) -> Tuple[dict, List[str], List[str], List[str]]:
-        """Returns (fields, deps, borrows, transient_refs).
+    def _pack_args(self, args: tuple, kwargs: dict, batched: bool = False
+                   ) -> Tuple[dict, List[str], List[str], List[str],
+                              List[tuple]]:
+        """Returns (fields, deps, borrows, transient_refs, pre_ops).
+        ``batched``: the caller ships specs via the ordered submit batch,
+        so big arg payloads become ("put", ...) pre-ops in that stream
+        instead of a synchronous put round trip.
 
         Top-level ObjectRef args are passed by reference and resolved to
         values before execution (= deps).  Refs nested inside values stay
@@ -679,23 +742,52 @@ class Worker:
                 [e for e in klayout.values() if e[0] == "ref"]]
         fields = {"arg_layout": layout, "kwarg_layout": klayout}
         transient: List[str] = []
+        pre_ops: List[tuple] = []
         if len(wire) <= GLOBAL_CONFIG.inline_object_max_bytes:
             fields["values_blob"] = wire
+        elif batched and not self.is_client:
+            # big arg payloads ride the object plane, not the control
+            # socket — single-copy: the already-serialized wire goes
+            # straight to the slab/shm plane, and the put_object rides the
+            # SAME ordered submit batch as the spec (transient=True: no
+            # client ref to release later; the spec's dep pin — applied
+            # later in the same batch — owns the lifetime).  The spec must
+            # never overtake the put: a worker would park on the missing
+            # arg, release its CPU, and the scheduler over-dispatches.
+            oid = str(ObjectID.make(self.worker_id, KIND_PUT,
+                                    self._put_seq()))
+            loc = self._write_wire(oid, wire)
+            pre_ops.append(("put", {
+                "object_id": oid, "loc": loc, "size": len(wire),
+                "contained": borrows, "transient": True,
+                "node_id": self.node_id}))
+            fields["values_ref"] = oid
+            deps = deps + [oid]
         else:
-            # big arg payloads ride the object plane, not the control socket
             vref = self.put(values)
             fields["values_ref"] = str(vref.id)
             deps = deps + [str(vref.id)]
             vref._skip_release = True  # scheduler dep-hold takes over
             transient.append(str(vref.id))  # drop our ledger ref post-submit
-        return fields, deps, borrows, transient
+        return fields, deps, borrows, transient, pre_ops
 
     def _unpack_args(self, spec: dict) -> Tuple[list, dict]:
         if "values_blob" in spec:
             values = deserialize_from(memoryview(spec["values_blob"]))
         elif "values_ref" in spec:
-            values = self.get_one(ObjectRef(spec["values_ref"], worker=self,
-                                            skip_release=True))
+            # fast path: the arg payload was written to the same-host slab
+            # by the submitter and is pinned by this task's dep — read it
+            # directly, no get_meta round trip (the 100KB-arg hot loop)
+            values = None
+            slab = self.slab
+            if slab is not None:
+                wire = slab.get(spec["values_ref"])
+                if wire is not None:
+                    values = deserialize_from(memoryview(wire))
+            if values is None:
+                values = self.get_one(ObjectRef(spec["values_ref"],
+                                                worker=self,
+                                                skip_release=True))
         else:
             values = []
         ref_ids = [oid for tag, oid in spec["arg_layout"] if tag == "ref"] + \
@@ -724,7 +816,8 @@ class Worker:
             from ray_tpu._private import runtime_env as renv
             runtime_env = renv.prepare(runtime_env, self)
         fn_id = self.export_callable(fn)
-        fields, deps, borrows, transient = self._pack_args(args, kwargs)
+        fields, deps, borrows, transient, pre_ops = self._pack_args(
+            args, kwargs, batched=True)
         task_id = TaskID.new()
         return_ids = [str(ObjectID.make(self.worker_id, KIND_RETURN, self._ret_seq()))
                       for _ in range(num_returns)]
@@ -751,11 +844,137 @@ class Worker:
         # one-way submit: return ids are generated client-side, so there is
         # nothing to wait for — pipelined submissions instead of a control-
         # plane round trip per task (reference: lease-cached submission).
-        # FIFO on the thread-local conn keeps submit → release ordering.
-        self.rpc_oneway("submit_task", spec=spec)
-        for oid in transient:
-            self.rpc_oneway("release", object_id=oid)
+        # Specs batch 64-to-a-message (r3: per-message framing was the
+        # measured residual of the task hot loop); transient releases ride
+        # the same batch AFTER their spec so the dep pin wins the race.
+        self._buffer_submit(spec, transient, pre_ops)
         return [ObjectRef(oid, worker=self) for oid in return_ids]
+
+    def _track_owned(self, spec: dict) -> None:
+        with self._owned_lock:
+            self._owned_specs[spec["task_id"]] = spec
+            for oid in spec["return_ids"]:
+                self._owned_by_ret[oid] = spec["task_id"]
+            while len(self._owned_specs) > 100_000:
+                _, old = self._owned_specs.popitem(last=False)
+                for oid in old["return_ids"]:
+                    self._owned_by_ret.pop(oid, None)
+
+    def _untrack_owned_ret(self, oid: str) -> None:
+        """A return was observed terminal (or its ref released): the task
+        no longer needs owner-side lineage."""
+        with self._owned_lock:
+            tid = self._owned_by_ret.pop(oid, None)
+            if tid is None:
+                return
+            spec = self._owned_specs.get(tid)
+            if spec is not None and not any(
+                    r in self._owned_by_ret for r in spec["return_ids"]):
+                del self._owned_specs[tid]
+
+    def _resubmit_owned(self, ch: protocol.RpcChannel) -> None:
+        """Reconnected to a RESTARTED head: re-seal locally-held arg
+        payloads (slab/shm segments survive the head) and resubmit every
+        in-flight owned spec as one ordered batch."""
+        with self._owned_lock:
+            specs = [dict(s) for s in self._owned_specs.values()]
+        if not specs:
+            return
+        logger.warning("head restart detected: resubmitting %d in-flight "
+                       "owned tasks", len(specs))
+        ops: List[tuple] = []
+        sealed: set = set()
+        for spec in specs:
+            for k in [k for k in spec if k.startswith("_")]:
+                spec.pop(k)
+            for dep in list(spec.get("deps", ())):
+                if dep in sealed or dep in self._owned_by_ret:
+                    continue  # produced by another resubmitted task
+                wire = None
+                slab = self.slab
+                if slab is not None:
+                    wire = slab.get(dep)
+                if wire is not None:
+                    ops.append(("put", {"object_id": dep, "loc": "slab",
+                                        "size": len(wire), "contained": [],
+                                        "transient": True,
+                                        "node_id": self.node_id}))
+                    sealed.add(dep)
+                elif os.path.exists(f"/dev/shm/rtpu_{dep}"):
+                    ops.append(("put", {
+                        "object_id": dep, "loc": "shm",
+                        "size": os.path.getsize(f"/dev/shm/rtpu_{dep}"),
+                        "contained": [], "transient": True,
+                        "node_id": self.node_id}))
+                    sealed.add(dep)
+            ops.append(("spec", spec))
+        ch.send_oneway("submit_batch", client_id=self.worker_id, ops=ops)
+
+    def _buffer_submit(self, spec: dict, releases: List[str],
+                       pre_ops: Optional[List[tuple]] = None) -> None:
+        if not self.is_client:
+            self._track_owned(spec)
+        entries = list(pre_ops or ()) + [("spec", spec)] + \
+            [("rel", o) for o in releases]
+        if self.is_client:
+            # proxied clients: no background flusher thread (their submit
+            # rate never needed batching) — ship immediately
+            self._send_submit_batch(entries)
+            return
+        flush = None
+        with self._submit_lock:
+            self._submit_buf.extend(entries)
+            if not self._submit_first:
+                self._submit_first = time.monotonic()
+            if len(self._submit_buf) >= 64:
+                flush, self._submit_buf = self._submit_buf, []
+                self._submit_first = 0.0
+            elif not self._submit_flusher_on and not self.is_client:
+                self._submit_flusher_on = True
+                threading.Thread(target=self._submit_flusher,
+                                 name="submit-flusher", daemon=True).start()
+        if flush is not None:
+            self._send_submit_batch(flush)
+
+    def _flush_submits(self) -> None:
+        with self._submit_lock:
+            if not self._submit_buf:
+                return
+            flush, self._submit_buf = self._submit_buf, []
+            self._submit_first = 0.0
+        self._send_submit_batch(flush)
+
+    def _send_submit_batch(self, entries: List[Any]) -> None:
+        # ordered op stream: ("put", msg) | ("spec", spec) | ("rel", oid) —
+        # the server applies them in sequence, so an arg-payload put always
+        # lands before the spec that deps on it, and a transient release
+        # always lands after the spec whose dep pin replaces it
+        self.rpc_oneway("submit_batch", ops=entries)
+
+    def _submit_flusher(self) -> None:
+        """Ships a lone buffered submit within ~2ms: fire-and-forget tasks
+        must not wait for a 64-deep batch that may never fill."""
+        while not self._stop.is_set():
+            time.sleep(0.002)
+            with self._submit_lock:
+                due = self._submit_buf and \
+                    time.monotonic() - self._submit_first >= 0.0015
+                if due:
+                    flush, self._submit_buf = self._submit_buf, []
+                    self._submit_first = 0.0
+            if due:
+                try:
+                    self._send_submit_batch(flush)
+                except (OSError, ConnectionError, EOFError):
+                    # transient channel break with the head still alive:
+                    # dropping the batch would lose task submissions for
+                    # good (no epoch change → no resubmission).  Requeue
+                    # at the FRONT (ordering) and re-dial next pass.
+                    self.pool.invalidate()
+                    with self._submit_lock:
+                        self._submit_buf[:0] = flush
+                        if not self._submit_first:
+                            self._submit_first = time.monotonic()
 
     # ---------------------------------------------------------- actor client
     def create_actor(self, cls: Any, args: tuple, kwargs: dict, *,
@@ -772,7 +991,7 @@ class Worker:
             from ray_tpu._private import runtime_env as renv
             runtime_env = renv.prepare(runtime_env, self)
         class_blob_id = self.export_callable(cls)
-        fields, deps, borrows, transient = self._pack_args(args, kwargs)
+        fields, deps, borrows, transient, _ = self._pack_args(args, kwargs)
         from ray_tpu._private.ids import ActorID
         actor_id = ActorID.new()
         task_id = TaskID.new()
@@ -817,7 +1036,7 @@ class Worker:
 
     def call_actor(self, actor_id: str, method: str, args: tuple, kwargs: dict, *,
                    num_returns: int = 1, max_task_retries: int = 0) -> List[ObjectRef]:
-        fields, deps, borrows, transient = self._pack_args(args, kwargs)
+        fields, deps, borrows, transient, _ = self._pack_args(args, kwargs)
         call_id = f"{self.worker_id}:{self._call_seq()}"
         return_ids = [str(ObjectID.make(self.worker_id, KIND_RETURN, self._ret_seq()))
                       for _ in range(num_returns)]
@@ -933,6 +1152,14 @@ class Worker:
                 kind = msg.get("kind")
                 if kind == "cancel":
                     self._cancel_current(msg["task_id"])
+                elif kind == "drop_queued":
+                    # the GCS revoked prepushed specs this worker holds
+                    # but hasn't started (pipeline reclaim, or cancel of
+                    # a queued spec): skip each local copy ONCE — the id
+                    # must not outlive the stale copy, or a legitimate
+                    # later re-dispatch of the same task to this worker
+                    # would be silently skipped and hang its caller
+                    self._dropped_ids.update(msg["task_ids"])
                 elif kind == "dump_stack":
                     # `ray_tpu stack` (reference: py-spy attach): dump all
                     # threads from the reader thread — works mid-task and
@@ -953,7 +1180,19 @@ class Worker:
             if msg is None:
                 break
             if msg["kind"] == "execute_task":
-                self._execute_task(msg["spec"])
+                if msg["spec"]["task_id"] in self._dropped_ids:
+                    self._dropped_ids.discard(msg["spec"]["task_id"])
+                else:
+                    self._execute_task(msg["spec"])
+                # prepushed lease-inheriting batch (one dispatch message
+                # carries the worker's whole pipeline): run back-to-back
+                for spec in msg.get("queued", ()):
+                    if self._stop.is_set():
+                        break
+                    if spec["task_id"] in self._dropped_ids:
+                        self._dropped_ids.discard(spec["task_id"])
+                        continue
+                    self._execute_task(spec)
             elif msg["kind"] == "create_actor":
                 self._become_actor(msg["spec"], tasks)
         sys.exit(0)
@@ -1081,7 +1320,15 @@ class Worker:
             saved_env = self._apply_runtime_env(spec)
             fn = self.fetch_callable(spec["fn_id"])
             args, kwargs = self._unpack_args(spec)
-            value = fn(*args, **kwargs)
+            container = (spec.get("runtime_env") or {}).get("container")
+            if container:
+                # per-task exec prefix: the body runs inside the image
+                # (reference: container runtime-env plugin)
+                from ray_tpu._private import runtime_env as renv
+                value = renv.run_in_container(container, fn, args, kwargs,
+                                              self)
+            else:
+                value = fn(*args, **kwargs)
             results = self._store_results(spec["return_ids"], value,
                                           spec["num_returns"])
             self._send_event({"kind": "task_done", "task_id": spec["task_id"],
@@ -1113,6 +1360,10 @@ class Worker:
         from ray_tpu._private.actor_server import ActorServer
         self._current_spec = spec
         try:
+            if (spec.get("runtime_env") or {}).get("container"):
+                raise exc.RayTpuError(
+                    "runtime_env['container'] applies to tasks; "
+                    "containerized actors are not supported")
             # actor-lifetime runtime env (never restored: process is dedicated)
             self._apply_runtime_env(spec)
             cls = self.fetch_callable(spec["class_blob_id"])

@@ -47,7 +47,12 @@ class StandardAutoscaler:
         return list(resp["task_shapes"]) + list(resp["pg_bundles"])
 
     def _node_utilization(self) -> Dict[str, bool]:
-        """node_id -> is_idle (all resources available == total)."""
+        """provider-node-id -> is_idle (all resources available == total).
+
+        Keyed by BOTH the cluster node id (FakeMultiNodeProvider ids) and
+        the node's ``ray-pod`` label (Kubernetes provider ids are pod
+        names; the provider stamps each pod's agent with its own pod
+        name, see kube.py)."""
         from ray_tpu._private import worker as worker_mod
         nodes = worker_mod.global_worker().rpc("list_nodes")["nodes"]
         out = {}
@@ -57,8 +62,11 @@ class StandardAutoscaler:
             total = {k: v for k, v in n["resources_total"].items()
                      if not k.startswith("node:")}
             avail = n["resources_available"]
-            out[n["node_id"]] = all(
-                avail.get(k, 0.0) >= v for k, v in total.items())
+            idle = all(avail.get(k, 0.0) >= v for k, v in total.items())
+            out[n["node_id"]] = idle
+            pod = (n.get("labels") or {}).get("ray-pod")
+            if pod:
+                out[pod] = idle
         return out
 
     def _counts(self) -> Dict[str, int]:
@@ -80,8 +88,13 @@ class StandardAutoscaler:
             launched = {}
             for t, n in to_launch.items():
                 cfg = self.config.node_types[t]
+                # pass the node type's whole config through (labels, TPU
+                # selectors, pod overrides...), not just resources — the
+                # provider decides what it understands
+                node_cfg = {k: v for k, v in cfg.items()
+                            if k not in ("min_workers", "max_workers")}
                 ids = self.provider.create_node(
-                    {"resources": cfg["resources"]},
+                    node_cfg,
                     {TAG_NODE_KIND: NODE_KIND_WORKER, TAG_NODE_TYPE: t}, n)
                 launched[t] = ids
 

@@ -11,7 +11,7 @@ slice-shaped work therefore scales the right pool.
 from __future__ import annotations
 
 import copy
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 ResourceDict = Dict[str, float]
 

@@ -17,7 +17,7 @@ backpressure.  Structure here:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -47,7 +47,12 @@ class ReadStage(Stage):
 
 
 class MapStage(Stage):
-    """fn: Block -> Block (fusable)."""
+    """fn: Block -> Block (fusable).  ``fusable=False`` (or custom
+    ``remote_args``) makes this stage its own streaming-pipeline operator
+    instead of fusing into its neighbors."""
+
+    fusable = True
+    remote_args: Optional[dict] = None
 
     def __init__(self, fn: Callable[[Block], Block], name="Map"):
         self.fn = fn
@@ -122,7 +127,8 @@ def _fuse(stages: List[Stage]) -> List[Stage]:
     """Merge consecutive MapStages (and into a leading ReadStage)."""
     out: List[Stage] = []
     for st in stages:
-        if isinstance(st, MapStage) and out and isinstance(out[-1], MapStage):
+        if isinstance(st, MapStage) and out and isinstance(out[-1], MapStage) \
+                and st.fusable and out[-1].fusable:
             prev = out.pop()
             fns = getattr(prev, "_fns", [prev.fn]) + \
                 getattr(st, "_fns", [st.fn])
@@ -140,38 +146,18 @@ def _stage_fns(st: MapStage) -> List[Callable]:
 
 def stream_refs(stages: List[Stage],
                 input_refs: Optional[List[Any]] = None) -> Iterator[Any]:
-    """Execute the plan, yielding output block refs lazily (streaming)."""
-    import cloudpickle
-    stages = _fuse(list(stages))
-    ctx = DataContext.get_current()
-    refs: Optional[List[Any]] = input_refs
-    i = 0
-    while i < len(stages):
-        st = stages[i]
-        # collect a maximal run of [Read|refs] + Maps (one fused wave)
-        fns: List[Callable] = []
-        j = i
-        source = None
-        if isinstance(st, ReadStage):
-            source = st
-            j += 1
-        while j < len(stages) and isinstance(stages[j], MapStage):
-            fns.extend(_stage_fns(stages[j]))
-            j += 1
-        fns_blob = cloudpickle.dumps(fns)
+    """Execute the plan, yielding output block refs lazily.
 
-        if j < len(stages):  # barrier next: materialize this wave
-            assert isinstance(stages[j], AllToAllStage)
-            wave_refs = list(_run_wave(source, refs, fns_blob, ctx))
-            refs = _run_shuffle(stages[j], wave_refs)
-            i = j + 1
-            continue
-        # final wave → stream
-        yield from _run_wave(source, refs, fns_blob, ctx)
-        return
-    # plan ended exactly at a barrier
-    for r in refs or []:
-        yield r
+    Runs the operator-pipelined streaming topology (streaming.py): every
+    operator is concurrently in flight with bounded per-operator budgets;
+    map chains stay fused into single tasks (the wave optimizer's win is
+    preserved), non-fusable stages overlap their upstream."""
+    from ray_tpu.data._internal.streaming import build_topology
+    topo = build_topology(stages, input_refs)
+    try:
+        yield from topo
+    finally:
+        topo.stop()
 
 
 def _run_wave(source: Optional[ReadStage], refs: Optional[List[Any]],

@@ -10,7 +10,7 @@ appear only at IO boundaries and in ``batch_format`` conversions.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Sequence
 
 import numpy as np
 

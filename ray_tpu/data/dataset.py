@@ -11,16 +11,15 @@ SURVEY.md §2.4).
 from __future__ import annotations
 
 import itertools
-from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
-                    Tuple, Union)
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 import ray_tpu
 from ray_tpu.data._internal.execution import (
-    AllToAllStage, MapStage, ReadStage, Stage, stream_refs)
+    AllToAllStage, MapStage, Stage, stream_refs)
 from ray_tpu.data.block import (
-    Block, BlockAccessor, VALUE_COL, block_from_rows, concat_blocks)
+    Block, BlockAccessor, block_from_rows, concat_blocks)
 from ray_tpu.data.context import DataContext
 
 
@@ -80,10 +79,19 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy",
+                    num_cpus: Optional[float] = None,
+                    fuse: bool = True,
                     **_compat: Any) -> "Dataset":
-        return self._with_stage(
-            MapStage(_batched_map_fn(fn, batch_size, batch_format),
-                     "MapBatches"))
+        """``num_cpus``/``fuse=False`` make this stage its own pipeline
+        operator (its tasks overlap upstream ingest instead of fusing
+        into it — reference: streaming executor operator boundaries)."""
+        st = MapStage(_batched_map_fn(fn, batch_size, batch_format),
+                      "MapBatches")
+        if num_cpus is not None or not fuse:
+            st.fusable = False
+            st.remote_args = {} if num_cpus is None \
+                else {"num_cpus": num_cpus}
+        return self._with_stage(st)
 
     def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
         def apply(block: Block) -> Block:

@@ -12,7 +12,7 @@ from __future__ import annotations
 import builtins
 import glob as _glob
 import os
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
 import numpy as np
 

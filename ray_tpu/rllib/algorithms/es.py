@@ -9,7 +9,7 @@ No backprop: the whole learner is the jitted perturbation/update math.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -22,8 +22,8 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
 from ray_tpu.rllib.evaluation import synchronous_parallel_sample
 from ray_tpu.rllib.sample_batch import (
-    ACTION_DIST_INPUTS, ACTION_LOGP, ACTIONS, NEXT_OBS, OBS, REWARDS,
-    SampleBatch, TERMINATEDS, VF_PREDS)
+    ACTION_DIST_INPUTS, ACTION_LOGP, NEXT_OBS, OBS, REWARDS,
+    TERMINATEDS, VF_PREDS)
 
 LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
 

@@ -10,7 +10,7 @@ a single jitted call over the whole vector of envs.
 from __future__ import annotations
 
 import collections
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -19,7 +19,7 @@ from ray_tpu.rllib import env as env_lib
 from ray_tpu.rllib.policy import Policy, compute_gae
 from ray_tpu.rllib.sample_batch import (
     ACTIONS, EPS_ID, OBS, NEXT_OBS, REWARDS, SampleBatch, TERMINATEDS,
-    TRUNCATEDS, VF_PREDS, concat_samples)
+    TRUNCATEDS, concat_samples)
 
 
 class RolloutWorker:

@@ -9,7 +9,7 @@ the MXU sees a handful of batched matmuls per update, nothing else.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Sequence, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

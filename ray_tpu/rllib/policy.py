@@ -18,7 +18,7 @@ import numpy as np
 from ray_tpu.rllib import models
 from ray_tpu.rllib.sample_batch import (
     ACTION_DIST_INPUTS, ACTION_LOGP, REWARDS, SampleBatch, TERMINATEDS,
-    TRUNCATEDS, VF_PREDS, ADVANTAGES, VALUE_TARGETS)
+    VF_PREDS, ADVANTAGES, VALUE_TARGETS)
 
 
 class Policy:

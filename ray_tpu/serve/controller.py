@@ -18,7 +18,7 @@ import math
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 import ray_tpu
 from ray_tpu._private import rtlog

@@ -111,12 +111,23 @@ def test_autoscaler_reports_infeasible(ray_start_2_cpus):
 # ---------------------------------------------------------- runtime_env
 
 def test_runtime_env_validation(ray_start_regular):
+    # conda is a supported plugin in r3 — but this host has no conda
+    # binary, so submission fails with the graceful validated-unsupported
+    # error (tests/test_runtime_env_plugins.py covers the supported path
+    # with fake binaries); a truly unknown key still fails as unsupported
     @ray_tpu.remote(runtime_env={"conda": "myenv"})
     def f():
         return 1
 
-    with pytest.raises(ValueError, match="unsupported runtime_env"):
+    with pytest.raises(ValueError, match="validated-unsupported"):
         f.remote()
+
+    @ray_tpu.remote(runtime_env={"docker_image": "x"})
+    def g():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported runtime_env"):
+        g.remote()
 
 
 def test_runtime_env_working_dir(ray_start_regular, tmp_path):

@@ -25,6 +25,13 @@ class Rank:
     def allreduce(self, x):
         return col.allreduce(np.asarray(x, np.float32), self.group)
 
+    def allreduce_op(self, x, op):
+        from ray_tpu.util.collective.types import ReduceOp
+        ops = {"max": ReduceOp.MAX, "min": ReduceOp.MIN,
+               "sum": ReduceOp.SUM}
+        return col.allreduce(np.asarray(x, np.float32), self.group,
+                             op=ops[op])
+
     def allgather(self, x):
         return col.allgather(np.asarray(x, np.float32), self.group)
 
@@ -79,6 +86,27 @@ class TestShmBackend:
         outs = ray_tpu.get([a.allreduce.remote(big) for a in actors])
         for o in outs:
             np.testing.assert_allclose(o, 2 * big)
+
+    def test_allreduce_ring_path(self, ray_start_regular):
+        """≥ RING_THRESHOLD with world > 2 → the chunked ring algorithm
+        (reduce-scatter + all-gather over p2p hops); numerics must match
+        the naive path exactly for SUM of integers-as-floats."""
+        actors = _mk_group(3)
+        n = (4 * 1024 * 1024) // 4 + 7  # just over the ring threshold
+        big = np.arange(n, dtype=np.float32) % 97
+        outs = ray_tpu.get([a.allreduce.remote(big) for a in actors],
+                           timeout=300)
+        for o in outs:
+            np.testing.assert_allclose(o, 3 * big)
+
+    def test_allreduce_ring_max_op(self, ray_start_regular):
+        actors = _mk_group(3)
+        n = (4 * 1024 * 1024) // 4
+        outs = ray_tpu.get(
+            [a.allreduce_op.remote(np.full(n, float(i), np.float32), "max")
+             for i, a in enumerate(actors)], timeout=300)
+        for o in outs:
+            np.testing.assert_allclose(o, np.full(n, 2.0))
 
     def test_allgather_ordering(self, ray_start_regular):
         actors = _mk_group(3)

@@ -7,7 +7,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu.exceptions import (
-    ObjectLostError, OwnerDiedError, RayTaskError, TaskCancelledError,
+    TaskCancelledError,
     WorkerCrashedError,
 )
 

@@ -177,31 +177,38 @@ def test_lease_lineage_schedule_sim(ray_start_regular, monkeypatch):
             msg = conn.inbox.pop(0)
             if msg.get("kind") != "execute_task":
                 continue
-            spec = msg["spec"]
-            roll = rng.random()
-            if roll < 0.75:  # completes
-                head._handle_worker_event(w.worker_id, {
-                    "kind": "task_done", "task_id": spec["task_id"],
-                    "status": "ok",
-                    "results": [{"loc": "inline", "data": b"r", "size": 1,
-                                 "contained": []}
-                                for _ in spec["return_ids"]]})
-                terminal_ok.add(spec["task_id"])
-            elif roll < 0.9:  # app error
-                from ray_tpu._private.serialization import serialize_to_bytes
-                err = ray_tpu.exceptions.RayTaskError("simtask", "boom")
-                head._handle_worker_event(w.worker_id, {
-                    "kind": "task_done", "task_id": spec["task_id"],
-                    "status": "app_error",
-                    "error": serialize_to_bytes(err)[0]})
-                terminal_err.add(spec["task_id"])
-            else:  # worker dies mid-task → retry or failure
-                with head.cv:
-                    head._handle_worker_death(w)
-                workers.remove(w)
-                next_id[0] += 1  # monotonic: two same-iteration deaths
-                # must not mint colliding worker ids
-                workers.append(_add_fake_worker(head, 1000 + next_id[0]))
+            # r3 wire contract: a dispatch message carries the spec plus a
+            # prepushed lease-inheriting batch; the worker runs them in
+            # order, one task_done each (a mid-batch death abandons the
+            # rest — the GCS requeues them from its pipeline view)
+            batch = [msg["spec"]] + list(msg.get("queued", ()))
+            for spec in batch:
+                roll = rng.random()
+                if roll < 0.75:  # completes
+                    head._handle_worker_event(w.worker_id, {
+                        "kind": "task_done", "task_id": spec["task_id"],
+                        "status": "ok",
+                        "results": [{"loc": "inline", "data": b"r",
+                                     "size": 1, "contained": []}
+                                    for _ in spec["return_ids"]]})
+                    terminal_ok.add(spec["task_id"])
+                elif roll < 0.9:  # app error
+                    from ray_tpu._private.serialization import \
+                        serialize_to_bytes
+                    err = ray_tpu.exceptions.RayTaskError("simtask", "boom")
+                    head._handle_worker_event(w.worker_id, {
+                        "kind": "task_done", "task_id": spec["task_id"],
+                        "status": "app_error",
+                        "error": serialize_to_bytes(err)[0]})
+                    terminal_err.add(spec["task_id"])
+                else:  # worker dies mid-task → retry or failure
+                    with head.cv:
+                        head._handle_worker_death(w)
+                    workers.remove(w)
+                    next_id[0] += 1  # monotonic: two same-iteration deaths
+                    # must not mint colliding worker ids
+                    workers.append(_add_fake_worker(head, 1000 + next_id[0]))
+                    break  # the dead worker abandons the rest of its batch
         if it % 7 == 0:
             head._pump()
 
@@ -215,13 +222,13 @@ def test_lease_lineage_schedule_sim(ray_start_regular, monkeypatch):
                 msg = conn.inbox.pop(0)
                 if msg.get("kind") != "execute_task":
                     continue
-                spec = msg["spec"]
-                head._handle_worker_event(w.worker_id, {
-                    "kind": "task_done", "task_id": spec["task_id"],
-                    "status": "ok",
-                    "results": [{"loc": "inline", "data": b"r", "size": 1,
-                                 "contained": []}
-                                for _ in spec["return_ids"]]})
+                for spec in [msg["spec"]] + list(msg.get("queued", ())):
+                    head._handle_worker_event(w.worker_id, {
+                        "kind": "task_done", "task_id": spec["task_id"],
+                        "status": "ok",
+                        "results": [{"loc": "inline", "data": b"r",
+                                     "size": 1, "contained": []}
+                                    for _ in spec["return_ids"]]})
                 moved = True
         if not moved and not head.pending_tasks and not head.running:
             break

@@ -1,0 +1,156 @@
+"""conda/container runtime-env plugins (VERDICT r2 missing #4).
+
+Reference: ``python/ray/_private/runtime_env/`` conda + container plugins
+(SURVEY.md §2.3).  Neither conda nor podman/docker exists in this image,
+so the tests install FAKE binaries on PATH that honor the real invocation
+protocol — the same mock-provider discipline as the kube tests — and the
+no-binary case asserts the graceful validated-unsupported error.
+"""
+
+import os
+import stat
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import runtime_env as renv
+
+FAKE_CONDA = textwrap.dedent("""\
+    #!/bin/bash
+    # fake conda: `conda create -y -p <prefix> pkg...` materializes a
+    # site-packages with one module per requested package
+    prefix=""
+    pkgs=()
+    while [[ $# -gt 0 ]]; do
+      case "$1" in
+        create|-y) shift;;
+        -p) prefix="$2"; shift 2;;
+        *) pkgs+=("$1"); shift;;
+      esac
+    done
+    sp="$prefix/lib/python{pyver}/site-packages"
+    mkdir -p "$sp" "$prefix/bin"
+    for p in "${pkgs[@]}"; do
+      name="${p%%=*}"
+      echo "VERSION = '${p#*=}'" > "$sp/$name.py"
+    done
+    echo fake-tool > "$prefix/bin/faketool"
+    chmod +x "$prefix/bin/faketool"
+""")
+
+FAKE_PODMAN = textwrap.dedent("""\
+    #!/bin/bash
+    # fake podman: `podman run --rm -v host:/rtpu_io image python -c S`
+    # executes the bootstrap locally with /rtpu_io bound via symlink —
+    # validating the real invocation protocol end to end
+    host=""
+    args=()
+    while [[ $# -gt 0 ]]; do
+      case "$1" in
+        run|--rm) shift;;
+        -v) host="${2%%:*}"; shift 2;;
+        *) args+=("$1"); shift;;
+      esac
+    done
+    # args = image python -c script
+    image="${args[0]}"
+    script="${args[3]}"
+    ln -sfn "$host" /rtpu_io
+    RTPU_FAKE_IMAGE="$image" python -c "$script"
+    rc=$?
+    rm -f /rtpu_io
+    exit $rc
+""")
+
+
+@pytest.fixture
+def fake_bins(tmp_path, monkeypatch):
+    pyver = f"{sys.version_info.major}.{sys.version_info.minor}"
+    conda = tmp_path / "conda"
+    conda.write_text(FAKE_CONDA.replace("{pyver}", pyver))
+    conda.chmod(conda.stat().st_mode | stat.S_IEXEC)
+    podman = tmp_path / "podman"
+    podman.write_text(FAKE_PODMAN)
+    podman.chmod(podman.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    yield tmp_path
+
+
+def test_validated_unsupported_without_binaries(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="validated-unsupported"):
+        f.options(runtime_env={"conda": ["numpy"]}).remote()
+    with pytest.raises(ValueError, match="validated-unsupported"):
+        f.options(runtime_env={"container": {"image": "x"}}).remote()
+
+
+def test_conda_env_per_hash_and_module_visibility(ray_start_regular,
+                                                  fake_bins):
+    @ray_tpu.remote
+    def use_pkg():
+        import fakelib  # provided only by the conda env
+        return fakelib.VERSION
+
+    ref = use_pkg.options(
+        runtime_env={"conda": ["fakelib=1.2.3"]}).remote()
+    assert ray_tpu.get(ref, timeout=120) == "1.2.3"
+
+    # pooled worker stays clean: the same fn WITHOUT the env must fail
+    @ray_tpu.remote
+    def no_pkg():
+        try:
+            import fakelib  # noqa: F401
+            return "leaked"
+        except ImportError:
+            return "clean"
+
+    assert ray_tpu.get(no_pkg.remote(), timeout=60) == "clean"
+
+    # cache discipline: same spec → same env dir (one create)
+    from ray_tpu._private import worker as wm
+    w = wm.global_worker()
+    d1 = renv.ensure_conda_env(["fakelib=1.2.3"], w)
+    d2 = renv.ensure_conda_env(["fakelib=1.2.3"], w)
+    assert d1 == d2
+    d3 = renv.ensure_conda_env(["fakelib=2.0"], w)
+    assert d3 != d1
+
+
+def test_conda_env_path_prefix(ray_start_regular, fake_bins):
+    @ray_tpu.remote
+    def which_tool():
+        import shutil
+        return shutil.which("faketool") or ""
+
+    ref = which_tool.options(runtime_env={"conda": ["anything=1"]}).remote()
+    out = ray_tpu.get(ref, timeout=120)
+    assert out.endswith("bin/faketool"), out
+
+
+def test_container_task_runs_in_image(ray_start_regular, fake_bins):
+    @ray_tpu.remote
+    def in_container(x):
+        return (os.environ.get("RTPU_FAKE_IMAGE"), x * 2)
+
+    ref = in_container.options(
+        runtime_env={"container": {"image": "ray-tpu:test"}}).remote(21)
+    image, val = ray_tpu.get(ref, timeout=120)
+    assert image == "ray-tpu:test"  # really ran under the runtime prefix
+    assert val == 42
+
+
+def test_container_task_error_propagates(ray_start_regular, fake_bins):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("inside the container")
+
+    ref = boom.options(
+        runtime_env={"container": "ray-tpu:test"}).remote()
+    with pytest.raises(Exception, match="inside the container"):
+        ray_tpu.get(ref, timeout=120)
