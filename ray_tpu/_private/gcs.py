@@ -103,6 +103,7 @@ class WorkerState:
         # lease; the worker's own task loop executes them in order, so the
         # per-task scheduler round trip overlaps with execution.
         self.pipeline: deque = deque()
+        self.dseq = 0  # dispatch sequence for prepush revocation scoping
         self.actor_id: Optional[str] = None
         self.actor_addr: Optional[str] = None
 
@@ -847,11 +848,13 @@ class GcsServer:
         prepush mark or a later pipeline pop would skip its push and
         strand it."""
         spec.pop("_prepushed", None)
+        spec.pop("_dseq", None)
         self._pending_counts[self._spec_class(spec)] += 1
         self.pending_tasks.append(spec)
 
     def _push_pending_left(self, spec: dict) -> None:
         spec.pop("_prepushed", None)
+        spec.pop("_dseq", None)
         self._pending_counts[self._spec_class(spec)] += 1
         self.pending_tasks.appendleft(spec)
 
@@ -1042,14 +1045,17 @@ class GcsServer:
                         and self._pending_counts["cpu"] \
                         and not self._parallel_capacity():
                     depth = GLOBAL_CONFIG.worker_pipeline_depth
+                    worker.dseq += 1
                     while len(queued) < depth:
                         extra = self._take_matching_pending(req)
                         if extra is None:
                             break
                         extra["_prepushed"] = True
+                        extra["_dseq"] = worker.dseq
                         queued.append(extra)
                     worker.pipeline.extend(queued)
                 if not worker.push({"kind": kind, "spec": spec,
+                                    "dseq": worker.dseq,
                                     "queued": queued}):
                     # push failed: worker died between idle and now
                     self._handle_worker_death(worker)
@@ -1494,12 +1500,12 @@ class GcsServer:
                     # give them back to the scheduler; the worker must
                     # drop its prepushed copies or a respawned-elsewhere
                     # spec would also run here after the unblock
-                    dropped = [s["task_id"] for s in w.pipeline
-                               if s.get("_prepushed")]
+                    dropped = [(s["task_id"], s.get("_dseq"))
+                               for s in w.pipeline if s.get("_prepushed")]
                     while w.pipeline:
                         self._push_pending_left(w.pipeline.pop())
                     if dropped:
-                        w.push({"kind": "drop_queued", "task_ids": dropped})
+                        w.push({"kind": "drop_queued", "pairs": dropped})
                     spec = w.current_task
                     cpu = (spec.get("_req") or {}).get("CPU", 0)
                     if cpu and not spec.get("_cpu_released"):
@@ -1623,11 +1629,13 @@ class GcsServer:
                 # refill the pipeline too, and ship it WITH nxt's push
                 # below (prepushed) — one message re-saturates the worker
                 depth = GLOBAL_CONFIG.worker_pipeline_depth
+                w.dseq += 1
                 while len(refill_queued) < depth:
                     extra = self._take_matching_pending(nxt["_req"])
                     if extra is None:
                         break
                     extra["_prepushed"] = True
+                    extra["_dseq"] = w.dseq
                     refill_queued.append(extra)
                 w.pipeline.extend(refill_queued)
             self._release_task_resources(spec)
@@ -1675,6 +1683,7 @@ class GcsServer:
                 w.current_task = nxt
                 self.running[nxt["task_id"]] = (worker_id, nxt)
                 if not w.push({"kind": "execute_task", "spec": nxt,
+                               "dseq": w.dseq,
                                "queued": refill_queued}):
                     # worker died between done and handoff: the task never
                     # STARTED — reschedule it without consuming its retry
@@ -2177,7 +2186,8 @@ class GcsServer:
                         for w in self.workers.values():
                             if spec in w.pipeline:
                                 w.push({"kind": "drop_queued",
-                                        "task_ids": [tid]})
+                                        "pairs": [(tid,
+                                                   spec.get("_dseq"))]})
                                 break
                     self.cv.notify_all()
                     return {"cancelled": "pending"}

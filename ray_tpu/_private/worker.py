@@ -197,7 +197,9 @@ class Worker:
         self._submit_lock = threading.Lock()
         self._submit_first: float = 0.0
         self._submit_flusher_on = False
-        self._dropped_ids: set = set()  # revoked prepushed specs (skip once)
+        self._dropped_ids: set = set()  # revoked (task_id, dseq) pairs
+        self._oneway_chan: Optional[protocol.RpcChannel] = None
+        self._oneway_init_lock = threading.Lock()
         # Owner-based lineage across head restarts (reference: TaskManager
         # lives in the OWNING worker): every submitted spec is retained
         # until one of its returns is observed terminal or its refs are
@@ -289,6 +291,7 @@ class Worker:
                        GLOBAL_CONFIG.gcs_reconnect_timeout_s)
         while not self._stop.is_set():
             self.pool.invalidate()
+            self._oneway_chan = None  # the ordered oneway channel too
             try:
                 self.pool.channel()
                 logger.info("reconnected to GCS")
@@ -300,7 +303,23 @@ class Worker:
         raise ConnectionError("worker stopping during GCS reconnect")
 
     def rpc_oneway(self, kind: str, **fields: Any) -> None:
-        self.pool.channel().send_oneway(kind, client_id=self.worker_id, **fields)
+        """One-way sends ride ONE shared channel (RpcChannel serializes
+        sends internally), so every oneway in this process is globally
+        FIFO at the server: a release can never overtake the submit whose
+        dep pin it retires even when different threads (e.g. the submit
+        flusher vs the GC) issue them."""
+        ch = self._oneway_chan
+        if ch is None:
+            with self._oneway_init_lock:
+                ch = self._oneway_chan
+                if ch is None:
+                    ch = protocol.RpcChannel(self.open_conn(self.gcs_path))
+                    self._oneway_chan = ch
+        try:
+            ch.send_oneway(kind, client_id=self.worker_id, **fields)
+        except (OSError, ValueError, ConnectionError):
+            self._oneway_chan = None  # re-dial on next use
+            raise
 
     def _tunnel(self, target: str):
         """Open a proxied connection to a cluster-local unix socket."""
@@ -969,8 +988,8 @@ class Worker:
                     # transient channel break with the head still alive:
                     # dropping the batch would lose task submissions for
                     # good (no epoch change → no resubmission).  Requeue
-                    # at the FRONT (ordering) and re-dial next pass.
-                    self.pool.invalidate()
+                    # at the FRONT (ordering); rpc_oneway already dropped
+                    # the dead shared channel, so the next pass re-dials.
                     with self._submit_lock:
                         self._submit_buf[:0] = flush
                         if not self._submit_first:
@@ -1155,11 +1174,15 @@ class Worker:
                 elif kind == "drop_queued":
                     # the GCS revoked prepushed specs this worker holds
                     # but hasn't started (pipeline reclaim, or cancel of
-                    # a queued spec): skip each local copy ONCE — the id
-                    # must not outlive the stale copy, or a legitimate
-                    # later re-dispatch of the same task to this worker
-                    # would be silently skipped and hang its caller
-                    self._dropped_ids.update(msg["task_ids"])
+                    # a queued spec).  Revocations are scoped by the
+                    # DISPATCH sequence the copy arrived under: a stale
+                    # drop (the copy already ran before the revocation
+                    # landed) can then never poison a later legitimate
+                    # re-dispatch of the same task id to this worker.
+                    self._dropped_ids.update(
+                        (t, d) for t, d in msg["pairs"])
+                    while len(self._dropped_ids) > 1024:
+                        self._dropped_ids.pop()
                 elif kind == "dump_stack":
                     # `ray_tpu stack` (reference: py-spy attach): dump all
                     # threads from the reader thread — works mid-task and
@@ -1180,17 +1203,15 @@ class Worker:
             if msg is None:
                 break
             if msg["kind"] == "execute_task":
-                if msg["spec"]["task_id"] in self._dropped_ids:
-                    self._dropped_ids.discard(msg["spec"]["task_id"])
-                else:
-                    self._execute_task(msg["spec"])
+                dseq = msg.get("dseq")
+                self._execute_task(msg["spec"])
                 # prepushed lease-inheriting batch (one dispatch message
                 # carries the worker's whole pipeline): run back-to-back
                 for spec in msg.get("queued", ()):
                     if self._stop.is_set():
                         break
-                    if spec["task_id"] in self._dropped_ids:
-                        self._dropped_ids.discard(spec["task_id"])
+                    if (spec["task_id"], dseq) in self._dropped_ids:
+                        self._dropped_ids.discard((spec["task_id"], dseq))
                         continue
                     self._execute_task(spec)
             elif msg["kind"] == "create_actor":

@@ -248,7 +248,11 @@ class KubernetesNodeProvider(NodeProvider):
             "command": ["python"],
             "args": args,
             "env": env,
-            "resources": {"limits": {}, "requests": {}},
+            # the agent registers --num-cpus with the head; the SAME count
+            # must be requested from Kubernetes or its bin-packing would
+            # place pods onto cores that don't exist
+            "resources": {"limits": {},
+                          "requests": {"cpu": str(int(cpus))}},
         }
         node_selector: Dict[str, str] = {}
         if tpus:

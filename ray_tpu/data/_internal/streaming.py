@@ -52,7 +52,6 @@ class _Op:
         self.emit_seq = 0              # next sequence to emit downstream
         self.upstream_done = False
         self.emitted = 0
-        self.spans: dict = {}          # seq -> [submit_ts, done_ts]
 
     def done(self) -> bool:
         return (self.upstream_done and not self.inq and not self.inflight
@@ -130,7 +129,6 @@ class StreamingTopology:
                             item = op.inq.popleft()
                             ref = op.submit(item)
                             op.inflight[ref] = op.next_seq
-                            op.spans[op.next_seq] = [_time.monotonic(), None]
                             op.next_seq += 1
                             progress = True
                     # emit completed outputs downstream, in order
@@ -168,16 +166,11 @@ class StreamingTopology:
                     continue
                 done, _ = ray_tpu.wait(inflight, num_returns=1,
                                        timeout=0.2)
-                import time as _t
-                now = _t.monotonic()
                 for ref in done:
                     for op in self.ops:
                         seq = op.inflight.pop(ref, None)
                         if seq is not None:
                             op.results[seq] = ref
-                            sp = op.spans.pop(seq, None)
-                            if sp is not None:
-                                sp[1] = now
                             break
         except BaseException as e:  # noqa: BLE001 - surfaced to consumer
             self.error = e

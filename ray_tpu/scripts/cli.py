@@ -258,6 +258,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--quick", action="store_true")
     sp.set_defaults(fn=cmd_microbenchmark)
 
+    sp = sub.add_parser("operator", help="reconcile a declarative cluster "
+                        "spec into Kubernetes pods (KubeRay-operator "
+                        "equivalent)")
+    sp.add_argument("--spec", required=True)
+    sp.add_argument("--interval", type=float, default=5.0)
+    sp.add_argument("--api-server", default=None)
+    sp.add_argument("--namespace", default=None)
+    sp.add_argument("--head-address", default=None)
+    sp.set_defaults(fn=lambda a: __import__(
+        "ray_tpu.autoscaler.operator", fromlist=["main"]).main(
+            ["--spec", a.spec, "--interval", str(a.interval)]
+            + (["--api-server", a.api_server] if a.api_server else [])
+            + (["--namespace", a.namespace] if a.namespace else [])
+            + (["--head-address", a.head_address] if a.head_address
+               else [])))
+
     sp = sub.add_parser("version")
     sp.set_defaults(fn=cmd_version)
     return p

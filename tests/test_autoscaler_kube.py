@@ -242,3 +242,56 @@ def test_e2e_scale_up_schedule_scale_down(ray_start_regular):
     finally:
         srv.stop()
         proxy.stop()
+
+
+# ------------------------------------------------------- operator (KubeRay)
+def test_operator_reconciles_groups(fake_kube):
+    """Declarative spec → pods: create to target, scale down, drop removed
+    groups (the KubeRay-operator contract, SURVEY.md §2.6 deploy row)."""
+    from ray_tpu.autoscaler.operator import RayClusterOperator
+
+    prov = _provider(fake_kube)
+    spec = {"cluster_name": "t", "worker_groups": [
+        {"name": "cpu", "replicas": 2,
+         "node_config": {"resources": {"CPU": 2}}},
+        {"name": "v5e", "replicas": 1,
+         "node_config": {"resources": {"CPU": 8, "TPU": 4},
+                         "tpu_accelerator": "tpu-v5-lite-podslice",
+                         "tpu_topology": "2x4"}}]}
+    op = RayClusterOperator(prov, spec=spec)
+    r1 = op.reconcile()
+    assert len(r1["created"]["cpu"]) == 2
+    assert len(r1["created"]["v5e"]) == 1
+    assert r1["groups"]["cpu"]["current"] == 2
+    # TPU group pods carry the GKE selectors
+    pod = fake_kube.pods[r1["created"]["v5e"][0]]
+    assert pod["spec"]["nodeSelector"][
+        "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+
+    # idempotent: a second pass changes nothing
+    r2 = op.reconcile()
+    assert not r2["created"] and not r2["deleted"]
+
+    # scale down cpu to 1; remove the v5e group entirely
+    op.update_spec({"cluster_name": "t", "worker_groups": [
+        {"name": "cpu", "replicas": 1,
+         "node_config": {"resources": {"CPU": 2}}}]})
+    r3 = op.reconcile()
+    assert len(r3["deleted"]["cpu"]) == 1
+    assert len(r3["deleted"]["v5e"]) == 1
+    assert sorted(prov.node_tags(p)["node-type"]
+                  for p in prov.non_terminated_nodes({})) == ["cpu"]
+
+
+def test_operator_autoscaling_group_left_to_autoscaler(fake_kube):
+    from ray_tpu.autoscaler.operator import RayClusterOperator
+
+    prov = _provider(fake_kube)
+    op = RayClusterOperator(prov, spec={"cluster_name": "t",
+        "worker_groups": [{"name": "elastic",
+                           "autoscaling": {"min_replicas": 0,
+                                           "max_replicas": 4},
+                           "node_config": {"resources": {"CPU": 1}}}]})
+    r = op.reconcile()
+    assert r["groups"]["elastic"]["managed_by"] == "autoscaler"
+    assert not r["created"]  # operator does not touch autoscaled groups

@@ -1,0 +1,60 @@
+"""dask-on-ray scheduler shim + ParallelIterator (SURVEY.md §2.3
+ray.util misc; VERDICT r2 missing #7)."""
+
+from operator import add, mul
+
+import ray_tpu
+from ray_tpu.util import iter as rit
+from ray_tpu.util.dask import ray_dask_get
+
+
+def test_dask_graph_executes_with_shared_deps(ray_start_regular):
+    dsk = {
+        "a": 1,
+        "b": (add, "a", 2),            # 3
+        "c": (mul, "b", "b"),          # 9 — 'b' computed once, shared
+        "d": (add, (mul, "b", 10), "c"),  # 39 (nested task)
+    }
+    assert ray_dask_get(dsk, "d") == 39
+    assert ray_dask_get(dsk, ["b", "c", ["a", "d"]]) == [3, 9, [1, 39]]
+
+
+def test_dask_graph_cycle_detected(ray_start_regular):
+    import pytest
+    with pytest.raises(ValueError, match="cycle|unresolvable"):
+        ray_dask_get({"x": (add, "y", 1), "y": (add, "x", 1)}, "x")
+
+
+def test_parallel_iterator_for_each_gather_sync(ray_start_regular):
+    it = rit.from_range(20, num_shards=3).for_each(lambda x: x * 2)
+    assert sorted(it.gather_sync()) == [x * 2 for x in range(20)]
+
+
+def test_parallel_iterator_chain_and_async(ray_start_regular):
+    it = (rit.from_items(list(range(30)), num_shards=2)
+          .filter(lambda x: x % 2 == 0)
+          .for_each(lambda x: x + 1)
+          .batch(4))
+    batches = list(it.gather_async())
+    flat = [x for b in batches for x in b]
+    assert sorted(flat) == [x + 1 for x in range(0, 30, 2)]
+    assert all(len(b) <= 4 for b in batches)
+
+
+def test_parallel_iterator_take_and_shards(ray_start_regular):
+    it = rit.from_range(100, num_shards=4)
+    assert it.num_shards() == 4
+    assert len(it.take(10)) == 10
+    assert sorted(it) == list(range(100))
+
+
+def test_dask_tuple_keys(ray_start_regular):
+    """Collection-style tuple keys (('x', i)) — the ubiquitous dask
+    chunk-key shape — must resolve as dependencies."""
+    dsk = {
+        ("x", 0): (add, 1, 2),
+        ("x", 1): (add, 10, 20),
+        "total": (add, ("x", 0), ("x", 1)),
+    }
+    assert ray_dask_get(dsk, "total") == 33
+    assert ray_dask_get(dsk, [("x", 0), ("x", 1)]) == [3, 30]
