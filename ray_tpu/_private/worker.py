@@ -197,7 +197,10 @@ class Worker:
         self._submit_lock = threading.Lock()
         self._submit_first: float = 0.0
         self._submit_flusher_on = False
-        self._dropped_ids: set = set()  # revoked (task_id, dseq) pairs
+        # revoked (task_id, dseq) pairs, insertion-ordered so overflow
+        # evicts the OLDEST revocation (an arbitrary set.pop could evict
+        # the pair a drop_queued just added, un-revoking it)
+        self._dropped_ids: "OrderedDict[tuple, None]" = OrderedDict()
         self._oneway_chan: Optional[protocol.RpcChannel] = None
         self._oneway_init_lock = threading.Lock()
         # Owner-based lineage across head restarts (reference: TaskManager
@@ -246,6 +249,9 @@ class Worker:
                 # surviving worker's late result for the same task seals
                 # the same return ids, which the seal path tolerates)
                 self._gcs_epoch = epoch
+                # the new head's per-worker dispatch sequences restart:
+                # stale revocations must not swallow re-dispatched tasks
+                self._dropped_ids.clear()
                 self._resubmit_owned(ch)
 
     # Two-way RPC kinds that MUTATE server state: these carry a _dedup id
@@ -1179,10 +1185,10 @@ class Worker:
                     # drop (the copy already ran before the revocation
                     # landed) can then never poison a later legitimate
                     # re-dispatch of the same task id to this worker.
-                    self._dropped_ids.update(
-                        (t, d) for t, d in msg["pairs"])
+                    for t, d in msg["pairs"]:
+                        self._dropped_ids[(t, d)] = None
                     while len(self._dropped_ids) > 1024:
-                        self._dropped_ids.pop()
+                        self._dropped_ids.popitem(last=False)
                 elif kind == "dump_stack":
                     # `ray_tpu stack` (reference: py-spy attach): dump all
                     # threads from the reader thread — works mid-task and
@@ -1211,7 +1217,7 @@ class Worker:
                     if self._stop.is_set():
                         break
                     if (spec["task_id"], dseq) in self._dropped_ids:
-                        self._dropped_ids.discard((spec["task_id"], dseq))
+                        self._dropped_ids.pop((spec["task_id"], dseq), None)
                         continue
                     self._execute_task(spec)
             elif msg["kind"] == "create_actor":

@@ -57,6 +57,9 @@ class RayClusterOperator:
     def update_spec(self, spec: Dict[str, Any]) -> None:
         with self._lock:
             self._spec = spec
+            # an explicit programmatic update overrides a file source —
+            # silently preferring the stale file would make this a no-op
+            self.spec_path = None
 
     # ------------------------------------------------------------- reconcile
     def _group_pods(self, group: str) -> List[str]:

@@ -1,0 +1,286 @@
+"""Experimental: shared-memory channels + compiled actor DAGs.
+
+Reference: ``python/ray/experimental/channel`` + compiled graphs (aDAG)
+— newer-vintage upstream features (SURVEY.md §2.6): a ``Channel`` is a
+pre-allocated single-producer/single-consumer transport that bypasses
+the control plane entirely, and a compiled graph pre-wires channels
+through a static DAG of actor methods so repeated executions pay zero
+per-call scheduling.
+
+TPU-first framing: the compiled in-mesh program already IS the compiled
+dataflow for device work; these channels cover the HOST side — e.g.
+feeding an inference actor chain at high rate without per-call
+control-plane messages.
+
+``Channel``: a /dev/shm ring buffer (mmap) with head/tail counters and
+spin-then-sleep waits; payloads are pickled objects.  Same-host only —
+exactly the reference's primary (shared-memory) channel; cross-host
+channels fall back to the normal actor-call path when compiled.
+
+``compile_chain``: the aDAG-lite — a linear pipeline of actor methods.
+Each hop gets a channel; each actor runs a pump thread reading its
+input channel, applying the bound method, writing its output channel.
+``execute()`` writes the input channel and reads the final output —
+no task submission, no GCS traffic, per-hop latency is a shm write +
+wakeup.  All chain actors must live on the driver's host (the channel
+re-attach fails with a clear error otherwise); cross-host stages should
+use the normal actor-call path.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import time
+import uuid
+from typing import Any, List, Optional
+
+import ray_tpu
+
+_HDR = struct.Struct("<QQ")          # head (write cursor), tail (read cursor)
+_LEN = struct.Struct("<I")
+
+
+class Channel:
+    """SPSC ring buffer over a /dev/shm segment.
+
+    One writer process, one reader process; ``put`` blocks while full,
+    ``get`` blocks while empty (spin briefly, then sleep-poll — the
+    reference channel uses the same wait shape)."""
+
+    def __init__(self, capacity_bytes: int = 4 * 1024 * 1024,
+                 name: Optional[str] = None, create: bool = True):
+        self.name = name or f"rtpu_chan_{uuid.uuid4().hex[:12]}"
+        self.capacity = capacity_bytes
+        path = f"/dev/shm/{self.name}"
+        if create:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, _HDR.size + capacity_bytes)
+            finally:
+                os.close(fd)
+        self._attach()
+
+    def _attach(self) -> None:
+        path = f"/dev/shm/{self.name}"
+        try:
+            fd = os.open(path, os.O_RDWR)
+        except FileNotFoundError:
+            raise RuntimeError(
+                f"channel segment {path} not found: shm channels are "
+                f"same-host only — this process is not on the creating "
+                f"host (use normal actor calls for cross-host stages)")
+        try:
+            size = os.fstat(fd).st_size
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self.capacity = len(self._mm) - _HDR.size
+
+    # channels pickle by name: the receiving process re-attaches
+    def __getstate__(self):
+        return {"name": self.name, "capacity": self.capacity}
+
+    def __setstate__(self, st):
+        self.name = st["name"]
+        self.capacity = st["capacity"]
+        self._attach()
+
+    # ------------------------------------------------------------------ ring
+    def _cursors(self):
+        return _HDR.unpack_from(self._mm, 0)
+
+    def _set_head(self, v: int) -> None:
+        struct.pack_into("<Q", self._mm, 0, v)
+
+    def _set_tail(self, v: int) -> None:
+        struct.pack_into("<Q", self._mm, 8, v)
+
+    def _write_bytes(self, off: int, data: bytes) -> None:
+        base = _HDR.size
+        pos = off % self.capacity
+        first = min(len(data), self.capacity - pos)
+        self._mm[base + pos:base + pos + first] = data[:first]
+        if first < len(data):
+            self._mm[base:base + len(data) - first] = data[first:]
+
+    def _read_bytes(self, off: int, n: int) -> bytes:
+        base = _HDR.size
+        pos = off % self.capacity
+        first = min(n, self.capacity - pos)
+        out = bytes(self._mm[base + pos:base + pos + first])
+        if first < n:
+            out += bytes(self._mm[base:base + n - first])
+        return out
+
+    def _wait(self, cond, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while True:
+            v = cond()
+            if v is not None:
+                return v
+            spins += 1
+            if spins < 200:      # ~burst latency: pure spin
+                continue
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name} wait timed out")
+            time.sleep(0.0002)
+
+    # ------------------------------------------------------------------- api
+    def put(self, value: Any, timeout: Optional[float] = None) -> None:
+        data = pickle.dumps(value, protocol=5)
+        need = _LEN.size + len(data)
+        if need > self.capacity:
+            raise ValueError(f"object of {len(data)}B exceeds channel "
+                             f"capacity {self.capacity}B")
+
+        def has_room():
+            head, tail = self._cursors()
+            return head if self.capacity - (head - tail) >= need else None
+
+        head = self._wait(has_room, timeout)
+        self._write_bytes(head, _LEN.pack(len(data)))
+        self._write_bytes(head + _LEN.size, data)
+        self._set_head(head + need)   # publish after the payload is in
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        def has_item():
+            head, tail = self._cursors()
+            return tail if head - tail >= _LEN.size else None
+
+        tail = self._wait(has_item, timeout)
+        (n,) = _LEN.unpack(self._read_bytes(tail, _LEN.size))
+
+        def full_item():
+            head, _ = self._cursors()
+            return tail if head - tail >= _LEN.size + n else None
+
+        self._wait(full_item, timeout)
+        data = self._read_bytes(tail + _LEN.size, n)
+        value = pickle.loads(data)
+        self._set_tail(tail + _LEN.size + n)
+        return value
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass
+
+    def destroy(self) -> None:
+        self.close()
+        try:
+            os.unlink(f"/dev/shm/{self.name}")
+        except OSError:
+            pass
+
+
+def _pump(instance, method_name: str, in_chan: Channel, out_chan: Channel,
+          stop_flag: dict) -> None:
+    method = getattr(instance, method_name)
+    while not stop_flag.get("stop"):
+        try:
+            item = in_chan.get(timeout=0.5)
+        except TimeoutError:
+            continue
+        if isinstance(item, _Stop):
+            out_chan.put(item)
+            return
+        if isinstance(item, _Err):
+            out_chan.put(item)  # forward the ORIGINAL upstream error —
+            continue            # feeding it to this stage would mask it
+        try:
+            out_chan.put(method(item))
+        except Exception as e:  # noqa: BLE001 - surfaced to the caller
+            out_chan.put(_Err(e))
+
+
+class _Stop:
+    pass
+
+
+class _Err:
+    def __init__(self, e: BaseException):
+        self.e = e
+
+
+class CompiledChain:
+    """A pre-wired pipeline: input channel → actor method → ... → output.
+
+    ``execute`` is synchronous; ``execute_async``/``result`` overlap
+    pipeline stages across consecutive inputs (each hop has its own
+    channel, so N in-flight items occupy N stages concurrently)."""
+
+    def __init__(self, actors: List[Any], methods: List[str],
+                 capacity_bytes: int = 4 * 1024 * 1024):
+        assert len(actors) == len(methods) and actors
+        self._chans = [Channel(capacity_bytes)
+                       for _ in range(len(actors) + 1)]
+        self._actors = actors
+        self._inflight = 0
+        # start a pump thread inside every actor (same-host shm channels)
+        refs = []
+        for i, (a, m) in enumerate(zip(actors, methods)):
+            refs.append(a.rtpu_channel_pump_start.remote(
+                m, self._chans[i], self._chans[i + 1]))
+        ray_tpu.get(refs)  # pumps running before first execute
+
+    def execute(self, value: Any, timeout: Optional[float] = 60.0) -> Any:
+        self.execute_async(value)
+        return self.result(timeout=timeout)
+
+    def execute_async(self, value: Any) -> None:
+        self._chans[0].put(value)
+        self._inflight += 1
+
+    def result(self, timeout: Optional[float] = 60.0) -> Any:
+        if self._inflight <= 0:
+            raise RuntimeError("no execution in flight")
+        out = self._chans[-1].get(timeout=timeout)
+        self._inflight -= 1
+        if isinstance(out, _Err):
+            raise out.e
+        return out
+
+    def teardown(self) -> None:
+        try:
+            self._chans[0].put(_Stop(), timeout=1.0)
+            self._chans[-1].get(timeout=5.0)  # drained through every stage
+        except (TimeoutError, OSError):
+            pass
+        for c in self._chans:
+            c.destroy()
+
+
+def enable_channels(actor_cls):
+    """Class decorator: adds the channel-pump entry point to an actor.
+
+    (The reference injects its accelerated-DAG machinery into every
+    actor; here opting in is explicit.)"""
+    def rtpu_channel_pump_start(self, method, in_chan, out_chan):
+        import threading
+        flag = {}
+        t = threading.Thread(target=_pump,
+                             args=(self, method, in_chan, out_chan, flag),
+                             daemon=True, name="channel-pump")
+        t.start()
+        if not hasattr(self, "_rtpu_pump_flags"):
+            self._rtpu_pump_flags = []
+        self._rtpu_pump_flags.append(flag)
+        return True
+
+    actor_cls.rtpu_channel_pump_start = rtpu_channel_pump_start
+    return actor_cls
+
+
+def compile_chain(bindings: List[tuple],
+                  capacity_bytes: int = 4 * 1024 * 1024) -> CompiledChain:
+    """``bindings``: [(actor_handle, "method"), ...] — a linear DAG.
+    Actor classes must be decorated with ``@enable_channels`` (below
+    ``@ray_tpu.remote``)."""
+    actors = [a for a, _ in bindings]
+    methods = [m for _, m in bindings]
+    return CompiledChain(actors, methods, capacity_bytes)

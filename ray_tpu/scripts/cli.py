@@ -258,21 +258,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--quick", action="store_true")
     sp.set_defaults(fn=cmd_microbenchmark)
 
-    sp = sub.add_parser("operator", help="reconcile a declarative cluster "
-                        "spec into Kubernetes pods (KubeRay-operator "
-                        "equivalent)")
-    sp.add_argument("--spec", required=True)
-    sp.add_argument("--interval", type=float, default=5.0)
-    sp.add_argument("--api-server", default=None)
-    sp.add_argument("--namespace", default=None)
-    sp.add_argument("--head-address", default=None)
-    sp.set_defaults(fn=lambda a: __import__(
-        "ray_tpu.autoscaler.operator", fromlist=["main"]).main(
-            ["--spec", a.spec, "--interval", str(a.interval)]
-            + (["--api-server", a.api_server] if a.api_server else [])
-            + (["--namespace", a.namespace] if a.namespace else [])
-            + (["--head-address", a.head_address] if a.head_address
-               else [])))
+    sp = sub.add_parser("operator", add_help=False,
+                        help="reconcile a declarative cluster spec into "
+                        "Kubernetes pods (KubeRay-operator equivalent); "
+                        "flags are the operator's own (--spec, ...)")
+    # flags are parsed by the operator itself (main() intercepts this
+    # subcommand before argparse — the operator owns its flag surface,
+    # duplicating it here would drift)
+    sp.set_defaults(fn=lambda a: 0)
 
     sp = sub.add_parser("version")
     sp.set_defaults(fn=cmd_version)
@@ -280,6 +273,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "operator":
+        # passthrough: the operator parses its own flags (incl. --help)
+        from ray_tpu.autoscaler import operator as operator_mod
+        return operator_mod.main(argv[1:])
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -85,8 +85,18 @@ def ray_dask_get(dsk: Dict, keys: Union[Sequence, Any], **_: Any):
     import cloudpickle
 
     refs: Dict[Any, Any] = {}
-    # resolve in dependency order (graphs are DAGs; cycles are an error)
-    remaining = dict(dsk)
+    # literals (plain values, no task/key content) never need a remote
+    # task — dask collection graphs carry hundreds of them; computing the
+    # dependency map ONCE keeps chains O(V+E) instead of O(V^2)
+    remaining: Dict[Any, Any] = {}
+    dep_map: Dict[Any, set] = {}
+    for key, comp in dsk.items():
+        deps = _deps_of(comp, dsk)
+        if not deps and not _is_task(comp) and not isinstance(comp, list):
+            refs[key] = ray_tpu.put(comp)
+        else:
+            remaining[key] = comp
+            dep_map[key] = deps
     guard = len(remaining) + 1
     while remaining:
         guard -= 1
@@ -94,7 +104,7 @@ def ray_dask_get(dsk: Dict, keys: Union[Sequence, Any], **_: Any):
             raise ValueError("cycle detected in dask graph")
         progressed = []
         for key, comp in remaining.items():
-            deps = _deps_of(comp, dsk)
+            deps = dep_map[key]
             if any(d in remaining for d in deps):
                 continue
             dep_keys = sorted(deps, key=repr)

@@ -201,6 +201,21 @@ def collect_cluster() -> Dict[str, dict]:
                 dst["series"].append(
                     {"tags": {**s["tags"], "worker": wid},
                      "value": s["value"]})
+    # native slab-store counters (reference: src/ray/stats/ metrics in the
+    # plasma/raylet process — SURVEY.md §2.1 Stats row): the C++ store
+    # keeps hits/misses/allocs/fails in its shared header; surface them as
+    # first-class gauges so `ray_tpu metrics` / Prometheus see the native
+    # data plane, not just Python-side registries.
+    slab = w.slab
+    if slab is not None:
+        try:
+            for name, val in slab.stats().items():
+                merged[f"rtpu_native_store_{name}"] = {
+                    "kind": "gauge",
+                    "description": f"native slab store {name}",
+                    "series": [{"tags": {}, "value": float(val)}]}
+        except Exception:  # noqa: BLE001 - store detached mid-collect
+            pass
     return merged
 
 

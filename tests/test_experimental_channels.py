@@ -1,0 +1,128 @@
+"""Experimental shm channels + compiled actor chains (SURVEY.md §2.6
+experimental/ row: the channels / compiled-graphs analog)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental.channels import (
+    Channel, compile_chain, enable_channels)
+
+
+def test_channel_same_process_roundtrip(ray_start_regular):
+    ch = Channel(capacity_bytes=1 << 16)
+    try:
+        ch.put({"a": 1})
+        ch.put(np.arange(100))
+        assert ch.get() == {"a": 1}
+        np.testing.assert_array_equal(ch.get(), np.arange(100))
+        with pytest.raises(TimeoutError):
+            ch.get(timeout=0.1)
+    finally:
+        ch.destroy()
+
+
+def test_channel_wraparound_and_capacity(ray_start_regular):
+    ch = Channel(capacity_bytes=4096)
+    try:
+        for i in range(50):  # forces multiple ring wraps
+            ch.put(bytes([i % 256]) * 900)
+            assert ch.get() == bytes([i % 256]) * 900
+        with pytest.raises(ValueError):
+            ch.put(b"x" * 8192)  # larger than the ring
+    finally:
+        ch.destroy()
+
+
+def test_channel_cross_process(ray_start_regular):
+    ch_in = Channel()
+    ch_out = Channel()
+
+    @ray_tpu.remote
+    class Echo:
+        def pump_once(self, cin, cout):
+            cout.put(cin.get(timeout=30) * 2)
+            return True
+
+    e = Echo.remote()
+    ref = e.pump_once.remote(ch_in, ch_out)
+    ch_in.put(21)
+    assert ch_out.get(timeout=30) == 42
+    assert ray_tpu.get(ref, timeout=30)
+    ch_in.destroy()
+    ch_out.destroy()
+
+
+def test_compiled_chain_executes_and_pipelines(ray_start_regular):
+    @ray_tpu.remote
+    @enable_channels
+    class Stage:
+        def __init__(self, add):
+            self.add = add
+
+        def f(self, x):
+            return x + self.add
+
+    a = Stage.remote(1)
+    b = Stage.remote(10)
+    c = Stage.remote(100)
+    g = compile_chain([(a, "f"), (b, "f"), (c, "f")])
+    try:
+        assert g.execute(0) == 111
+        assert g.execute(5) == 116
+        # pipelined: N in-flight items flow without per-call submission
+        for i in range(20):
+            g.execute_async(i)
+        outs = [g.result(timeout=60) for _ in range(20)]
+        assert outs == [i + 111 for i in range(20)]
+    finally:
+        g.teardown()
+
+
+def test_compiled_chain_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    @enable_channels
+    class Boom:
+        def f(self, x):
+            raise ValueError("stage blew up")
+
+    g = compile_chain([(Boom.remote(), "f")])
+    try:
+        with pytest.raises(ValueError, match="stage blew up"):
+            g.execute(1)
+    finally:
+        g.teardown()
+
+
+def test_compiled_chain_faster_than_actor_calls(ray_start_regular):
+    """The point of compiled graphs: repeated execution beats the
+    per-call path (here: two-stage chain vs chained actor calls)."""
+    @ray_tpu.remote
+    @enable_channels
+    class S:
+        def f(self, x):
+            return x + 1
+
+    a, b = S.remote(), S.remote()
+    # warm the normal path
+    ray_tpu.get(b.f.remote(ray_tpu.get(a.f.remote(0))), timeout=60)
+    n = 50
+    t0 = time.perf_counter()
+    for i in range(n):
+        ray_tpu.get(b.f.remote(ray_tpu.get(a.f.remote(i))), timeout=60)
+    t_calls = time.perf_counter() - t0
+
+    g = compile_chain([(a, "f"), (b, "f")])
+    try:
+        g.execute(0)  # warm
+        t0 = time.perf_counter()
+        for i in range(n):
+            g.execute_async(i)
+        outs = [g.result(timeout=60) for _ in range(n)]
+        t_chain = time.perf_counter() - t0
+        assert outs == [i + 2 for i in range(n)]
+        assert t_chain < t_calls, (t_chain, t_calls)
+    finally:
+        g.teardown()

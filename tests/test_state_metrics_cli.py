@@ -180,3 +180,22 @@ def test_stack_dump(ray_start_regular):
     joined = "\n".join(resp["stacks"].values())
     assert "sleepy" in joined or "sleep" in joined
     ray_tpu.cancel(ref)
+
+
+def test_native_store_metrics_exported(ray_start_regular):
+    """SURVEY.md §2.1 Stats row: the C++ slab store's own counters
+    (shared-header hits/misses/allocs/fails) surface as cluster gauges."""
+    import numpy as np
+
+    from ray_tpu.util import metrics
+
+    refs = [ray_tpu.put(np.zeros(20000)) for _ in range(3)]
+    _ = ray_tpu.get(refs)
+    m = metrics.collect_cluster()
+    native = {k: v["series"][0]["value"] for k, v in m.items()
+              if k.startswith("rtpu_native_store_")}
+    assert native.get("rtpu_native_store_allocs", 0) >= 3
+    assert native.get("rtpu_native_store_heap_size", 0) > 0
+    # and they render as prometheus text
+    text = metrics.prometheus_text(m)
+    assert "rtpu_native_store_allocs" in text
