@@ -181,21 +181,41 @@ class Channel:
 def _pump(instance, method_name: str, in_chan: Channel, out_chan: Channel,
           stop_flag: dict) -> None:
     method = getattr(instance, method_name)
+
+    def put_checked(item) -> bool:
+        """Bounded put that honors the stop flag while the ring is full
+        (an unbounded put would strand this thread forever if the
+        downstream consumer died)."""
+        while not stop_flag.get("stop"):
+            try:
+                out_chan.put(item, timeout=0.5)
+                return True
+            except TimeoutError:
+                continue
+        return False
+
     while not stop_flag.get("stop"):
         try:
             item = in_chan.get(timeout=0.5)
         except TimeoutError:
             continue
         if isinstance(item, _Stop):
-            out_chan.put(item)
+            put_checked(item)
             return
         if isinstance(item, _Err):
-            out_chan.put(item)  # forward the ORIGINAL upstream error —
+            put_checked(item)   # forward the ORIGINAL upstream error —
             continue            # feeding it to this stage would mask it
         try:
-            out_chan.put(method(item))
+            out = method(item)
         except Exception as e:  # noqa: BLE001 - surfaced to the caller
-            out_chan.put(_Err(e))
+            try:
+                put_checked(_Err(e))
+            except Exception:   # noqa: BLE001 - unpicklable exception:
+                # a crashed pump would wedge the chain with no diagnosis
+                put_checked(_Err(RuntimeError(
+                    f"stage {method_name} error (unpicklable): {e!r}")))
+            continue
+        put_checked(out)
 
 
 class _Stop:
@@ -232,8 +252,11 @@ class CompiledChain:
         self.execute_async(value)
         return self.result(timeout=timeout)
 
-    def execute_async(self, value: Any) -> None:
-        self._chans[0].put(value)
+    def execute_async(self, value: Any,
+                      timeout: Optional[float] = 60.0) -> None:
+        # bounded: a dead/stalled first stage must surface as a
+        # TimeoutError here, not an unkillable spin in the ring wait
+        self._chans[0].put(value, timeout=timeout)
         self._inflight += 1
 
     def result(self, timeout: Optional[float] = 60.0) -> Any:
@@ -250,6 +273,14 @@ class CompiledChain:
             self._chans[0].put(_Stop(), timeout=1.0)
             self._chans[-1].get(timeout=5.0)  # drained through every stage
         except (TimeoutError, OSError):
+            pass
+        # belt and braces: raise every pump's stop flag too — if the
+        # _Stop could not flow (full ring, dead stage) the threads exit
+        # at their next 0.5s poll instead of leaking forever
+        try:
+            ray_tpu.get([a.rtpu_channel_pump_stop.remote()
+                         for a in self._actors], timeout=10)
+        except Exception:  # noqa: BLE001 - actor may already be dead
             pass
         for c in self._chans:
             c.destroy()
@@ -272,7 +303,13 @@ def enable_channels(actor_cls):
         self._rtpu_pump_flags.append(flag)
         return True
 
+    def rtpu_channel_pump_stop(self):
+        for flag in getattr(self, "_rtpu_pump_flags", []):
+            flag["stop"] = True
+        return True
+
     actor_cls.rtpu_channel_pump_start = rtpu_channel_pump_start
+    actor_cls.rtpu_channel_pump_stop = rtpu_channel_pump_stop
     return actor_cls
 
 

@@ -206,14 +206,18 @@ def collect_cluster() -> Dict[str, dict]:
     # keeps hits/misses/allocs/fails in its shared header; surface them as
     # first-class gauges so `ray_tpu metrics` / Prometheus see the native
     # data plane, not just Python-side registries.
+    # (the slab is per-HOST shared state — one series tagged with the
+    # collecting node, not one per worker; remote agent hosts use spools,
+    # not slabs, so this meters the head-host store)
     slab = w.slab
     if slab is not None:
         try:
             for name, val in slab.stats().items():
                 merged[f"rtpu_native_store_{name}"] = {
                     "kind": "gauge",
-                    "description": f"native slab store {name}",
-                    "series": [{"tags": {}, "value": float(val)}]}
+                    "description": f"native slab store {name} (head host)",
+                    "series": [{"tags": {"node": str(w.node_id)[:8]},
+                                "value": float(val)}]}
         except Exception:  # noqa: BLE001 - store detached mid-collect
             pass
     return merged
