@@ -215,7 +215,13 @@ def _pump(instance, method_name: str, in_chan: Channel, out_chan: Channel,
                 put_checked(_Err(RuntimeError(
                     f"stage {method_name} error (unpicklable): {e!r}")))
             continue
-        put_checked(out)
+        try:
+            put_checked(out)
+        except Exception as e:  # noqa: BLE001 - unpicklable/oversized
+            # RESULT: forward a descriptive error instead of dying (a
+            # dead pump wedges the chain with only a bare timeout)
+            put_checked(_Err(RuntimeError(
+                f"stage {method_name} result not transportable: {e!r}")))
 
 
 class _Stop:
@@ -237,6 +243,7 @@ class CompiledChain:
     def __init__(self, actors: List[Any], methods: List[str],
                  capacity_bytes: int = 4 * 1024 * 1024):
         assert len(actors) == len(methods) and actors
+        self._chain_id = uuid.uuid4().hex[:12]
         self._chans = [Channel(capacity_bytes)
                        for _ in range(len(actors) + 1)]
         self._actors = actors
@@ -245,11 +252,11 @@ class CompiledChain:
         refs = []
         for i, (a, m) in enumerate(zip(actors, methods)):
             refs.append(a.rtpu_channel_pump_start.remote(
-                m, self._chans[i], self._chans[i + 1]))
+                m, self._chans[i], self._chans[i + 1], self._chain_id))
         ray_tpu.get(refs)  # pumps running before first execute
 
     def execute(self, value: Any, timeout: Optional[float] = 60.0) -> Any:
-        self.execute_async(value)
+        self.execute_async(value, timeout=timeout)
         return self.result(timeout=timeout)
 
     def execute_async(self, value: Any,
@@ -278,7 +285,7 @@ class CompiledChain:
         # _Stop could not flow (full ring, dead stage) the threads exit
         # at their next 0.5s poll instead of leaking forever
         try:
-            ray_tpu.get([a.rtpu_channel_pump_stop.remote()
+            ray_tpu.get([a.rtpu_channel_pump_stop.remote(self._chain_id)
                          for a in self._actors], timeout=10)
         except Exception:  # noqa: BLE001 - actor may already be dead
             pass
@@ -291,7 +298,8 @@ def enable_channels(actor_cls):
 
     (The reference injects its accelerated-DAG machinery into every
     actor; here opting in is explicit.)"""
-    def rtpu_channel_pump_start(self, method, in_chan, out_chan):
+    def rtpu_channel_pump_start(self, method, in_chan, out_chan,
+                                chain_id="default"):
         import threading
         flag = {}
         t = threading.Thread(target=_pump,
@@ -299,12 +307,15 @@ def enable_channels(actor_cls):
                              daemon=True, name="channel-pump")
         t.start()
         if not hasattr(self, "_rtpu_pump_flags"):
-            self._rtpu_pump_flags = []
-        self._rtpu_pump_flags.append(flag)
+            self._rtpu_pump_flags = {}
+        # scoped per chain: tearing one chain down must not kill the
+        # pumps another live chain runs on this same actor
+        self._rtpu_pump_flags.setdefault(chain_id, []).append(flag)
         return True
 
-    def rtpu_channel_pump_stop(self):
-        for flag in getattr(self, "_rtpu_pump_flags", []):
+    def rtpu_channel_pump_stop(self, chain_id="default"):
+        flags = getattr(self, "_rtpu_pump_flags", {})
+        for flag in flags.pop(chain_id, []):
             flag["stop"] = True
         return True
 

@@ -148,3 +148,24 @@ def test_teardown_with_backpressured_chain(ray_start_regular):
     g.teardown()  # must not hang; pumps stop via the flag path
     # the actor is still healthy for normal calls afterwards
     assert ray_tpu.get(a.rtpu_channel_pump_stop.remote(), timeout=30)
+
+
+def test_two_chains_share_actor_independent_teardown(ray_start_regular):
+    """Tearing down one chain must not kill another chain's pumps on the
+    same actor (stop flags are chain-scoped)."""
+    @ray_tpu.remote
+    @enable_channels
+    class S:
+        def f(self, x):
+            return x + 1
+
+    shared = S.remote()
+    g1 = compile_chain([(shared, "f")])
+    g2 = compile_chain([(shared, "f")])
+    try:
+        assert g1.execute(1) == 2 and g2.execute(10) == 11
+        g1.teardown()
+        # g2 still fully alive after g1's teardown
+        assert g2.execute(20) == 21
+    finally:
+        g2.teardown()

@@ -268,3 +268,123 @@ def test_zombie_pending_meta_regression(ray_start_regular):
     assert head.objects[oid].state == "pending"
     head._h_release({"client_id": "zc2", "object_id": oid})
     assert oid not in head.objects, "zombie PENDING meta leaked"
+
+
+# ------------------------------------------------- r3 op-stream batch fuzz
+
+def test_submit_batch_op_stream_fuzz(ray_start_regular, monkeypatch):
+    """Fuzz the r3 ordered op stream (_h_submit_batch): transient puts +
+    specs dep'ing them + interleaved releases, against fake workers with
+    random completion/death.  Invariants after drain:
+
+    - every submitted return is terminal (nothing parked forever);
+    - transient arg objects are FREED once their task is terminal (the
+      dep pin was their only reference — a leak here grows the store
+      unboundedly on the 100KB-arg hot path);
+    - the client ledger never goes negative / never resurrects.
+    """
+    head = ray_tpu._head
+    # the sim owns the worker pool: never fork real processes (a real
+    # worker would receive sim specs with unregistered fn ids)
+    monkeypatch.setattr(head, "_spawn_worker", lambda *a, **k: None)
+    rng = random.Random(987)
+    steps = max(200, STEPS // 500)
+    workers = [_add_fake_worker(head, 7000 + i) for i in range(3)]
+    next_id = [0]
+    submitted = {}
+    transient_args = {}   # oid -> owning task_id
+    user_put_refs = []    # oids the "driver" still holds
+
+    def drain(kill_prob=0.1):
+        moved = True
+        while moved:
+            moved = False
+            for w in list(workers):
+                conn = w.task_conn
+                if not isinstance(conn, _FakeConn) or not conn.inbox:
+                    continue
+                msg = conn.inbox.pop(0)
+                if msg.get("kind") != "execute_task":
+                    continue
+                batch = [msg["spec"]] + list(msg.get("queued", ()))
+                for spec in batch:
+                    if rng.random() < kill_prob:
+                        with head.cv:
+                            head._handle_worker_death(w)
+                        workers.remove(w)
+                        next_id[0] += 1
+                        workers.append(
+                            _add_fake_worker(head, 7000 + 100 + next_id[0]))
+                        break
+                    head._handle_worker_event(w.worker_id, {
+                        "kind": "task_done", "task_id": spec["task_id"],
+                        "status": "ok",
+                        "results": [{"loc": "inline", "data": b"r",
+                                     "size": 1, "contained": []}
+                                    for _ in spec["return_ids"]]})
+                moved = True
+
+    for it in range(steps):
+        ops = []
+        n_entries = rng.randint(1, 5)
+        for _ in range(n_entries):
+            roll = rng.random()
+            next_id[0] += 1
+            if roll < 0.45:
+                # transient arg put + a spec dep'ing it, SAME batch
+                aid = f"simarg{next_id[0]:08d}"
+                tid = f"simbt{next_id[0]:08d}"
+                ret = f"simbr{next_id[0]:08d}"
+                ops.append(("put", {"object_id": aid, "loc": "inline",
+                                    "data": b"a", "size": 1,
+                                    "contained": [], "transient": True,
+                                    "node_id": head.head_node_id}))
+                spec = {"task_id": tid, "fn_id": "f", "name": "bt",
+                        "owner": "simdriver", "return_ids": [ret],
+                        "num_returns": 1, "deps": [aid], "borrows": [],
+                        "num_cpus": 1, "num_tpus": 0, "resources": {},
+                        "max_retries": rng.randint(0, 2),
+                        "retry_exceptions": False,
+                        "scheduling_strategy": None, "runtime_env": None,
+                        "values_ref": aid,
+                        "arg_layout": [], "kwarg_layout": {}}
+                ops.append(("spec", spec))
+                submitted[tid] = spec
+                transient_args[aid] = tid
+            elif roll < 0.7:
+                # plain user put the driver holds (and sometimes drops)
+                oid = f"simup{next_id[0]:08d}"
+                ops.append(("put", {"object_id": oid, "loc": "inline",
+                                    "data": b"u", "size": 1,
+                                    "contained": []}))
+                user_put_refs.append(oid)
+            elif user_put_refs:
+                ops.append(("rel", user_put_refs.pop(
+                    rng.randrange(len(user_put_refs)))))
+        head._h_submit_batch({"client_id": "simdriver", "ops": ops})
+        if it % 3 == 0:
+            drain()
+
+    for _ in range(200):
+        head._pump()
+        drain(kill_prob=0.0)
+        with head.lock:
+            if not head.pending_tasks and not head.running:
+                break
+
+    with head.lock:
+        for tid, spec in submitted.items():
+            for ret in spec["return_ids"]:
+                meta = head.objects.get(ret)
+                assert meta is not None and meta.state in ("ready", "error"), \
+                    (tid, ret, getattr(meta, "state", None))
+        # transient args must not leak: their only pin was the task dep
+        leaked = [aid for aid in transient_args
+                  if aid in head.objects
+                  and head.objects[aid].refcount > 0]
+        assert not leaked, f"transient arg objects leaked: {leaked[:5]}"
+        # the driver's ledger matches the user refs it still holds
+        ledger = head.client_refs.get("simdriver", {})
+        for oid in user_put_refs:
+            assert ledger.get(oid, 0) == 1, (oid, ledger.get(oid))
+        assert all(v > 0 for v in ledger.values())
