@@ -93,12 +93,19 @@ def _delivered_matmul_tflops(jax, jnp) -> dict:
     sync(c)
     pipelined = 30 * flop / (time.perf_counter() - t0) / 1e12
 
-    t0 = time.perf_counter()
-    c = a0
-    for _ in range(10):
-        c = fused(c)
-    sync(c)
-    fused_pipelined = 500 * flop / (time.perf_counter() - t0) / 1e12
+    # best-of-3: "delivered" is a CEILING measurement — a loaded-host dip
+    # in a single pass would understate the chip and overstate
+    # mfu_vs_delivered (observed spread 133-151 TF/s on the shared host)
+    fused_pipelined = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        c = a0
+        for _ in range(10):
+            c = fused(c)
+        sync(c)
+        fused_pipelined = max(
+            fused_pipelined,
+            500 * flop / (time.perf_counter() - t0) / 1e12)
     return {"pipelined": round(pipelined, 1),
             "fused_pipelined": round(fused_pipelined, 1)}
 

@@ -250,10 +250,22 @@ class CompiledChain:
         self._inflight = 0
         # start a pump thread inside every actor (same-host shm channels)
         refs = []
-        for i, (a, m) in enumerate(zip(actors, methods)):
-            refs.append(a.rtpu_channel_pump_start.remote(
-                m, self._chans[i], self._chans[i + 1], self._chain_id))
-        ray_tpu.get(refs)  # pumps running before first execute
+        try:
+            for i, (a, m) in enumerate(zip(actors, methods)):
+                refs.append(a.rtpu_channel_pump_start.remote(
+                    m, self._chans[i], self._chans[i + 1], self._chain_id))
+            ray_tpu.get(refs)  # pumps running before first execute
+        except BaseException:
+            # partial start (e.g. a dead actor): stop the pumps that DID
+            # start and free the segments, or they leak forever
+            for a in actors:
+                try:
+                    a.rtpu_channel_pump_stop.remote(self._chain_id)
+                except Exception:  # noqa: BLE001
+                    pass
+            for c in self._chans:
+                c.destroy()
+            raise
 
     def execute(self, value: Any, timeout: Optional[float] = 60.0) -> Any:
         self.execute_async(value, timeout=timeout)
@@ -313,10 +325,13 @@ def enable_channels(actor_cls):
         self._rtpu_pump_flags.setdefault(chain_id, []).append(flag)
         return True
 
-    def rtpu_channel_pump_stop(self, chain_id="default"):
+    def rtpu_channel_pump_stop(self, chain_id=None):
+        """Stop one chain's pumps, or ALL pumps when called with no
+        chain id (the orphan-recovery escape hatch)."""
         flags = getattr(self, "_rtpu_pump_flags", {})
-        for flag in flags.pop(chain_id, []):
-            flag["stop"] = True
+        for cid in ([chain_id] if chain_id is not None else list(flags)):
+            for flag in flags.pop(cid, []):
+                flag["stop"] = True
         return True
 
     actor_cls.rtpu_channel_pump_start = rtpu_channel_pump_start

@@ -133,6 +133,50 @@ def _add_fake_worker(head, i):
     return w
 
 
+
+def _drain_fake_workers(head, workers, outcome, next_id,
+                        worker_base=8000):
+    """Shared fake-worker drain: unpack the r3 dispatch wire shape (spec
+    + prepushed 'queued' batch), let ``outcome(spec) -> "ok"|"err"|
+    "die"`` decide each result, and respawn on death.  The ONE copy of
+    the wire protocol all three sims exercise."""
+    from ray_tpu._private.serialization import serialize_to_bytes
+    moved = False
+    for w in list(workers):
+        conn = w.task_conn
+        while isinstance(conn, _FakeConn) and conn.inbox:
+            msg = conn.inbox.pop(0)
+            if msg.get("kind") != "execute_task":
+                continue
+            moved = True
+            for spec in [msg["spec"]] + list(msg.get("queued", ())):
+                what = outcome(spec)
+                if what == "die":
+                    with head.cv:
+                        head._handle_worker_death(w)
+                    workers.remove(w)
+                    next_id[0] += 1
+                    workers.append(_add_fake_worker(
+                        head, worker_base + next_id[0]))
+                    break  # the dead worker abandons the rest of its batch
+                if what == "err":
+                    err = ray_tpu.exceptions.RayTaskError("simtask", "boom")
+                    head._handle_worker_event(w.worker_id, {
+                        "kind": "task_done", "task_id": spec["task_id"],
+                        "status": "app_error",
+                        "error": serialize_to_bytes(err)[0]})
+                else:
+                    head._handle_worker_event(w.worker_id, {
+                        "kind": "task_done", "task_id": spec["task_id"],
+                        "status": "ok",
+                        "results": [{"loc": "inline", "data": b"r",
+                                     "size": 1, "contained": []}
+                                    for _ in spec["return_ids"]]})
+            if w not in workers:
+                break
+    return moved
+
+
 def test_lease_lineage_schedule_sim(ray_start_regular, monkeypatch):
     head = ray_tpu._head
     # the sim owns the worker pool: never fork real processes
@@ -141,8 +185,6 @@ def test_lease_lineage_schedule_sim(ray_start_regular, monkeypatch):
     rng = random.Random(77)
     workers = [_add_fake_worker(head, i) for i in range(4)]
     submitted = {}          # task_id -> spec
-    terminal_ok = set()
-    terminal_err = set()
     next_id = [0]
     iters = max(1000, STEPS // 50)
 
@@ -161,6 +203,10 @@ def test_lease_lineage_schedule_sim(ray_start_regular, monkeypatch):
         head._h_submit_task({"spec": spec, "client_id": "simdriver"})
         return ret
 
+    def outcome(spec):
+        roll = rng.random()
+        return "ok" if roll < 0.75 else ("err" if roll < 0.9 else "die")
+
     recent_rets = []
     for it in range(iters):
         r = rng.random()
@@ -169,67 +215,18 @@ def test_lease_lineage_schedule_sim(ray_start_regular, monkeypatch):
                                                rng.randint(0, 2)))
             recent_rets.append(submit(deps))
             recent_rets = recent_rets[-32:]
-        # drain: fake workers act on their dispatched tasks
-        for w in list(workers):
-            conn = w.task_conn
-            if not isinstance(conn, _FakeConn) or not conn.inbox:
-                continue
-            msg = conn.inbox.pop(0)
-            if msg.get("kind") != "execute_task":
-                continue
-            # r3 wire contract: a dispatch message carries the spec plus a
-            # prepushed lease-inheriting batch; the worker runs them in
-            # order, one task_done each (a mid-batch death abandons the
-            # rest — the GCS requeues them from its pipeline view)
-            batch = [msg["spec"]] + list(msg.get("queued", ()))
-            for spec in batch:
-                roll = rng.random()
-                if roll < 0.75:  # completes
-                    head._handle_worker_event(w.worker_id, {
-                        "kind": "task_done", "task_id": spec["task_id"],
-                        "status": "ok",
-                        "results": [{"loc": "inline", "data": b"r",
-                                     "size": 1, "contained": []}
-                                    for _ in spec["return_ids"]]})
-                    terminal_ok.add(spec["task_id"])
-                elif roll < 0.9:  # app error
-                    from ray_tpu._private.serialization import \
-                        serialize_to_bytes
-                    err = ray_tpu.exceptions.RayTaskError("simtask", "boom")
-                    head._handle_worker_event(w.worker_id, {
-                        "kind": "task_done", "task_id": spec["task_id"],
-                        "status": "app_error",
-                        "error": serialize_to_bytes(err)[0]})
-                    terminal_err.add(spec["task_id"])
-                else:  # worker dies mid-task → retry or failure
-                    with head.cv:
-                        head._handle_worker_death(w)
-                    workers.remove(w)
-                    next_id[0] += 1  # monotonic: two same-iteration deaths
-                    # must not mint colliding worker ids
-                    workers.append(_add_fake_worker(head, 1000 + next_id[0]))
-                    break  # the dead worker abandons the rest of its batch
+        # drain: fake workers act on their dispatched tasks (shared wire
+        # protocol helper — see _drain_fake_workers)
+        _drain_fake_workers(head, workers, outcome, next_id,
+                            worker_base=1000)
         if it % 7 == 0:
             head._pump()
 
     # drain everything still pending deterministically: complete all
     for _ in range(20000):
         head._pump()
-        moved = False
-        for w in list(workers):
-            conn = w.task_conn
-            while isinstance(conn, _FakeConn) and conn.inbox:
-                msg = conn.inbox.pop(0)
-                if msg.get("kind") != "execute_task":
-                    continue
-                for spec in [msg["spec"]] + list(msg.get("queued", ())):
-                    head._handle_worker_event(w.worker_id, {
-                        "kind": "task_done", "task_id": spec["task_id"],
-                        "status": "ok",
-                        "results": [{"loc": "inline", "data": b"r",
-                                     "size": 1, "contained": []}
-                                    for _ in spec["return_ids"]]})
-                moved = True
+        moved = _drain_fake_workers(head, workers, lambda s: "ok",
+                                    next_id, worker_base=1000)
         if not moved and not head.pending_tasks and not head.running:
             break
 
@@ -296,33 +293,11 @@ def test_submit_batch_op_stream_fuzz(ray_start_regular, monkeypatch):
     user_put_refs = []    # oids the "driver" still holds
 
     def drain(kill_prob=0.1):
-        moved = True
-        while moved:
-            moved = False
-            for w in list(workers):
-                conn = w.task_conn
-                if not isinstance(conn, _FakeConn) or not conn.inbox:
-                    continue
-                msg = conn.inbox.pop(0)
-                if msg.get("kind") != "execute_task":
-                    continue
-                batch = [msg["spec"]] + list(msg.get("queued", ()))
-                for spec in batch:
-                    if rng.random() < kill_prob:
-                        with head.cv:
-                            head._handle_worker_death(w)
-                        workers.remove(w)
-                        next_id[0] += 1
-                        workers.append(
-                            _add_fake_worker(head, 7000 + 100 + next_id[0]))
-                        break
-                    head._handle_worker_event(w.worker_id, {
-                        "kind": "task_done", "task_id": spec["task_id"],
-                        "status": "ok",
-                        "results": [{"loc": "inline", "data": b"r",
-                                     "size": 1, "contained": []}
-                                    for _ in spec["return_ids"]]})
-                moved = True
+        def outcome(spec):
+            return "die" if rng.random() < kill_prob else "ok"
+        while _drain_fake_workers(head, workers, outcome, next_id,
+                                  worker_base=7100):
+            pass
 
     for it in range(steps):
         ops = []
