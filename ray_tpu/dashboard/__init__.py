@@ -62,6 +62,24 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path == "/api/timeline":
                 import ray_tpu
                 self._json(ray_tpu.timeline())
+            elif self.path == "/api/logs":
+                self._json(_list_logs())
+            elif self.path.startswith("/api/logs/"):
+                from urllib.parse import parse_qs, urlparse
+                u = urlparse(self.path)
+                name = u.path[len("/api/logs/"):]
+                try:
+                    tail = int(parse_qs(u.query).get("tail", ["200"])[0])
+                except ValueError:
+                    self._send(400, b"tail must be an integer",
+                               "text/plain")
+                    return
+                text = _read_log(name, tail)
+                if text is None:
+                    self._send(404, b"no such log", "text/plain")
+                else:
+                    self._send(200, text.encode("utf-8", "replace"),
+                               "text/plain")
             elif self.path == "/":
                 from ray_tpu.dashboard._index import INDEX_HTML
                 self._send(200, INDEX_HTML.encode(), "text/html")
@@ -69,6 +87,52 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, b"not found", "text/plain")
         except Exception as e:  # noqa: BLE001
             self._send(500, str(e).encode(), "text/plain")
+
+
+def _logs_dir():
+    from ray_tpu._private import worker as worker_mod
+    w = worker_mod.try_global_worker()
+    if w is None or w.session is None:
+        return None
+    return w.session.path / "logs"
+
+
+def _list_logs():
+    """Reference: the dashboard's per-node log listing (SURVEY.md §5.5)."""
+    d = _logs_dir()
+    if d is None or not d.is_dir():
+        return []
+    out = []
+    for p in sorted(d.glob("*.log")):
+        try:
+            out.append({"name": p.name, "bytes": p.stat().st_size})
+        except OSError:
+            pass
+    return out
+
+
+def _read_log(name: str, tail: int):
+    """Tail one session log file.  The name must resolve INSIDE the logs
+    dir — a traversal path (../gcs_state/...) must 404, not read."""
+    d = _logs_dir()
+    if d is None:
+        return None
+    p = (d / name).resolve()
+    if not str(p).startswith(str(d.resolve()) + "/") or not p.is_file():
+        return None
+    tail = max(1, min(tail, 10000))
+    # bounded read: a multi-GB log must not be loaded whole to serve a
+    # 200-line tail — seek back a generous per-line budget instead
+    budget = tail * 4096
+    with open(p, "rb") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        f.seek(max(0, size - budget))
+        data = f.read()
+    lines = data.decode("utf-8", "replace").splitlines()
+    if size > budget and lines:
+        lines = lines[1:]  # first line is likely a partial
+    return "\n".join(lines[-tail:]) + "\n"
 
 
 def start_dashboard(host: str = "127.0.0.1",

@@ -135,7 +135,7 @@ def _add_fake_worker(head, i):
 
 
 def _drain_fake_workers(head, workers, outcome, next_id,
-                        worker_base=8000):
+                        worker_base=8000, max_msgs=None):
     """Shared fake-worker drain: unpack the r3 dispatch wire shape (spec
     + prepushed 'queued' batch), let ``outcome(spec) -> "ok"|"err"|
     "die"`` decide each result, and respawn on death.  The ONE copy of
@@ -144,7 +144,10 @@ def _drain_fake_workers(head, workers, outcome, next_id,
     moved = False
     for w in list(workers):
         conn = w.task_conn
-        while isinstance(conn, _FakeConn) and conn.inbox:
+        handled = 0
+        while isinstance(conn, _FakeConn) and conn.inbox \
+                and (max_msgs is None or handled < max_msgs):
+            handled += 1
             msg = conn.inbox.pop(0)
             if msg.get("kind") != "execute_task":
                 continue
@@ -215,10 +218,11 @@ def test_lease_lineage_schedule_sim(ray_start_regular, monkeypatch):
                                                rng.randint(0, 2)))
             recent_rets.append(submit(deps))
             recent_rets = recent_rets[-32:]
-        # drain: fake workers act on their dispatched tasks (shared wire
-        # protocol helper — see _drain_fake_workers)
+        # drain ONE message per worker per iteration: inboxes accumulate
+        # so the prepushed lease-inheriting batches run under backlog
+        # pressure (shared wire-protocol helper)
         _drain_fake_workers(head, workers, outcome, next_id,
-                            worker_base=1000)
+                            worker_base=1000, max_msgs=1)
         if it % 7 == 0:
             head._pump()
 
