@@ -2136,9 +2136,27 @@ class GcsServer:
                         # _h_submit_task: a lost error strands the getter
                         self._fail_task(payload, e)
                 elif kind == "put":
-                    self._apply_put_locked(client_id, payload)
+                    try:
+                        self._apply_put_locked(client_id, payload)
+                    except Exception as e:  # noqa: BLE001 - one bad op
+                        # must not discard the rest of the ordered stream,
+                        # and a silently-lost put error would strand every
+                        # getter (put is one-way; the ref already exists):
+                        # seal the object WITH the error so parked specs
+                        # and direct get()s wake with it
+                        logger.exception("submit_batch: put %s failed",
+                                         payload.get("object_id"))
+                        oid = payload.get("object_id")
+                        if oid:
+                            from ray_tpu._private.serialization import \
+                                serialize_to_bytes
+                            self._seal_error(oid, serialize_to_bytes(e)[0])
                 elif kind == "rel":
-                    self._apply_release_locked(client_id, payload)
+                    try:
+                        self._apply_release_locked(client_id, payload)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("submit_batch: release %s failed",
+                                         payload)
         self._pump()
         return {}
 

@@ -195,6 +195,12 @@ class Worker:
         # safe (the GCS promotes dep-waiters when the object arrives).
         self._submit_buf: List[Any] = []   # interleaved specs + releases
         self._submit_lock = threading.Lock()
+        # serializes pop→send in _drain_submits: without it two threads
+        # (64-full caller vs flusher) could pop successive batches and
+        # reach the wire in either order, letting a release overtake the
+        # submit whose dep pin it retires.  Ordering: acquire BEFORE
+        # _submit_lock, never the reverse.
+        self._submit_send_lock = threading.Lock()
         self._submit_first: float = 0.0
         self._submit_flusher_on = False
         # revoked (task_id, dseq) pairs, insertion-ordered so overflow
@@ -946,28 +952,53 @@ class Worker:
             # rate never needed batching) — ship immediately
             self._send_submit_batch(entries)
             return
-        flush = None
+        full = False
         with self._submit_lock:
             self._submit_buf.extend(entries)
             if not self._submit_first:
                 self._submit_first = time.monotonic()
-            if len(self._submit_buf) >= 64:
-                flush, self._submit_buf = self._submit_buf, []
-                self._submit_first = 0.0
-            elif not self._submit_flusher_on and not self.is_client:
-                self._submit_flusher_on = True
-                threading.Thread(target=self._submit_flusher,
-                                 name="submit-flusher", daemon=True).start()
-        if flush is not None:
-            self._send_submit_batch(flush)
+            full = len(self._submit_buf) >= 64
+            if not full:
+                self._ensure_flusher_locked()
+        if full:
+            self._drain_submits()
 
     def _flush_submits(self) -> None:
-        with self._submit_lock:
-            if not self._submit_buf:
-                return
-            flush, self._submit_buf = self._submit_buf, []
-            self._submit_first = 0.0
-        self._send_submit_batch(flush)
+        self._drain_submits()
+
+    def _ensure_flusher_locked(self) -> None:
+        # _submit_lock held
+        if not self._submit_flusher_on and not self.is_client:
+            self._submit_flusher_on = True
+            threading.Thread(target=self._submit_flusher,
+                             name="submit-flusher", daemon=True).start()
+
+    def _drain_submits(self) -> None:
+        """Pop the whole buffer and ship it; on a transient channel break
+        REQUEUE it at the front.  The head is still alive (no epoch
+        change), so _resubmit_owned never fires — dropping the batch would
+        lose submissions whose .remote() already returned, hanging their
+        get() forever.  rpc_oneway drops the dead shared channel on error
+        (its break classes: OSError/ValueError/ConnectionError), so the
+        retry (the flusher's next pass) re-dials.  pop→send is atomic
+        under _submit_send_lock so concurrent drains can't reorder
+        batches on the wire OR interleave requeues out of order."""
+        with self._submit_send_lock:
+            with self._submit_lock:
+                if not self._submit_buf:
+                    return
+                flush, self._submit_buf = self._submit_buf, []
+                self._submit_first = 0.0
+            try:
+                self._send_submit_batch(flush)
+            except (OSError, ValueError, ConnectionError):
+                with self._submit_lock:
+                    self._submit_buf[:0] = flush
+                    if not self._submit_first:
+                        self._submit_first = time.monotonic()
+                    # ensure someone retries even if the flusher was
+                    # never started (all-exact-64-batch history)
+                    self._ensure_flusher_locked()
 
     def _send_submit_batch(self, entries: List[Any]) -> None:
         # ordered op stream: ("put", msg) | ("spec", spec) | ("rel", oid) —
@@ -982,24 +1013,10 @@ class Worker:
         while not self._stop.is_set():
             time.sleep(0.002)
             with self._submit_lock:
-                due = self._submit_buf and \
+                due = bool(self._submit_buf) and \
                     time.monotonic() - self._submit_first >= 0.0015
-                if due:
-                    flush, self._submit_buf = self._submit_buf, []
-                    self._submit_first = 0.0
             if due:
-                try:
-                    self._send_submit_batch(flush)
-                except (OSError, ConnectionError, EOFError):
-                    # transient channel break with the head still alive:
-                    # dropping the batch would lose task submissions for
-                    # good (no epoch change → no resubmission).  Requeue
-                    # at the FRONT (ordering); rpc_oneway already dropped
-                    # the dead shared channel, so the next pass re-dials.
-                    with self._submit_lock:
-                        self._submit_buf[:0] = flush
-                        if not self._submit_first:
-                            self._submit_first = time.monotonic()
+                self._drain_submits()
 
     # ---------------------------------------------------------- actor client
     def create_actor(self, cls: Any, args: tuple, kwargs: dict, *,

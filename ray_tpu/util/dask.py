@@ -74,6 +74,12 @@ def _exec_task(comp_blob: bytes, *dep_vals):
                 return env[x]
         except TypeError:
             pass
+        if type(x) is tuple:
+            # mirror _deps_of: keys may hide inside plain (non-task)
+            # tuples — substitute them and rebuild the tuple.  Exact-type
+            # check: tuple subclasses (NamedTuples) are literal data and
+            # can never be dask keys; rebuilding would downcast them.
+            return tuple(ev(a) for a in x)
         return x
 
     return ev(comp)

@@ -58,3 +58,18 @@ def test_dask_tuple_keys(ray_start_regular):
     }
     assert ray_dask_get(dsk, "total") == 33
     assert ray_dask_get(dsk, [("x", 0), ("x", 1)]) == [3, 30]
+
+
+def test_dask_key_nested_in_literal_tuple(ray_start_regular):
+    """A key hiding inside a plain (non-task) tuple arg must be
+    substituted at execution, not shipped raw — _deps_of and ev() must
+    walk tuples identically (r3 advisor finding)."""
+    def first_plus(pair, z):
+        return pair[0] + z
+
+    dsk = {
+        "a": (add, 1, 2),
+        # ("a", 99) is NOT a key — a literal tuple containing the key "a"
+        "out": (first_plus, ("a", 99), 10),
+    }
+    assert ray_dask_get(dsk, "out") == 13
