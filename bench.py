@@ -139,11 +139,16 @@ def main() -> None:
         cfg = gpt2.tiny(vocab=512, seq=128)
         batch, seq, steps = 8, 64, 3
 
+    import jax.numpy as _jnp
     mc = MeshConfig(data=1).resolved(1)
     mesh = mesh_lib.build_mesh(mc, [dev])
     prog = spmd.build_train_program(
         loss_fn=lambda p, b: gpt2.loss_fn(p, b, cfg),
         init_params_fn=lambda rng: gpt2.init_params(rng, cfg),
+        # bf16 moment storage (r4, parallel/optim.py): halves the
+        # bandwidth-floored AdamW phase's state traffic
+        optimizer=spmd.default_optimizer(
+            moments_dtype=_jnp.bfloat16 if on_tpu else None),
         mesh=mesh, mesh_config=mc)
     state = prog.init_fn(jax.random.key(0))
 
@@ -202,7 +207,57 @@ def main() -> None:
         "mfu_vs_delivered": round(tok_s * fpt / delivered_peak, 4)
         if delivered_peak else None,
     }
+    if on_tpu:
+        # The BASELINE #5 flagship at its NAMED size: GPT-2-XL 1.5B,
+        # single-chip fit via bf16 master params + bf16 Adam moments +
+        # remat "attn" (r4; recipe + OOM frontier in
+        # benchmarks/results/sweep_flagship_r04.json).
+        del state, prog, b
+        out["xl_1558m"] = _run_xl(jax, np, gpt2, mesh_lib, spmd, MeshConfig,
+                                  dev, peak)
     print(json.dumps(out))
+
+
+def _run_xl(jax, np, gpt2, mesh_lib, spmd, MeshConfig, dev,
+            peak: float) -> dict:
+    import dataclasses
+    import jax.numpy as jnp
+    cfg = dataclasses.replace(gpt2.gpt2_xl(), attn_impl="flash",
+                              remat_policy="attn",
+                              param_dtype=jnp.bfloat16)
+    batch, seq, steps = 8, 1024, 8
+    mc = MeshConfig(data=1).resolved(1)
+    mesh = mesh_lib.build_mesh(mc, [dev])
+    prog = spmd.build_train_program(
+        loss_fn=lambda p, b: gpt2.loss_fn(p, b, cfg),
+        init_params_fn=lambda rng: gpt2.init_params(rng, cfg),
+        optimizer=spmd.default_optimizer(moments_dtype=jnp.bfloat16),
+        mesh=mesh, mesh_config=mc)
+    try:
+        state = prog.init_fn(jax.random.key(0))
+        toks = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+        b = spmd.shard_batch(prog, {"inputs": toks[:, :-1],
+                                    "targets": toks[:, 1:]})
+        t0 = time.perf_counter()
+        state, m = prog.step_fn(state, b)
+        float(jax.device_get(m["loss"]))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = prog.step_fn(state, b)
+        loss = float(jax.device_get(m["loss"]))
+        step_s = (time.perf_counter() - t0) / steps
+    except Exception as e:  # noqa: BLE001 - diagnostic field, not the metric
+        return {"error": str(e)[:160]}
+    tok_s = batch * seq / step_s
+    fpt = gpt2.flops_per_token(cfg, seq)
+    return {"tokens_per_s_per_chip": round(tok_s, 1),
+            "mfu": round(tok_s * fpt / peak, 4),
+            "vs_baseline": round(tok_s * fpt / peak / A100_REFERENCE_MFU, 4),
+            "step_ms": round(step_s * 1e3, 2),
+            "compile_s": round(compile_s, 1),
+            "batch": batch, "loss": round(loss, 4)}
 
 
 if __name__ == "__main__":

@@ -22,7 +22,8 @@ import jax
 import numpy as np
 
 
-def run_variant(name: str, cfg, batch: int, seq: int, steps: int):
+def run_variant(name: str, cfg, batch: int, seq: int, steps: int,
+                accum: int = 1, moments=None):
     from ray_tpu.models import gpt2
     from ray_tpu.parallel import mesh as mesh_lib, spmd
     from ray_tpu.parallel.mesh import MeshConfig
@@ -33,7 +34,8 @@ def run_variant(name: str, cfg, batch: int, seq: int, steps: int):
     prog = spmd.build_train_program(
         loss_fn=lambda p, b: gpt2.loss_fn(p, b, cfg),
         init_params_fn=lambda rng: gpt2.init_params(rng, cfg),
-        mesh=mesh, mesh_config=mc)
+        optimizer=spmd.default_optimizer(moments_dtype=moments),
+        mesh=mesh, mesh_config=mc, accum_steps=accum)
     try:
         state = prog.init_fn(jax.random.key(0))
         rng = np.random.default_rng(0)
@@ -78,11 +80,24 @@ def main():
                     help="comma-separated variant names")
     ap.add_argument("--model", default="gpt2",
                     help="preset name (gpt2|gpt2-medium|gpt2-large|...)")
+    ap.add_argument("--param-dtype", default="f32", choices=["f32", "bf16"],
+                    help="master param dtype (bf16 is the single-chip XL "
+                         "fit: f32 params + moments for 1.5B exceed 16GB)")
+    ap.add_argument("--moments", default="f32", choices=["f32", "bf16"],
+                    help="Adam moment storage dtype (parallel/optim.py)")
+    ap.add_argument("--accum", type=int, default=1,
+                    help="microbatch gradient-accumulation steps")
     args = ap.parse_args()
+
+    import jax.numpy as jnp
 
     from ray_tpu.models import gpt2
 
     base = gpt2.PRESETS[args.model]()
+    if args.param_dtype == "bf16":
+        base = gpt2.GPT2Config(**{**base.__dict__,
+                                  "param_dtype": jnp.bfloat16})
+    moments = jnp.bfloat16 if args.moments == "bf16" else None
 
     def mk(**kw):
         return gpt2.GPT2Config(**{**base.__dict__, **kw})
@@ -108,7 +123,8 @@ def main():
         raise SystemExit(f"unknown variant(s) {unknown}; "
                          f"valid: {sorted(variants)}")
     for name in picked:
-        run_variant(name, variants[name], args.batch, args.seq, args.steps)
+        run_variant(name, variants[name], args.batch, args.seq, args.steps,
+                    accum=args.accum, moments=moments)
 
 
 if __name__ == "__main__":

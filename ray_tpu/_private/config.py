@@ -121,6 +121,13 @@ _FLAG_DEFS = [
           "worker restarts (SURVEY.md §7.3: big-model compiles take "
           "minutes; Serve replica restarts and trainer elastic restarts "
           "must not pay them again).  '' disables."),
+    # --- wire protocol -------------------------------------------------------
+    _flag("proto_min_version", 0,
+          "Minimum control-plane wire version the GCS accepts (0 = legacy "
+          "raw-pickle peers allowed).  Raising it makes the server reject "
+          "__proto_hello__ from older clients AND legacy frames — the "
+          "version-skew guard the reference gets from protobuf/gRPC "
+          "(src/ray/protobuf/).  See _private/wire.py."),
     # --- metrics / tracing ---------------------------------------------------
     _flag("metrics_export_period_s", 5.0, "Metrics agent export period."),
     _flag("timeline_enabled", True, "Record profile events for `ray_tpu timeline`."),

@@ -1276,15 +1276,37 @@ class GcsServer:
             t.start()
 
     def _serve_conn(self, conn) -> None:
+        from ray_tpu._private import wire
+        from ray_tpu._private.config import GLOBAL_CONFIG
         client_id: Optional[str] = None
+        ver = 0  # negotiated wire version for THIS connection
         try:
             while not self._shutdown:
                 try:
-                    msg = conn.recv()
+                    msg, seen_ver = wire.conn_recv(conn)
                 except (EOFError, OSError):
+                    break
+                except wire.WireError as e:
+                    logger.warning("undecodable frame: %s", e)
                     break
                 kind = msg.get("kind")
                 rid = msg.get("rid")
+                if kind == "__proto_hello__":
+                    # version negotiation (wire.py): reply at the agreed
+                    # version; every later frame on this conn rides it
+                    try:
+                        ver = wire.negotiate_version(
+                            msg.get("versions", [0]),
+                            GLOBAL_CONFIG.proto_min_version)
+                        reply = {"rid": rid, "error": None, "proto": ver}
+                    except wire.ProtocolVersionError as e:
+                        reply = {"rid": rid, "error": dumps_call(
+                            ConnectionError(str(e)))}
+                    try:
+                        wire.conn_send(conn, reply, ver)
+                    except (OSError, ValueError):
+                        break
+                    continue
                 if kind == "attach_task_conn":
                     self._attach_task_conn(msg["worker_id"], conn,
                                            msg.get("reattach"))
@@ -1292,6 +1314,22 @@ class GcsServer:
                 if kind == "agent_attach":
                     self._attach_agent_conn(msg["node_id"], conn)
                     return  # thread parks until the agent disconnects
+                if seen_ver == 0 and ver == 0 \
+                        and GLOBAL_CONFIG.proto_min_version > 0:
+                    # un-negotiated legacy peer on a version-fenced server.
+                    # (attach kinds above are exempt: they are one-shot
+                    # messages that CONVERT the conn into a server-push
+                    # channel — in-cluster senders from this same build,
+                    # not the cross-version clients the fence is for)
+                    err = dumps_call(ConnectionError(
+                        f"wire protocol >= v"
+                        f"{GLOBAL_CONFIG.proto_min_version} required "
+                        f"(send __proto_hello__)"))
+                    try:
+                        wire.conn_send(conn, {"rid": rid, "error": err}, 0)
+                    except (OSError, ValueError):
+                        pass
+                    break
                 if client_id is None and "client_id" in msg:
                     client_id = msg["client_id"]
                 dedup = msg.get("_dedup")
@@ -1304,7 +1342,8 @@ class GcsServer:
                         # recorded reply, don't double-apply
                         if rid is not None:
                             try:
-                                conn.send({"rid": rid, **replay})
+                                wire.conn_send(conn, {"rid": rid, **replay},
+                                               ver)
                             except (OSError, ValueError):
                                 break
                         continue
@@ -1325,7 +1364,7 @@ class GcsServer:
                         self._dedup_commit(key, reply)
                 if rid is not None:
                     try:
-                        conn.send({"rid": rid, **reply})
+                        wire.conn_send(conn, {"rid": rid, **reply}, ver)
                     except (OSError, ValueError):
                         break
         finally:

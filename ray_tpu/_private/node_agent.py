@@ -46,7 +46,7 @@ class NodeAgent:
             res["TPU"] = self.num_tpus
         all_labels = {"agent": "1", **(labels or {})}
         self._conn = protocol.tunnel_connect(*self.head, "gcs")
-        self._chan = protocol.RpcChannel(self._conn)
+        self._chan = protocol.RpcChannel(self._conn, negotiate=True)
         # P2P object plane (reference: ObjectManager node↔node transfer):
         # large objects produced on this host spool locally and are served
         # directly to sibling hosts; the head is only the fallback relay.
@@ -95,7 +95,8 @@ class NodeAgent:
             ch = None
             try:
                 ch = protocol.RpcChannel(
-                    protocol.tunnel_connect(*self.head, "gcs"))
+                    protocol.tunnel_connect(*self.head, "gcs"),
+                    negotiate=True)
                 resp = ch.call("pick_oom_victim", node_id=self.node_id,
                                frac=used / total)
                 pid = resp.get("pid")
@@ -221,7 +222,7 @@ class NodeAgent:
                 pass
         try:  # fresh conn: the attach conn is dedicated to liveness
             ch = protocol.RpcChannel(
-                protocol.tunnel_connect(*self.head, "gcs"))
+                protocol.tunnel_connect(*self.head, "gcs"), negotiate=True)
             ch.call("remove_node", node_id=self.node_id)
             ch.close()
         except Exception:  # noqa: BLE001 - head may already be gone

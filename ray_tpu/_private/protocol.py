@@ -133,21 +133,52 @@ def set_authkey_from_env() -> None:
 
 
 class RpcChannel:
-    """Synchronous request/response client over one Connection."""
+    """Synchronous request/response client over one Connection.
+
+    ``negotiate=True`` performs the ``__proto_hello__`` exchange
+    (``_private/wire.py``) right after construction: the channel then
+    speaks the agreed frame version (rtmsg control codec at v2) instead of
+    legacy raw pickle.  A version-fenced server (``proto_min_version``)
+    raises ConnectionError here — version skew fails loudly at dial time,
+    not as a mid-stream decode error.
+    """
 
     _rid_counter = itertools.count(1)
 
-    def __init__(self, conn: Connection):
+    def __init__(self, conn: Connection, negotiate: bool = False):
         self._conn = conn
         self._lock = threading.Lock()
+        self.version = 0  # legacy until negotiated
+        if negotiate:
+            self.negotiate()
+
+    def negotiate(self) -> int:
+        from ray_tpu._private import wire
+        try:
+            resp = self.call("__proto_hello__",
+                             versions=list(range(wire.PROTO_MIN,
+                                                 wire.PROTO_MAX + 1)))
+        except (ConnectionError, EOFError, OSError):
+            # ConnectionError: the server's explicit version rejection
+            # (proto_min_version fence) — or a genuinely dead conn.
+            # Either way the dial must fail loudly.
+            raise
+        except Exception:  # noqa: BLE001 - pre-versioning server: unknown
+            # rpc kind → server error reply.  Both ends speak legacy
+            # pickle fine; degrade instead of refusing to connect.
+            self.version = 0
+            return 0
+        self.version = int(resp.get("proto", 0))
+        return self.version
 
     def call(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        from ray_tpu._private import wire
         rid = next(self._rid_counter)
         msg = {"kind": kind, "rid": rid, **fields}
         with self._lock:
-            self._conn.send(msg)
+            wire.conn_send(self._conn, msg, self.version)
             while True:
-                resp = self._conn.recv()
+                resp, _ = wire.conn_recv(self._conn)
                 if resp.get("rid") == rid:
                     break
         if resp.get("error") is not None:
@@ -156,8 +187,10 @@ class RpcChannel:
         return resp
 
     def send_oneway(self, kind: str, **fields: Any) -> None:
+        from ray_tpu._private import wire
         with self._lock:
-            self._conn.send({"kind": kind, "rid": None, **fields})
+            wire.conn_send(self._conn, {"kind": kind, "rid": None, **fields},
+                           self.version)
 
     def close(self) -> None:
         try:
@@ -181,7 +214,7 @@ class RpcPool:
     def channel(self) -> RpcChannel:
         ch = getattr(self._tls, "ch", None)
         if ch is None:
-            ch = RpcChannel(self._connect_fn())
+            ch = RpcChannel(self._connect_fn(), negotiate=True)
             self._tls.ch = ch
             with self._lock:
                 self._all.append(ch)

@@ -13,8 +13,14 @@ site-packages on ``sys.path``; restore removes the path AND purges modules
 imported from the venv, so the pooled worker stays clean.  Local
 wheel/sdist paths are uploaded into the GCS KV at submit and materialized
 on the executing host — installs run ``--no-index`` (zero-egress; index
-requirements fail loudly).  Conda/container isolation remains unsupported
-and validated-out.
+requirements fail loudly).
+
+``conda`` isolation (r3) creates/reuses a cached env per spec hash via the
+first available mamba/micromamba/conda binary; ``container`` (r3) wraps
+worker exec in podman/docker.  Both validate loudly as unsupported when no
+binary exists on the host — which is the case in this image, so their tests
+(tests/test_runtime_env_plugins.py) exercise them against in-tree fake
+binaries; see PARITY.md for that caveat.
 """
 
 from __future__ import annotations

@@ -325,7 +325,8 @@ class Worker:
             with self._oneway_init_lock:
                 ch = self._oneway_chan
                 if ch is None:
-                    ch = protocol.RpcChannel(self.open_conn(self.gcs_path))
+                    ch = protocol.RpcChannel(self.open_conn(self.gcs_path),
+                                             negotiate=True)
                     self._oneway_chan = ch
         try:
             ch.send_oneway(kind, client_id=self.worker_id, **fields)
@@ -991,7 +992,9 @@ class Worker:
                 self._submit_first = 0.0
             try:
                 self._send_submit_batch(flush)
-            except (OSError, ValueError, ConnectionError):
+            except (OSError, ValueError, ConnectionError, EOFError):
+                # EOFError: the negotiated re-dial (RpcChannel negotiate)
+                # recv()s mid-hello — a half-restarted head can EOF there
                 with self._submit_lock:
                     self._submit_buf[:0] = flush
                     if not self._submit_first:

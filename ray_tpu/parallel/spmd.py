@@ -46,9 +46,19 @@ jax.tree_util.register_pytree_node(
 
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.01,
                       warmup: int = 100, total_steps: int = 10_000,
-                      b2: float = 0.95, clip: float = 1.0) -> optax.GradientTransformation:
+                      b2: float = 0.95, clip: float = 1.0,
+                      moments_dtype: Any = None) -> optax.GradientTransformation:
+    """AdamW with warmup-cosine schedule.  ``moments_dtype`` (e.g.
+    ``jnp.bfloat16``) stores BOTH Adam moments compactly — halves the
+    optimizer's HBM footprint and its bandwidth-floored step phase
+    (parallel/optim.py); None keeps optax's f32 state."""
     sched = optax.warmup_cosine_decay_schedule(
         0.0, lr, warmup, max(total_steps, warmup + 1), end_value=lr * 0.1)
+    if moments_dtype is not None:
+        from ray_tpu.parallel.optim import adamw_compact
+        return adamw_compact(sched, b1=0.9, b2=b2,
+                             weight_decay=weight_decay, clip=clip,
+                             mu_dtype=moments_dtype, nu_dtype=moments_dtype)
     return optax.chain(optax.clip_by_global_norm(clip),
                        optax.adamw(sched, b1=0.9, b2=b2,
                                    weight_decay=weight_decay))
@@ -94,11 +104,21 @@ def build_train_program(
         mesh: Optional[Mesh] = None,
         rules: Rules = TRANSFORMER_RULES,
         batch_rank: int = 2,
-        donate_state: bool = True) -> SpmdProgram:
+        donate_state: bool = True,
+        accum_steps: int = 1,
+        accum_dtype: Any = None) -> SpmdProgram:
     """Assemble the one-jit distributed train step.
 
     ``loss_fn(params, batch) -> scalar``; GSPMD derives every collective from
     the shardings — there is no explicit allreduce anywhere.
+
+    ``accum_steps > 1`` runs microbatch gradient accumulation INSIDE the one
+    jit: the global batch is split on its leading dim into ``accum_steps``
+    microbatches and a ``lax.scan`` accumulates grads before one optimizer
+    update.  Activation memory scales with the MICRObatch, so batch sizes
+    that OOM outright fit (the r3 sweep's HBM-OOM rows; VERDICT r3 #1).
+    ``accum_dtype`` sets the accumulator dtype (default: the grad dtype —
+    pass ``jnp.bfloat16`` to halve accumulator HBM when params are f32).
     """
     optimizer = optimizer or default_optimizer()
     if mesh is None:
@@ -130,14 +150,58 @@ def build_train_program(
 
     init_fn = jax.jit(_init, out_shardings=state_sh)
 
-    def _step(state: TrainState, batch: Any):
+    def _grads(params: Any, batch: Any):
         # Runs at trace time: model code (e.g. ring attention) can pick up
         # the program mesh via mesh_lib.get_ambient_mesh() to nest shard_map.
         with mesh_lib.ambient_mesh(mesh):
-            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+            return jax.value_and_grad(loss_fn)(params, batch)
+
+    def _grads_accum(params: Any, batch: Any):
+        # Microbatch split on the leading (batch) dim.  The reshape keeps
+        # the data-parallel sharding on the microbatch dim (constraint
+        # below) so each scan iteration is the same SPMD program at 1/A
+        # batch; the accumulator is carried state, the activations die with
+        # each iteration.
+        A = accum_steps
+
+        def split(x):
+            if getattr(x, "ndim", 0) == 0 or x.shape[0] % A:
+                raise ValueError(
+                    f"batch dim {getattr(x, 'shape', ())} not divisible "
+                    f"by accum_steps={A}")
+            mb = x.reshape(A, x.shape[0] // A, *x.shape[1:])
+            spec = mesh_lib.batch_spec(mesh_config, mb.ndim - 1)
+            return jax.lax.with_sharding_constraint(
+                mb, NamedSharding(mesh, P(None, *spec)))
+
+        mbs = jax.tree_util.tree_map(split, batch)
+        acc0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, accum_dtype or p.dtype), params)
+
+        def body(carry, mb):
+            loss_acc, g_acc = carry
+            loss, grads = _grads(params, mb)
+            g_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(a.dtype), g_acc, grads)
+            return (loss_acc + loss, g_acc), None
+
+        (loss_sum, acc), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), acc0), mbs)
+        inv = jnp.float32(1.0 / A)
+        grads = jax.tree_util.tree_map(
+            lambda a, p: (a.astype(jnp.float32) * inv).astype(p.dtype),
+            acc, params)
+        return loss_sum * inv, grads
+
+    def _step(state: TrainState, batch: Any):
+        if accum_steps > 1:
+            loss, grads = _grads_accum(state.params, batch)
+        else:
+            loss, grads = _grads(state.params, batch)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        from ray_tpu.parallel.optim import apply_updates_mixed
+        params = apply_updates_mixed(state.params, updates)
         new = TrainState(step=state.step + 1, params=params,
                          opt_state=opt_state)
         gnorm = optax.global_norm(grads)

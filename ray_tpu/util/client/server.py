@@ -91,9 +91,12 @@ class ClientProxyServer:
         state = {"finished": False}
 
         def pump(src, dst):
+            # opaque byte-frame relay: never decode — versioned wire
+            # frames (_private/wire.py) and legacy pickle pass through
+            # identically, and the proxy skips a pickle round-trip
             while True:
                 try:
-                    dst.send(src.recv())
+                    dst.send_bytes(src.recv_bytes())
                 except (EOFError, OSError, ValueError):
                     break
             with lock:

@@ -50,7 +50,10 @@ def _deps_of(comp: Any, dsk: Dict) -> set:
                 walk(a)
         elif _is_key(x, dsk):
             out.add(x)
-        elif isinstance(x, tuple):
+        elif type(x) is tuple:
+            # exact-type, matching ev(): tuple SUBCLASSES (NamedTuples)
+            # are literal data on both walks — descending here but not in
+            # ev() would ship a dep that never gets substituted
             for a in x:
                 walk(a)
 

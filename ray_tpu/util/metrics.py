@@ -220,7 +220,55 @@ def collect_cluster() -> Dict[str, dict]:
                                 "value": float(val)}]}
         except Exception:  # noqa: BLE001 - store detached mid-collect
             pass
+    merged.update(device_memory_gauges())
     return merged
+
+
+def device_memory_gauges() -> Dict[str, dict]:
+    """Per-chip HBM gauges from PJRT ``device.memory_stats()`` (SURVEY.md
+    §5.5 rebuild note: per-chip HBM/duty-cycle on the dashboard).
+
+    Best-effort by design: only reads devices when jax is ALREADY imported
+    in this process (collecting metrics must never pay a backend init), and
+    only platforms whose PJRT client implements memory_stats report.
+    Documented platform gaps rather than silent ones:
+
+    - the relay-attached ``axon`` platform returns ``None`` from
+      memory_stats (no allocator stats over the relay), so on this rig the
+      gauges appear only for locally-attached chips;
+    - duty-cycle/TensorCore-utilization needs libtpu's gRPC metrics
+      service (what ``tpu-info`` reads), which PJRT does not expose — no
+      gauge is synthesized for it.
+    """
+    import sys as _sys
+    jax_mod = _sys.modules.get("jax")
+    if jax_mod is None:
+        return {}
+    names = (("bytes_in_use", "rtpu_device_hbm_bytes_in_use",
+              "HBM bytes currently allocated (PJRT memory_stats)"),
+             ("peak_bytes_in_use", "rtpu_device_hbm_peak_bytes",
+              "peak HBM bytes allocated (PJRT memory_stats)"),
+             ("bytes_limit", "rtpu_device_hbm_bytes_limit",
+              "HBM allocator capacity (PJRT memory_stats)"))
+    out: Dict[str, dict] = {}
+    try:
+        for d in jax_mod.local_devices():
+            if d.platform == "cpu":
+                continue
+            stats = d.memory_stats() or {}
+            for key, mname, desc in names:
+                if key not in stats:
+                    continue
+                dst = out.setdefault(mname, {"kind": "gauge",
+                                             "description": desc,
+                                             "series": []})
+                dst["series"].append(
+                    {"tags": {"device": str(getattr(d, "id", 0)),
+                              "kind": getattr(d, "device_kind", d.platform)},
+                     "value": float(stats[key])})
+    except Exception:  # noqa: BLE001 - backend half-initialized/detached
+        return out
+    return out
 
 
 def _reset_for_tests() -> None:

@@ -1,0 +1,210 @@
+"""Wire-protocol versioning (_private/wire.py; VERDICT r3 missing #3).
+
+Covers: rtmsg codec round-trip + safety, frame encode/decode across
+versions, legacy-pickle interop on one socket, hello negotiation, and a
+version-fenced server rejecting an old client loudly.
+"""
+
+import pickle
+import threading
+
+import pytest
+
+from ray_tpu._private import protocol, wire
+
+
+# ------------------------------------------------------------------ codec
+def test_rtmsg_roundtrip_control_shapes():
+    msgs = [
+        {"kind": "submit_batch", "rid": None, "client_id": "abc",
+         "ops": [("spec", {"task_id": "t1", "deps": ["o1"],
+                           "num_cpus": 1.5, "retries": 3}),
+                 ("rel", "o2"), ("put", {"object_id": "o3",
+                                         "data": b"\x00\x80xyz"})]},
+        {"rid": 7, "error": None, "workers": [], "nested": {"a": [1, -2]},
+         "big": (1 << 62), "neg": -(1 << 62), "f": 3.5, "t": True},
+        {},
+        {"empty": [], "tup": (), "none": None},
+    ]
+    for m in msgs:
+        assert wire.rtmsg_loads(wire.rtmsg_dumps(m)) == m
+    # tuples keep their identity (submit ops are unpacked as pairs)
+    out = wire.rtmsg_loads(wire.rtmsg_dumps({"ops": [("spec", 1)]}))
+    assert isinstance(out["ops"][0], tuple)
+
+
+def test_rtmsg_rejects_python_objects():
+    class Thing:
+        pass
+
+    with pytest.raises(TypeError):
+        wire.rtmsg_dumps({"x": Thing()})
+    # subclasses don't round-trip → refused, not silently downcast
+    import numpy as np
+    with pytest.raises(TypeError):
+        wire.rtmsg_dumps({"n": np.int64(3)})
+    with pytest.raises(TypeError):
+        wire.rtmsg_dumps({"big": 1 << 70})
+
+
+def test_rtmsg_decode_is_not_pickle():
+    """The control codec must execute nothing: a malicious frame is a
+    parse error, never a constructor call."""
+    evil = pickle.dumps({"kind": "x"})
+    with pytest.raises(wire.WireError):
+        wire.rtmsg_loads(evil[1:])  # arbitrary bytes → WireError, not exec
+
+
+# ----------------------------------------------------------------- frames
+class Payload:
+    def __eq__(self, other):
+        return isinstance(other, Payload)
+
+
+def test_frame_versions_and_legacy_interop():
+    msg = {"kind": "ping", "rid": 3}
+    # v2 control message rides rtmsg
+    f2 = wire.encode_frame(msg, 2)
+    assert f2[0] == 2 and f2[1] == 1
+    assert wire.decode_frame(f2) == (msg, 2)
+    # v1 is framed pickle
+    f1 = wire.encode_frame(msg, 1)
+    assert f1[0] == 1 and f1[1] == 0
+    assert wire.decode_frame(f1) == (msg, 1)
+    # a legacy raw-pickle stream decodes as version 0
+    assert wire.decode_frame(pickle.dumps(msg)) == (msg, 0)
+    # v2 with a Python payload falls back to the pickle codec, same version
+    fp = wire.encode_frame({"kind": "x", "obj": Payload()}, 2)
+    assert fp[0] == 2 and fp[1] == 0
+    obj, ver = wire.decode_frame(fp)
+    assert ver == 2 and obj["obj"] == Payload()
+    # frames from the future are refused
+    with pytest.raises(wire.ProtocolVersionError):
+        wire.decode_frame(bytes([wire.PROTO_MAX + 1, 0]) + b"x")
+
+
+def test_negotiate_version():
+    assert wire.negotiate_version([1, 2], server_min=0) == 2
+    assert wire.negotiate_version([1], server_min=0) == 1
+    assert wire.negotiate_version([1, 2, 99], server_min=0) == wire.PROTO_MAX
+    with pytest.raises(wire.ProtocolVersionError):
+        wire.negotiate_version([1], server_min=2)
+    with pytest.raises(wire.ProtocolVersionError):
+        wire.negotiate_version("garbage", server_min=0)
+
+
+# ------------------------------------------------- live channel negotiation
+def _mini_server(listener, server_min, replies):
+    """One-connection mini GCS: handles __proto_hello__ + echoes pings,
+    mirroring gcs._serve_conn's versioning behavior."""
+    conn = listener.accept()
+    ver = 0
+    try:
+        while True:
+            msg, seen = wire.conn_recv(conn)
+            kind, rid = msg.get("kind"), msg.get("rid")
+            if kind == "__proto_hello__":
+                try:
+                    ver = wire.negotiate_version(msg["versions"], server_min)
+                    wire.conn_send(conn, {"rid": rid, "error": None,
+                                          "proto": ver}, ver)
+                except wire.ProtocolVersionError as e:
+                    from ray_tpu._private.serialization import dumps_call
+                    wire.conn_send(conn, {"rid": rid, "error": dumps_call(
+                        ConnectionError(str(e)))}, 0)
+                continue
+            replies.append((kind, seen))
+            wire.conn_send(conn, {"rid": rid, "error": None, "pong": True},
+                           ver)
+    except (EOFError, OSError):
+        pass
+
+
+def test_channel_negotiates_and_sends_v2(tmp_path):
+    path = str(tmp_path / "sock")
+    listener = protocol.make_listener(path)
+    replies = []
+    t = threading.Thread(target=_mini_server, args=(listener, 0, replies),
+                         daemon=True)
+    t.start()
+    ch = protocol.RpcChannel(protocol.connect(path), negotiate=True)
+    assert ch.version == wire.PROTO_MAX
+    assert ch.call("ping")["pong"] is True
+    ch.close()
+    listener.close()
+    assert replies == [("ping", wire.PROTO_MAX)]
+
+
+def test_version_fenced_server_rejects_old_client(tmp_path):
+    path = str(tmp_path / "sock")
+    listener = protocol.make_listener(path)
+    t = threading.Thread(target=_mini_server, args=(listener, 99, []),
+                         daemon=True)
+    t.start()
+    with pytest.raises(ConnectionError, match="server requires"):
+        protocol.RpcChannel(protocol.connect(path), negotiate=True)
+    listener.close()
+
+
+def test_negotiate_falls_back_to_legacy_on_old_server(tmp_path):
+    """A pre-versioning server errors on the unknown __proto_hello__ kind;
+    the client must degrade to legacy v0, not refuse to connect."""
+    from ray_tpu._private.serialization import dumps_call
+    path = str(tmp_path / "sock")
+    listener = protocol.make_listener(path)
+
+    def old_server():
+        conn = listener.accept()
+        try:
+            while True:
+                msg = conn.recv()  # legacy pickle recv, like a pre-wire GCS
+                if msg["kind"] == "__proto_hello__":
+                    conn.send({"rid": msg.get("rid"), "error": dumps_call(
+                        ValueError("unknown rpc __proto_hello__"))})
+                else:
+                    conn.send({"rid": msg.get("rid"), "error": None,
+                               "pong": True})
+        except (EOFError, OSError):
+            pass
+
+    t = threading.Thread(target=old_server, daemon=True)
+    t.start()
+    ch = protocol.RpcChannel(protocol.connect(path), negotiate=True)
+    assert ch.version == 0
+    assert ch.call("ping")["pong"] is True  # legacy frames both ways
+    ch.close()
+    listener.close()
+
+
+def test_version_fenced_cluster_still_schedules():
+    """proto_min_version=2 on a live cluster: pool/oneway channels
+    negotiate v2, and the in-cluster attach kinds (worker task conns) are
+    exempt from the fence — tasks keep flowing."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, _system_config={"proto_min_version": 2})
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get([f.remote(i) for i in range(10)]) == \
+            [2 * i for i in range(10)]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_end_to_end_cluster_speaks_v2(ray_start_regular):
+    """The real GCS negotiates v2 with the driver's pool channels and the
+    whole core API keeps working over rtmsg frames."""
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    assert w.pool.channel().version == wire.PROTO_MAX
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get([f.remote(i) for i in range(20)]) == \
+        list(range(1, 21))

@@ -199,3 +199,32 @@ def test_native_store_metrics_exported(ray_start_regular):
     # and they render as prometheus text
     text = metrics.prometheus_text(m)
     assert "rtpu_native_store_allocs" in text
+
+
+def test_device_memory_gauges(monkeypatch):
+    """SURVEY.md §5.5: per-chip HBM gauges via PJRT memory_stats, with the
+    two documented platform gaps (None stats, cpu devices) handled."""
+    import jax
+
+    class FakeDev:
+        platform = "tpu"
+        id = 3
+        device_kind = "TPU v5 lite"
+
+        def memory_stats(self):
+            return {"bytes_in_use": 123.0, "bytes_limit": 1000.0}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
+    out = metrics_lib.device_memory_gauges()
+    s = out["rtpu_device_hbm_bytes_in_use"]["series"][0]
+    assert s["value"] == 123.0 and s["tags"]["device"] == "3"
+    assert out["rtpu_device_hbm_bytes_limit"]["series"][0]["value"] == 1000.0
+    # only keys the platform exposes become gauges
+    assert "rtpu_device_hbm_peak_bytes" not in out
+
+    class RelayDev(FakeDev):
+        def memory_stats(self):  # relay-attached axon platform behavior
+            return None
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [RelayDev()])
+    assert metrics_lib.device_memory_gauges() == {}
