@@ -166,6 +166,11 @@ class PgState:
         self.bundle_avail: List[Dict[str, float]] = [dict(b) for b in bundles]
 
 
+# The GcsServer living in THIS process, if any (head == driver process).
+# Worker.rpc short-circuits to it; see the note in GcsServer.__init__.
+_INPROC_SERVER: Optional["GcsServer"] = None
+
+
 class GcsServer:
     def __init__(self, session: Session, head_resources: Dict[str, float]):
         self.session = session
@@ -266,6 +271,12 @@ class GcsServer:
         m = threading.Thread(target=self._monitor_loop, name="gcs-monitor", daemon=True)
         m.start()
         self._threads.append(m)
+        # In-process dispatch short-circuit (reference analog: core_worker
+        # short-circuiting its local raylet/plasma): a driver whose head
+        # lives in ITS OWN process skips the socket + serve-thread wakeup
+        # per RPC — Worker.rpc consults this global, guarded by rpc_path.
+        global _INPROC_SERVER
+        _INPROC_SERVER = self
 
     # ----------------------------------------------------- fault tolerance
     def _persist_durable(self) -> None:
@@ -1343,7 +1354,7 @@ class GcsServer:
                         if rid is not None:
                             try:
                                 wire.conn_send(conn, {"rid": rid, **replay},
-                                               ver)
+                                               ver, kind in wire._HOT_KINDS)
                             except (OSError, ValueError):
                                 break
                         continue
@@ -1364,7 +1375,8 @@ class GcsServer:
                         self._dedup_commit(key, reply)
                 if rid is not None:
                     try:
-                        wire.conn_send(conn, {"rid": rid, **reply}, ver)
+                        wire.conn_send(conn, {"rid": rid, **reply}, ver,
+                                       kind in wire._HOT_KINDS)
                     except (OSError, ValueError):
                         break
         finally:
@@ -1807,6 +1819,21 @@ class GcsServer:
             raise exc.RaySystemError(f"unknown rpc kind: {kind}")
         return handler(msg)
 
+    def local_call(self, kind: str, msg: dict) -> dict:
+        """In-process RPC: dispatch directly on the caller's thread.
+
+        Used by a driver whose head lives in its own process
+        (``_INPROC_SERVER``): no socket, no serve-thread wakeup, no frame
+        codec — the dominant costs of the serial round-trip on small
+        hosts.  Handler exceptions propagate to the caller directly
+        (the socket path's dumps_call/loads_call round-trip preserves
+        type anyway); no dedup ids are needed because there is no channel
+        to break mid-reply."""
+        if self._shutdown:
+            raise ConnectionError("GCS is shut down")
+        resp = self._dispatch(kind, msg)
+        return {"error": None, **(resp or {})}
+
     # --- registration
     def _h_register_client(self, msg: dict) -> dict:
         with self.cv:
@@ -2047,13 +2074,18 @@ class GcsServer:
             refs[msg["object_id"]] = refs.get(msg["object_id"], 0) + 1
         return {}
 
+    def _add_refs_locked(self, ledger: str, object_ids) -> None:
+        """Lock held — the ONE copy of ref-pinning (used by the add_refs
+        RPC and the submit-stream 'ref' op; the two must not drift)."""
+        refs = self.client_refs[ledger]
+        for oid in object_ids:
+            self._get_or_create_meta(oid).refcount += 1
+            refs[oid] = refs.get(oid, 0) + 1
+
     def _h_add_refs(self, msg: dict) -> dict:
-        ledger = msg.get("ledger") or msg["client_id"]
         with self.cv:
-            refs = self.client_refs[ledger]
-            for oid in msg["object_ids"]:
-                self._get_or_create_meta(oid).refcount += 1
-                refs[oid] = refs.get(oid, 0) + 1
+            self._add_refs_locked(msg.get("ledger") or msg["client_id"],
+                                  msg["object_ids"])
         return {}
 
     def _h_release_batch(self, msg: dict) -> dict:
@@ -2196,6 +2228,17 @@ class GcsServer:
                     except Exception:  # noqa: BLE001
                         logger.exception("submit_batch: release %s failed",
                                          payload)
+                elif kind == "ref":
+                    # batched add_refs riding the ordered stream (actor-
+                    # call return pins — saves a per-call oneway on the
+                    # direct-call hot path); MUST precede any later "rel"
+                    # of the same oid, which stream order gives
+                    try:
+                        self._add_refs_locked(
+                            payload.get("ledger") or client_id,
+                            payload["object_ids"])
+                    except Exception:  # noqa: BLE001
+                        logger.exception("submit_batch: ref op failed")
         self._pump()
         return {}
 
@@ -2798,6 +2841,9 @@ class GcsServer:
 
     # ------------------------------------------------------------------ close
     def shutdown(self) -> None:
+        global _INPROC_SERVER
+        if _INPROC_SERVER is self:
+            _INPROC_SERVER = None
         self._shutdown = True
         with self.cv:
             procs = [w.proc for w in self.workers.values() if w.proc is not None]

@@ -169,13 +169,33 @@ def rtmsg_loads(raw: bytes) -> Any:
 
 
 # ----------------------------------------------------------------- frames
-def encode_frame(obj: Any, version: int) -> bytes:
-    """Encode one message at the negotiated version (0 = legacy pickle)."""
+# µs-critical kinds stay on the pickle codec (C-speed) even at v2: the
+# pure-Python rtmsg encoder costs ~20µs/frame (measured, cProfile on the
+# actor serial-RT loop) vs ~2µs for C pickle, and these kinds sit on the
+# serial round-trip path.  The codec BYTE is per-frame, so a polyglot peer
+# that cannot speak pickle can still negotiate v2 and read every
+# non-payload control kind as rtmsg; same-language peers keep C-speed
+# where latency is the contract (BASELINE #7).
+_HOT_KINDS = frozenset({
+    "submit_batch", "submit_task", "get_meta", "peek_meta", "wait",
+    "add_refs", "release", "release_batch", "task_done", "call",
+    "put_object", "put_chunk", "fetch_chunk"})
+
+
+def encode_frame(obj: Any, version: int,
+                 prefer_pickle: bool = False) -> bytes:
+    """Encode one message at the negotiated version (0 = legacy pickle).
+
+    ``prefer_pickle`` marks a hot-path frame (reply to a hot kind); hot
+    requests are detected from their own "kind" field.
+    """
     if version == 0:
         return pickle.dumps(obj)
     if not PROTO_MIN <= version <= PROTO_MAX:
         raise ProtocolVersionError(f"cannot encode version {version}")
-    if version >= 2:
+    if version >= 2 and not prefer_pickle \
+            and (not isinstance(obj, dict)
+                 or obj.get("kind") not in _HOT_KINDS):
         try:
             return bytes((version, _CODEC_RTMSG)) + rtmsg_dumps(obj)
         except TypeError:
@@ -207,11 +227,12 @@ def decode_frame(raw: bytes) -> Tuple[Any, int]:
     raise WireError(f"unknown codec {codec}")
 
 
-def conn_send(conn, obj: Any, version: int) -> None:
+def conn_send(conn, obj: Any, version: int,
+              prefer_pickle: bool = False) -> None:
     if version == 0:
         conn.send(obj)  # legacy peers do a plain pickle recv()
     else:
-        conn.send_bytes(encode_frame(obj, version))
+        conn.send_bytes(encode_frame(obj, version, prefer_pickle))
 
 
 def conn_recv(conn) -> Tuple[Any, int]:

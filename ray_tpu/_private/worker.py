@@ -164,6 +164,12 @@ class Worker:
             weakref.WeakKeyDictionary()
         self._local_values: "OrderedDict[str, bytes]" = OrderedDict()
         self._local_lock = threading.Lock()
+        # signaled on every inline-result arrival AND on actor-channel
+        # death: get() parks here for in-flight direct calls instead of
+        # paying the GCS get_meta machinery (the reader thread loses the
+        # race on small hosts, turning every serial actor RT into a full
+        # control-plane round-trip — measured 2x the direct-path latency)
+        self._local_cv = threading.Condition(self._local_lock)
         self._actor_channels: Dict[str, "_ActorChannel"] = {}
         self._actor_chan_lock = threading.Lock()
         self._pulls: Dict[str, dict] = {}       # in-flight chunked pulls
@@ -272,12 +278,30 @@ class Worker:
         "export_function", "seal_errors", "kv_put", "kv_del",
         "pg_create", "pg_remove", "add_node", "remove_node"})
 
+    def _local_server(self):
+        """The GcsServer living in THIS process (head == driver), if it is
+        the one this worker is attached to — the in-process dispatch
+        short-circuit.  None for spawned workers, clients, and drivers
+        attached to an external head."""
+        if self.is_client:
+            return None
+        from ray_tpu._private import gcs as gcs_mod
+        srv = gcs_mod._INPROC_SERVER
+        if srv is not None and not srv._shutdown \
+                and srv.rpc_path == self.gcs_path:
+            return srv
+        return None
+
     def rpc(self, kind: str, **fields: Any) -> dict:
         # Two-way calls observe prior submits (FIFO illusion): flush the
         # submit batch first — e.g. a get_meta on a buffered task's return
         # must find the task registered.
         if self._submit_buf:
             self._flush_submits()
+        srv = self._local_server()
+        if srv is not None:
+            return srv.local_call(
+                kind, {"kind": kind, "client_id": self.worker_id, **fields})
         # Across a true GCS restart the dedup cache is empty and the retry
         # re-applies — the documented at-least-once contract for head
         # fault tolerance (fresh object table).
@@ -319,7 +343,19 @@ class Worker:
         sends internally), so every oneway in this process is globally
         FIFO at the server: a release can never overtake the submit whose
         dep pin it retires even when different threads (e.g. the submit
-        flusher vs the GC) issue them."""
+        flusher vs the GC) issue them.
+
+        In-process head: apply inline instead (strictly program-ordered —
+        stronger than the channel FIFO); handler errors are logged, not
+        raised, matching the socket path's fire-and-forget contract."""
+        srv = self._local_server()
+        if srv is not None:
+            try:
+                srv.local_call(kind, {"kind": kind, "rid": None,
+                                      "client_id": self.worker_id, **fields})
+            except Exception:  # noqa: BLE001 - oneway: log like the server
+                logger.exception("local one-way rpc %s failed", kind)
+            return
         ch = self._oneway_chan
         if ch is None:
             with self._oneway_init_lock:
@@ -550,6 +586,8 @@ class Worker:
                 else:
                     missing.append(oid)
         if missing:
+            missing = self._await_inline_results(missing, metas, deadline)
+        if missing:
             metas.update(self._blocking_get_meta(missing, deadline))
         # any meta observed at a terminal state completes its actor call
         # (the inline reply may have died with the actor; see
@@ -577,6 +615,63 @@ class Worker:
                         raise exc.ObjectLostError(oid, "shm segment vanished")
                     metas.update(self._blocking_get_meta([oid], deadline))
         return out
+
+    def _await_inline_results(self, missing: List[str], metas: dict,
+                              deadline: Optional[float]) -> List[str]:
+        """Direct-call fast path: when EVERY missing ref is the return of
+        an in-flight actor call on a live direct channel, park on the
+        inline-reply arrival instead of doing a GCS get_meta.
+
+        The reply lands on the channel reader thread; on small hosts the
+        reader reliably loses the race with the caller's get(), which then
+        pays the full control-plane round-trip (waiter registration, seal
+        event, reply encode) for a result that was already on its way —
+        measured 2x the direct-path serial latency.  Falls back to the
+        authoritative GCS path the moment any ref is not inline-eligible
+        (big results arrive seal-only, dead channels seal errors there).
+        Returns the refs still needing the GCS."""
+        if self.ctx.in_task:
+            # inside a task the GCS path is mandatory: it releases this
+            # worker's CPU while blocked (task_blocked) so the scheduler
+            # can run whatever the awaited call depends on — parking here
+            # instead can deadlock a fully-occupied host
+            return missing
+        flushed = False
+        while True:
+            with self._local_cv:
+                found = [o for o in missing if o in self._local_values]
+                for o in found:
+                    metas[o] = {"state": "ready", "loc": "inline",
+                                "data": self._local_values[o]}
+                if found:
+                    missing = [o for o in missing
+                               if o not in self._local_values]
+                if not missing:
+                    return []
+            with self._actor_chan_lock:
+                for oid in missing:
+                    ent = self._inflight_calls.get(oid)
+                    ch = self._actor_channels.get(ent[0]) if ent else None
+                    if ch is None or ch.closed:
+                        return missing  # not inline-eligible → GCS
+            if not flushed:
+                # this wait turned out to be a real block: deferred
+                # decrefs must not pin store memory for a long actor
+                # method (same contract as _blocking_get_meta) — but
+                # only pay the flush once we actually block, not on the
+                # already-arrived hot path
+                flushed = True
+                self._flush_releases()
+                continue  # the flush may have taken a while: re-check
+            with self._local_cv:
+                if any(o in self._local_values for o in missing):
+                    continue  # arrived between the two locks
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    return missing  # GCS path raises GetTimeoutError
+                # bounded slice: re-checks channel liveness/in-flight
+                # membership above even on a missed notify
+                self._local_cv.wait(0.05)
 
     def _blocking_get_meta(self, oids: List[str],
                            deadline: Optional[float]) -> dict:
@@ -704,6 +799,13 @@ class Worker:
             self._local_values[oid] = wire
             while len(self._local_values) > 4096:
                 self._local_values.popitem(last=False)
+            self._local_cv.notify_all()
+
+    def _wake_local_waiters(self) -> None:
+        """Channel-death hook: get() waiters parked on in-flight direct
+        calls must re-check (and fall back to the authoritative GCS)."""
+        with self._local_lock:
+            self._local_cv.notify_all()
 
     # --------------------------------------------------------------- export
     def export_callable(self, obj: Any) -> str:
@@ -967,6 +1069,38 @@ class Worker:
     def _flush_submits(self) -> None:
         self._drain_submits()
 
+    def _buffer_stream_op(self, op: tuple) -> None:
+        """Queue one op on the ordered submit stream (flushed within ~2ms
+        or before any two-way RPC).  Pin/unpin pairs for the same object
+        MUST both ride this stream: a pin that buffers while its release
+        goes out directly (socket oneway or the in-process inline path)
+        applies in the wrong order and frees the object under the pin —
+        the free-before-pin race."""
+        if self.is_client:
+            self.rpc_oneway("submit_batch", ops=[op])
+            return
+        full = False
+        with self._submit_lock:
+            self._submit_buf.append(op)
+            if not self._submit_first:
+                self._submit_first = time.monotonic()
+            full = len(self._submit_buf) >= 64
+            if not full:
+                self._ensure_flusher_locked()
+        if full:
+            self._drain_submits()
+
+    def _buffer_ref_add(self, object_ids: List[str],
+                        ledger: Optional[str] = None) -> None:
+        """add_refs on the ordered submit stream: one buffered op instead
+        of a per-call oneway message (the direct-call hot path issues one
+        or two of these per actor call).  The seal-with-zero-refs race
+        (actor seals before the batched ref lands) is covered by the
+        GCS's graceful-free grace, same as the old cross-channel oneway
+        was."""
+        self._buffer_stream_op(("ref", {"object_ids": object_ids,
+                                        "ledger": ledger}))
+
     def _ensure_flusher_locked(self) -> None:
         # _submit_lock held
         if not self._submit_flusher_on and not self.is_client:
@@ -1085,8 +1219,14 @@ class Worker:
         call_id = f"{self.worker_id}:{self._call_seq()}"
         return_ids = [str(ObjectID.make(self.worker_id, KIND_RETURN, self._ret_seq()))
                       for _ in range(num_returns)]
-        # hold refs: returns for us, args for the in-flight call
-        self.rpc_oneway("add_refs", object_ids=return_ids)
+        # return-id pins ride the buffered stream (their release is the
+        # client's own ObjectRef.__del__ → same stream, ordered).  Arg
+        # pins must NOT buffer: the actor's release_all for this call's
+        # ledger races ahead of a deferred flush on a fast method (no
+        # cross-channel ordering) and would pop the ledger before the pin
+        # lands, leaking the args forever.  Sent BEFORE the call, the pin
+        # is always in flight ahead of the actor's completion.
+        self._buffer_ref_add(return_ids)
         hold = deps + borrows
         if hold:
             self.rpc_oneway("add_refs", object_ids=hold,
@@ -1103,7 +1243,10 @@ class Worker:
                 self._inflight_calls[oid] = (actor_id, call_id)
         ch.send_call(msg)
         for oid in transient:
-            self.rpc_oneway("release", object_id=oid)
+            # MUST follow the arg-pin "ref" op in stream order — a direct
+            # oneway here applies before the buffered pin and frees the
+            # arg payload under it (free-before-pin)
+            self._buffer_stream_op(("rel", oid))
         return [ObjectRef(oid, worker=self) for oid in return_ids]
 
     def _mark_call_done(self, oid: str) -> None:
@@ -1510,12 +1653,18 @@ class _ActorChannel:
             call_id = msg.get("call_id")
             with self._lock:
                 self._outstanding.pop(call_id, None)
-            with self.worker._actor_chan_lock:
-                for oid in msg["return_ids"]:
-                    self.worker._inflight_calls.pop(oid, None)
+            # cache BEFORE clearing in-flight state: a get() parked on the
+            # inline fast path (_await_inline_results) re-checks the cache
+            # first and must find the value the moment it wakes
             for oid, res in zip(msg["return_ids"], msg.get("inline_results") or []):
                 if res is not None:
                     self.worker.cache_local(oid, res)
+            with self.worker._actor_chan_lock:
+                for oid in msg["return_ids"]:
+                    self.worker._inflight_calls.pop(oid, None)
+            # non-inline (big) results: wake parked getters so they fall
+            # through to the authoritative GCS path
+            self.worker._wake_local_waiters()
         self._on_disconnect()
 
     def _on_disconnect(self) -> None:
@@ -1585,6 +1734,9 @@ class _ActorChannel:
         if not resubmit:
             with self._lock:
                 self.closed = True
+        # parked inline-fast-path getters must re-check channel liveness
+        # (a closed channel routes them to the authoritative GCS path)
+        self.worker._wake_local_waiters()
 
     def close(self) -> None:
         with self._lock:
@@ -1594,3 +1746,4 @@ class _ActorChannel:
                     self._conn.close()
                 except OSError:
                     pass
+        self.worker._wake_local_waiters()

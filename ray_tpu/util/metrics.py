@@ -244,6 +244,16 @@ def device_memory_gauges() -> Dict[str, dict]:
     jax_mod = _sys.modules.get("jax")
     if jax_mod is None:
         return {}
+    try:
+        # merely having jax imported is not enough: local_devices() on an
+        # UNinitialized process triggers full PJRT backend init (seconds,
+        # and on TPU a second-process libtpu init can hang or contend for
+        # the trainer's chip).  Only read devices from a backend some
+        # other code already paid for.
+        if not jax_mod._src.xla_bridge._backends:
+            return {}
+    except AttributeError:  # internal layout moved: skip, never init
+        return {}
     names = (("bytes_in_use", "rtpu_device_hbm_bytes_in_use",
               "HBM bytes currently allocated (PJRT memory_stats)"),
              ("peak_bytes_in_use", "rtpu_device_hbm_peak_bytes",
