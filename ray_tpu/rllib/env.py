@@ -183,6 +183,37 @@ register_env("RandomPixelEnv", lambda cfg: RandomPixelEnv(cfg))
 register_env("PixelSquareEnv", lambda cfg: PixelSquareEnv(cfg))
 
 
+class SlowEnv:
+    """Wraps any registered env with a fixed per-step latency
+    (``env_config: {"inner": name, "inner_config": {...},
+    "step_delay_ms": float}``).
+
+    Models the simulator/remote-game envs async IMPALA exists for: the
+    actor spends most of a step WAITING, not computing — exactly the
+    latency the actor/learner pipeline hides (reference: IMPALA paper's
+    motivation; used by ``rllib_bench.py impala_overlap``)."""
+
+    def __init__(self, cfg: Optional[dict] = None):
+        import time as _t
+        cfg = cfg or {}
+        self._delay = float(cfg.get("step_delay_ms", 2.0)) / 1e3
+        self._sleep = _t.sleep
+        self._inner = create_env(cfg.get("inner", "RandomEnv"),
+                                 cfg.get("inner_config", {}))
+        self.observation_space = self._inner.observation_space
+        self.action_space = self._inner.action_space
+
+    def reset(self, seed: Optional[int] = None):
+        return self._inner.reset(seed=seed)
+
+    def step(self, action):
+        self._sleep(self._delay)
+        return self._inner.step(action)
+
+
+register_env("SlowEnv", lambda cfg: SlowEnv(cfg))
+
+
 def create_env(env: Any, env_config: Optional[dict] = None):
     """Resolve an env spec: registered name, gymnasium id, class, or
     callable."""
