@@ -92,6 +92,40 @@ def main() -> int:
     assert rows == B * R
     results["random_shuffle_rows_per_s"] = round(rows / dt, 1)
 
+    # 4) Arrow block format (r4, VERDICT r3 missing #4): parquet
+    # read->slice->concat->write with Tables as blocks (no numpy
+    # conversion on the IO path) vs the numpy-block path on the same file
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.context import DataContext
+    tmp = tempfile.mkdtemp(prefix="rtpu_data_bench_")
+    n_rows = B * R // 4
+    src = pa.table({
+        "id": np.arange(n_rows, dtype=np.int64),
+        "val": np.random.default_rng(0).random(n_rows),
+        "txt": pa.array([f"row-{i}" for i in range(n_rows)]),
+    })
+    pq.write_table(src, os.path.join(tmp, "in.parquet"))
+    ctx = DataContext.get_current()
+    for fmt in ("numpy", "arrow"):
+        ctx.block_format = fmt
+        t0 = time.perf_counter()
+        ds4 = rd.read_parquet(tmp).materialize()
+        refs = list(ds4._cached_refs)
+        total = sum(ray_tpu.get(r).num_rows if fmt == "arrow"
+                    else len(ray_tpu.get(r)["id"]) for r in refs)
+        ds4.write_parquet(os.path.join(tmp, f"out_{fmt}"))
+        dt = time.perf_counter() - t0
+        assert total == n_rows
+        results[f"parquet_roundtrip_{fmt}_rows_per_s"] = round(n_rows / dt, 1)
+    ctx.block_format = "numpy"
+    results["arrow_vs_numpy_parquet_speedup"] = round(
+        results["parquet_roundtrip_arrow_rows_per_s"]
+        / results["parquet_roundtrip_numpy_rows_per_s"], 2)
+
     out_doc = {
         "baseline_row": ("SURVEY.md §2.5 Ray Data row (streaming "
                          "executor); VERDICT r2 next-round #3"),

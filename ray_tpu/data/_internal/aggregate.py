@@ -26,9 +26,16 @@ _KERNELS = {
 
 
 def apply_groupby(block: Block, key: str, aggs: List[AggSpec]) -> Block:
-    if not block:
+    acc = BlockAccessor(block)
+    if not acc.num_rows():
         return {}
-    keys = block[key]
+    # kernels are numpy reductions; pull ONLY the key + agg input columns
+    # through the accessor (format-dispatching) so Arrow blocks aggregate
+    # identically without converting unrelated columns (result block
+    # stays numpy — the reduce output is small)
+    needed = {key} | {on for _, on, _ in aggs if on}
+    cols = {c: acc.get_column(c) for c in needed}
+    keys = cols[key]
     order = np.argsort(keys, kind="stable")
     sorted_keys = keys[order]
     # group boundaries
@@ -44,6 +51,6 @@ def apply_groupby(block: Block, key: str, aggs: List[AggSpec]) -> Block:
         idx = order[s:e]
         out[key].append(sorted_keys[s])
         for agg_name, on_col, out_name in aggs:
-            col = block[on_col] if on_col else keys
+            col = cols[on_col] if on_col else keys
             out[out_name].append(_KERNELS[agg_name](col[idx]))
     return {k: np.asarray(v) for k, v in out.items()}

@@ -217,7 +217,7 @@ def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
 
         def part_fn(block: Block, n: int, _b=bounds, _k=key) -> List[Block]:
             acc = BlockAccessor(block)
-            col = block.get(_k)
+            col = acc.get_column(_k)
             if col is None:
                 return [acc.slice(0, 0) for _ in range(n)]
             assign = np.searchsorted(np.asarray(_b), col, side="right")
@@ -226,9 +226,10 @@ def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
 
         def reduce_fn(pieces: List[Block], _k=key, _d=descending) -> Block:
             out = concat_blocks(pieces)
-            if not out:
+            acc = BlockAccessor(out)
+            if not acc.num_rows():
                 return out
-            order = np.argsort(out[_k], kind="stable")
+            order = np.argsort(acc.get_column(_k), kind="stable")
             if _d:
                 order = order[::-1]
             return BlockAccessor(out).take_idx(order)
@@ -238,7 +239,7 @@ def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
 
         def part_fn(block: Block, n: int, _k=key) -> List[Block]:
             acc = BlockAccessor(block)
-            col = block.get(_k)
+            col = acc.get_column(_k)
             if col is None:
                 return [acc.slice(0, 0) for _ in range(n)]
             h = np.array([_stable_hash(x) % n for x in col.tolist()])
@@ -253,7 +254,7 @@ def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
 
         def part_fn(block: Block, n: int, _k=key) -> List[Block]:
             acc = BlockAccessor(block)
-            col = block.get(_k)
+            col = acc.get_column(_k)
             if col is None:
                 return [acc.slice(0, 0) for _ in range(n)]
             h = np.array([_stable_hash(x) % n for x in col.tolist()])

@@ -21,6 +21,11 @@ class DataContext:
     # rows per batch when batch_size is unset in map_batches
     default_batch_size: int = 1024
     use_push_based_shuffle: bool = True
+    # "numpy" (default: dict-of-ndarray blocks, zero-copy out of the shm
+    # store and directly device_put-able) or "arrow" (pyarrow Table
+    # blocks: zero-copy slice/concat, schema'd tabular path, conversion-
+    # free parquet IO — the reference's block representation)
+    block_format: str = "numpy"
 
     _instance = None
     _lock = threading.Lock()

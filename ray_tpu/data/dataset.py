@@ -25,6 +25,11 @@ from ray_tpu.data.context import DataContext
 
 def _batched_map_fn(fn: Callable, batch_size: Optional[int],
                     batch_format: str) -> Callable[[Block], Block]:
+    # block_format is captured at DATASET-BUILD time (driver context) —
+    # the closure executes in workers, whose DataContext singleton is a
+    # fresh default
+    blk_fmt = DataContext.get_current().block_format
+
     def apply(block: Block) -> Block:
         acc = BlockAccessor(block)
         rows = acc.num_rows()
@@ -36,15 +41,17 @@ def _batched_map_fn(fn: Callable, batch_size: Optional[int],
             batch = BlockAccessor(acc.slice(s, min(s + bs, rows))) \
                 .to_batch(batch_format)
             out = fn(batch)
-            outs.append(BlockAccessor.batch_to_block(out))
+            outs.append(BlockAccessor.batch_to_block(out, blk_fmt))
         return concat_blocks(outs)
     return apply
 
 
 def _row_map_fn(fn: Callable) -> Callable[[Block], Block]:
+    blk_fmt = DataContext.get_current().block_format
+
     def apply(block: Block) -> Block:
         rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
-        return block_from_rows(rows)
+        return block_from_rows(rows, blk_fmt)
     return apply
 
 
@@ -94,11 +101,13 @@ class Dataset:
         return self._with_stage(st)
 
     def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        blk_fmt = DataContext.get_current().block_format
+
         def apply(block: Block) -> Block:
             rows: List[Dict] = []
             for r in BlockAccessor(block).iter_rows():
                 rows.extend(fn(r))
-            return block_from_rows(rows)
+            return block_from_rows(rows, blk_fmt)
         return self._with_stage(MapStage(apply, "FlatMap"))
 
     def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
@@ -113,24 +122,22 @@ class Dataset:
         def apply(block: Block) -> Block:
             acc = BlockAccessor(block)
             vals = [fn(batch) for batch in [acc.to_batch("numpy")]]
-            out = dict(block)
-            out[name] = np.asarray(vals[0])
-            return out
+            return acc.with_column(name, vals[0])
         return self._with_stage(MapStage(apply, "AddColumn"))
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
         def apply(block: Block) -> Block:
-            return {k: v for k, v in block.items() if k not in cols}
+            return BlockAccessor(block).drop(cols)
         return self._with_stage(MapStage(apply, "DropColumns"))
 
     def select_columns(self, cols: List[str]) -> "Dataset":
         def apply(block: Block) -> Block:
-            return {k: block[k] for k in cols}
+            return BlockAccessor(block).select(cols)
         return self._with_stage(MapStage(apply, "SelectColumns"))
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         def apply(block: Block) -> Block:
-            return {mapping.get(k, k): v for k, v in block.items()}
+            return BlockAccessor(block).rename(mapping)
         return self._with_stage(MapStage(apply, "RenameColumns"))
 
     # ----------------------------------------------------------- shuffles
@@ -147,7 +154,7 @@ class Dataset:
         samples: List[np.ndarray] = []
         for ref in self._cached_refs:
             block = ray_tpu.get(ref)
-            col = block.get(key)
+            col = BlockAccessor(block).get_column(key)
             if col is not None and len(col):
                 samples.append(np.random.default_rng(0).choice(
                     col, size=min(100, len(col)), replace=False)
@@ -198,11 +205,7 @@ class Dataset:
             # whole right dataset to the driver
             pieces = [BlockAccessor(right_blocks[i]).slice(lo, hi)
                       for i, (_, lo, hi) in enumerate(spans)]
-            b = concat_blocks(pieces)
-            out = dict(a)
-            for k, v in b.items():
-                out[k if k not in a else f"{k}_1"] = v
-            return out
+            return BlockAccessor(a).merge(concat_blocks(pieces))
 
         # map each left block's global row range onto right-block spans
         r_starts = np.concatenate([[0], np.cumsum(right_counts)])
@@ -375,13 +378,15 @@ class Dataset:
             if n == 0:
                 return block
             import zlib
+            edge = concat_blocks([acc.slice(0, 1), acc.slice(n - 1, n)])
             sig = zlib.crc32(n.to_bytes(8, "little") + b"".join(
-                np.ascontiguousarray(np.asarray(v)[:1]).tobytes() +
-                np.ascontiguousarray(np.asarray(v)[-1:]).tobytes()
-                for v in block.values()))
+                np.ascontiguousarray(np.asarray(v)).tobytes()
+                if getattr(np.asarray(v), "dtype", None) != object
+                else repr(list(v)).encode()
+                for v in BlockAccessor(edge).to_batch("numpy").values()))
             rng = np.random.default_rng([int(base) % (2 ** 63), sig])
             mask = rng.random(n) < fraction
-            return {k: np.asarray(v)[mask] for k, v in block.items()}
+            return acc.take_idx(np.nonzero(mask)[0])
         return self._with_stage(MapStage(apply, "RandomSample"))
 
     def schema(self) -> Optional[Dict[str, Any]]:
@@ -553,16 +558,18 @@ class GroupedData:
     def map_groups(self, fn: Callable[[Dict[str, np.ndarray]], Any]) -> Dataset:
         key = self._key
 
+        blk_fmt = DataContext.get_current().block_format
+
         def apply(block: Block) -> Block:
-            if not block:
-                return block
-            keys = block[key]
             acc = BlockAccessor(block)
+            if not acc.num_rows():
+                return block
+            keys = acc.get_column(key)
             outs = []
             for val in dict.fromkeys(keys.tolist()):  # ordered unique
                 idx = np.nonzero(keys == val)[0]
-                out = fn(acc.take_idx(idx))
-                outs.append(BlockAccessor.batch_to_block(out))
+                out = fn(BlockAccessor(acc.take_idx(idx)).to_batch("numpy"))
+                outs.append(BlockAccessor.batch_to_block(out, blk_fmt))
             return concat_blocks(outs)
 
         # hash-partition so each group lands wholly in one block, then map

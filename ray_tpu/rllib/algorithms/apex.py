@@ -260,9 +260,13 @@ class APEX(Algorithm):
         done_updates = 0
         warm = self._added >= int(cfg["learning_starts"])
         # keep one prioritized sample in flight per shard once warm
+        # (parked shards stay parked — an add_batch routing to them wakes
+        # them below; re-issuing here would stack a second sample chain
+        # on the same shard)
         if warm:
             for i, shard in enumerate(self.replay_shards):
-                if i not in self._replay_futs.values():
+                if i not in self._replay_futs.values() \
+                        and i not in self._shard_idle:
                     self._replay_futs[shard.sample.remote(
                         batch_size, beta)] = i
         while done_updates < n_updates:
@@ -296,6 +300,9 @@ class APEX(Algorithm):
                 shard = self.replay_shards[i]
                 out = ray_tpu.get(fut)
                 if out is not None:
+                    # a stale park flag here would let the next routed
+                    # fragment wake the shard into a SECOND chain
+                    self._shard_idle.discard(i)
                     cols, idx, w = out
                     info.update(self._learn(cols, idx, w, shard))
                     done_updates += 1
