@@ -130,8 +130,8 @@ def main() -> None:
         # ~35% at this config on v5e.  remat_policy="attn_qkv" pins the
         # flash out/lse residuals + the qkv projection across the remat
         # boundary — the backward re-runs neither the attention kernel nor
-        # the qkv matmul (r3 device-trace work; full decomposition in
-        # benchmarks/results/step_breakdown_r03.md).
+        # the qkv matmul (r3/r4 device-trace work; full decomposition in
+        # benchmarks/results/step_breakdown_r04.md).
         cfg = dataclasses.replace(gpt2.gpt2_small(), attn_impl="flash",
                                   remat_policy="attn_qkv")
         batch, seq, steps = 32, 1024, 20

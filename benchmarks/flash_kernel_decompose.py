@@ -59,20 +59,44 @@ def main() -> int:
             fa._flash_forward_lse, causal=True, block_size=bs,
             interpret=False, want_lse=True))
 
+        def _delta(out, g):
+            d = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)
+            return d.transpose(0, 2, 1).reshape(B * H, 1, T)
+
         def bwd(q, k, v, out, lse, g, bs=bs):
-            return fa._flash_backward(q, k, v, out, lse, g, causal=True,
-                                      block_size=bs, interpret=False)
+            # full backward as the vjp runs it (delta precompute +
+            # flattens included) — comparable with the r3 measurements.
+            delta = _delta(out, g)
+            qf, kf, vf = fa._flatten(q), fa._flatten(k), fa._flatten(v)
+            dof = fa._flatten(g).astype(q.dtype)
+            return fa._flash_backward_flat(qf, kf, vf, lse, delta, dof,
+                                           causal=True, block_size=bs,
+                                           interpret=False)
+
+        def bwd_flat(qf, kf, vf, lse, delta, dof, bs=bs):
+            # kernel only: operands pre-staged in the kernel layout
+            return fa._flash_backward_flat(qf, kf, vf, lse, delta, dof,
+                                           causal=True, block_size=bs,
+                                           interpret=False)
 
         out, lse = fwd_lse(q, k, v)
         bwd_j = jax.jit(bwd)
+        bwd_flat_j = jax.jit(bwd_flat)
+        qf, kf, vf = fa._flatten(q), fa._flatten(k), fa._flatten(v)
+        dof = fa._flatten(g)
+        delta = _delta(out, g)
+        qf, kf, vf, dof, delta = jax.device_put((qf, kf, vf, dof, delta))
         ms_fwd = timeit(jax, fwd_nolse, q, k, v)
         ms_fwd_lse = timeit(jax, fwd_lse, q, k, v)
         ms_bwd = timeit(jax, bwd_j, q, k, v, out, lse, g)
+        ms_bwd_flat = timeit(jax, bwd_flat_j, qf, kf, vf, lse, delta, dof)
         print(json.dumps({
             "block": bs,
             "fwd_ms": round(ms_fwd, 2),
             "fwd_lse_ms": round(ms_fwd_lse, 2),
             "bwd_ms": round(ms_bwd, 2),
+            "bwd_flat_ms": round(ms_bwd_flat, 2),
             "per_step_x12_ms": round(12 * (ms_fwd_lse + ms_bwd), 1),
         }), flush=True)
     return 0

@@ -178,9 +178,11 @@ def _run_wave(source: Optional[ReadStage], refs: Optional[List[Any]],
 # --------------------------------------------------------------- shuffles
 def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
     import cloudpickle
+    from ray_tpu.data.context import DataContext
     kind = st.kind
     kw = st.kwargs
     n_out = kw.get("num_blocks") or max(1, len(input_refs))
+    blk_fmt = DataContext.get_current().block_format
 
     if kind == "repartition":
         def part_fn(block: Block, n: int) -> List[Block]:
@@ -189,8 +191,8 @@ def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
             bounds = np.linspace(0, rows, n + 1).astype(int)
             return [acc.slice(bounds[k], bounds[k + 1]) for k in range(n)]
 
-        def reduce_fn(pieces: List[Block]) -> Block:
-            return concat_blocks(pieces)
+        def reduce_fn(pieces: List[Block], _f=blk_fmt) -> Block:
+            return concat_blocks(pieces, _f)
 
     elif kind == "random_shuffle":
         seed = kw.get("seed")
@@ -203,8 +205,8 @@ def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
             return [acc.take_idx(np.nonzero(assign == k)[0])
                     for k in range(n)]
 
-        def reduce_fn(pieces: List[Block], _seed=seed) -> Block:
-            out = concat_blocks(pieces)
+        def reduce_fn(pieces: List[Block], _seed=seed, _f=blk_fmt) -> Block:
+            out = concat_blocks(pieces, _f)
             acc = BlockAccessor(out)
             rng = np.random.default_rng(_seed)
             perm = rng.permutation(acc.num_rows())
@@ -224,8 +226,9 @@ def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
             return [acc.take_idx(np.nonzero(assign == k)[0])
                     for k in range(n)]
 
-        def reduce_fn(pieces: List[Block], _k=key, _d=descending) -> Block:
-            out = concat_blocks(pieces)
+        def reduce_fn(pieces: List[Block], _k=key, _d=descending,
+                      _f=blk_fmt) -> Block:
+            out = concat_blocks(pieces, _f)
             acc = BlockAccessor(out)
             if not acc.num_rows():
                 return out
@@ -245,8 +248,8 @@ def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
             h = np.array([_stable_hash(x) % n for x in col.tolist()])
             return [acc.take_idx(np.nonzero(h == k)[0]) for k in range(n)]
 
-        def reduce_fn(pieces: List[Block]) -> Block:
-            return concat_blocks(pieces)
+        def reduce_fn(pieces: List[Block], _f=blk_fmt) -> Block:
+            return concat_blocks(pieces, _f)
 
     elif kind == "groupby":
         key = kw["key"]
@@ -260,9 +263,10 @@ def _run_shuffle(st: AllToAllStage, input_refs: List[Any]) -> List[Any]:
             h = np.array([_stable_hash(x) % n for x in col.tolist()])
             return [acc.take_idx(np.nonzero(h == k)[0]) for k in range(n)]
 
-        def reduce_fn(pieces: List[Block], _k=key, _aggs=aggs) -> Block:
+        def reduce_fn(pieces: List[Block], _k=key, _aggs=aggs,
+                      _f=blk_fmt) -> Block:
             from ray_tpu.data._internal.aggregate import apply_groupby
-            return apply_groupby(concat_blocks(pieces), _k, _aggs)
+            return apply_groupby(concat_blocks(pieces, _f), _k, _aggs)
 
     else:
         raise ValueError(f"unknown shuffle kind {kind!r}")

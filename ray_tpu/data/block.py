@@ -321,11 +321,21 @@ class ArrowBlockAccessor(BlockAccessor):
         raise ValueError(f"unknown batch_format {batch_format!r}")
 
 
-def concat_blocks(blocks: Sequence[Block]) -> Block:
-    blocks = [b for b in blocks
-              if b is not None and BlockAccessor(b).num_rows()]
-    if not blocks:
-        return _empty_arrow() if _block_format() == "arrow" else {}
+def concat_blocks(blocks: Sequence[Block],
+                  block_format: Optional[str] = None) -> Block:
+    """``block_format`` matters only for the all-empty case: worker-side
+    callers must pass their driver-captured format (the worker's
+    DataContext singleton is a fresh default), or the inputs' own format
+    decides."""
+    nonempty = [b for b in blocks
+                if b is not None and BlockAccessor(b).num_rows()]
+    if not nonempty:
+        fmt = block_format
+        if fmt is None and any(_is_arrow(b) for b in blocks
+                               if b is not None):
+            fmt = "arrow"
+        return _empty_arrow() if (fmt or _block_format()) == "arrow" else {}
+    blocks = nonempty
     if any(_is_arrow(b) for b in blocks):
         import pyarrow as pa
         tables = [b if _is_arrow(b)

@@ -42,7 +42,7 @@ def _batched_map_fn(fn: Callable, batch_size: Optional[int],
                 .to_batch(batch_format)
             out = fn(batch)
             outs.append(BlockAccessor.batch_to_block(out, blk_fmt))
-        return concat_blocks(outs)
+        return concat_blocks(outs, blk_fmt)
     return apply
 
 

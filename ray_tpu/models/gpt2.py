@@ -243,7 +243,9 @@ def forward_hidden(params: Params, tokens: jax.Array,
             # per GPT-2-small layer at b32/s1024).  "attn_qkv" also pins
             # the qkv projection — the one matmul the replay would re-run
             # — at (B,T,3E) bf16 per layer; right for small models,
-            # OOMs ≥ gpt2-medium at b32/s1024 on 16GB chips.
+            # OOMs ≥ gpt2-medium at b32/s1024 on 16GB chips.  (Pinning
+            # the kernel-layout q/k/v instead measured +15ms on the
+            # forward scan — see step_breakdown_r04.md.)
             names = ["flash_attn_out", "flash_attn_lse"]
             if cfg.remat_policy == "attn_qkv":
                 names.append("attn_qkv")
