@@ -95,6 +95,10 @@ _FLAG_DEFS = [
           "(reference: PullManager bandwidth admission)."),
     # --- scheduler / workers -------------------------------------------------
     _flag("num_workers_per_node", 0, "Size of worker pool (0 = num_cpus)."),
+    _flag("prestart_workers", 0,
+          "Plain workers forked eagerly at head start (warm pool: Serve "
+          "scale-ups and first tasks skip the worker boot; reference: "
+          "prestart_worker_first_driver)."),
     _flag("worker_register_timeout_s", 30.0, "Timeout for a spawned worker to register."),
     _flag("worker_lease_cache", True, "Reuse leased idle workers for same-shape tasks."),
     _flag("worker_pipeline_depth", 4,

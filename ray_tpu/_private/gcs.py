@@ -242,6 +242,13 @@ class GcsServer:
 
         self.head_node_id = NodeID.new()
         self.add_node_internal(self.head_node_id, head_resources, is_head=True)
+        # Warm worker pool (reference: RAY_prestart_worker_first_driver /
+        # worker-pool prestart): fork N plain workers NOW so the first
+        # tasks — and Serve replica scale-ups (SURVEY.md §7.3 TPU cold
+        # starts) — skip the worker-process boot (~10s on 1-core hosts,
+        # measured in serve_bench_r04.json).
+        for _ in range(int(GLOBAL_CONFIG.prestart_workers or 0)):
+            self._spawn_worker(self.head_node_id)
 
         # GCS fault tolerance (reference: GCS restart w/ Redis persistence,
         # SURVEY.md §5.3): durable tables snapshot to <session>/gcs_state;

@@ -284,7 +284,13 @@ class ServeController:
             if rs.health_ref is None:
                 if now - rs.started_at >= period:
                     rs.started_at = now
-                    rs.health_ref = rs.handle.check_health.remote()
+                    try:
+                        rs.health_ref = rs.handle.check_health.remote()
+                    except Exception:  # noqa: BLE001 - actor already dead:
+                        # a raising submit must not abort the whole tick
+                        # (it previously left the dead replica in the
+                        # ready set forever — every tick re-raised)
+                        self._replica_died(st, tag, "health submit failed")
                 continue
             done, _ = ray_tpu.wait([rs.health_ref], num_returns=1, timeout=0)
             if not done:

@@ -224,3 +224,33 @@ def test_streaming_response_handle_and_http(serve_cluster):
     status, body = _http("GET", _base_url() + "/stream")
     assert status == 200
     assert body.decode() == "c0|c1|c2|c3|c4|"
+
+
+def test_replica_death_recovery(serve_cluster):
+    """An externally-killed replica must leave the ready set and be
+    replaced (controller health loop; the r4 fix guards the health-probe
+    submit so one dead actor cannot abort the whole tick forever)."""
+    @serve.deployment(num_replicas=2, health_check_period_s=0.3)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Echo.bind(), route_prefix="/rk", name="rk")
+    assert h.remote(1).result() == 1
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    key = next(k for k in ray_tpu.get(ctrl.status.remote()) if k == "rk#Echo")
+    tg = ray_tpu.get(ctrl.get_deployment_targets.remote(key))
+    victim = next(iter(tg["replicas"].values()))
+    ray_tpu.kill(ray_tpu.get_actor(victim), no_restart=True)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = ray_tpu.get(ctrl.status.remote())[key]
+        tg = ray_tpu.get(ctrl.get_deployment_targets.remote(key))
+        if st["ready"] >= 2 and victim not in tg["replicas"].values():
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(
+            f"replica not replaced: {st} {tg['replicas']}")
+    # and the deployment still serves
+    assert h.remote(7).result() == 7
