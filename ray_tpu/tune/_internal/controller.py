@@ -139,6 +139,8 @@ class TuneController:
                 trial.latest_checkpoint_path = payload["checkpoint_path"]
                 self._apply_checkpoint_retention(trial)
             trial.metrics_history.append(metrics)
+            if self.searcher is not None:
+                self.searcher.on_trial_result(trial.id, metrics)
             decision = self.scheduler.on_trial_result(self, trial, metrics)
             if decision == TrialScheduler.STOP or \
                     self._hit_stop_criteria(metrics):

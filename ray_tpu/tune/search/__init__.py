@@ -7,3 +7,7 @@ from ray_tpu.tune.search.sample import (  # noqa: F401
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator  # noqa: F401
 from ray_tpu.tune.search.searcher import Searcher  # noqa: F401
 from ray_tpu.tune.search.tpe import TPESearcher  # noqa: F401
+from ray_tpu.tune.search.bohb import BOHBSearcher, HyperBandForBOHB  # noqa: F401
+from ray_tpu.tune.search.adapters import (  # noqa: F401
+    HyperOptSearch, OptunaSearch,
+)

@@ -32,6 +32,11 @@ class Searcher:
     def suggest(self, trial_id: str) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def on_trial_result(self, trial_id: str,
+                        result: Dict[str, Any]) -> None:
+        """Intermediate (per-report) observation — multi-fidelity
+        searchers (BOHB) learn from rung results, not just finals."""
+
     def on_trial_complete(self, trial_id: str,
                           result: Optional[Dict[str, Any]]) -> None:
         pass
