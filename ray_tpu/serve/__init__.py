@@ -14,11 +14,15 @@ TPU-first design points:
 """
 
 from ray_tpu.serve.api import (  # noqa: F401
-    delete, get_app_handle, get_deployment_handle, get_http_address, run,
-    shutdown, start, status,
+    delete, get_app_handle, get_deployment_handle, get_grpc_address,
+    get_http_address, run, shutdown, start, status,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
-from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions  # noqa: F401
+from ray_tpu.serve.config import (AutoscalingConfig, HTTPOptions,  # noqa: F401
+                                  gRPCOptions)
+from ray_tpu.serve.multiplex import (  # noqa: F401
+    get_multiplexed_model_id, multiplexed,
+)
 from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from ray_tpu.serve.http_util import (Request, Response,  # noqa: F401
@@ -27,8 +31,9 @@ from ray_tpu.serve.http_util import (Request, Response,  # noqa: F401
 __all__ = [
     "deployment", "run", "start", "shutdown", "status", "delete",
     "get_app_handle", "get_deployment_handle", "get_http_address",
-    "batch", "AutoscalingConfig", "HTTPOptions", "Application",
-    "StreamingResponse",
+    "get_grpc_address", "batch", "AutoscalingConfig", "HTTPOptions",
+    "gRPCOptions", "Application", "StreamingResponse",
+    "multiplexed", "get_multiplexed_model_id",
     "Deployment", "DeploymentHandle", "DeploymentResponse",
     "Request", "Response",
 ]

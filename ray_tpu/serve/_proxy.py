@@ -124,12 +124,16 @@ class ProxyActor:
         request = Request.from_parts(req.command, req.path,
                                      dict(req.headers), body, prefix)
         handle = DeploymentHandle(dep_key)
+        # reference header contract: serve_multiplexed_model_id routes to
+        # a replica already holding that model (multiplex.py)
+        model_id = req.headers.get("serve_multiplexed_model_id", "")
         try:
             # The configured request timeout bounds BOTH phases: waiting
             # for a replica (assign) and waiting for the result.
             start = time.monotonic()
             resp_f = handle._router().assign(
-                "__call__", (request,), {}, timeout_s=self._timeout)
+                "__call__", (request,), {}, timeout_s=self._timeout,
+                multiplexed_model_id=model_id)
             remaining = max(0.1, self._timeout - (time.monotonic() - start))
             # raw result: a stream MARKER must reach the chunked-encoding
             # path below, not result()'s generator conversion

@@ -77,31 +77,51 @@ class Replica:
 
         args = tuple(resolve(a) for a in args)
         kwargs = {k: resolve(v) for k, v in kwargs.items()}
+        # multiplex routing metadata rides a reserved kwarg; expose it to
+        # the user method via serve.get_multiplexed_model_id()
+        model_id = kwargs.pop("__serve_model_id__", "")
+        from ray_tpu.serve import multiplex as _mux
         m = getattr(self._instance, method)
         if inspect.iscoroutinefunction(m):
-            fut = asyncio.run_coroutine_threadsafe(
-                m(*args, **kwargs), self._loop)
+            # contextvars do not cross run_coroutine_threadsafe into the
+            # loop thread; set the id inside the task's own context
+            async def _run():
+                tok = _mux._set_model_id(model_id)
+                try:
+                    return await m(*args, **kwargs)
+                finally:
+                    _mux._current_model_id.reset(tok)
+
+            fut = asyncio.run_coroutine_threadsafe(_run(), self._loop)
             result = fut.result()
         else:
-            result = m(*args, **kwargs)
-        return self._maybe_register_stream(result)
+            token = _mux._set_model_id(model_id)
+            try:
+                result = m(*args, **kwargs)
+            finally:
+                _mux._current_model_id.reset(token)
+        return self._maybe_register_stream(result, model_id)
 
     # ------------------------------------------------------------ streaming
-    def _maybe_register_stream(self, result: Any):
+    def _maybe_register_stream(self, result: Any, model_id: str = ""):
         """Generators / StreamingResponse stay replica-side; the caller
         gets a marker and pulls chunks via ``stream_next`` (the router
-        pins continuations to THIS replica)."""
+        pins continuations to THIS replica).  ``model_id`` is remembered
+        with the stream: a generator body executes during stream_next
+        pulls (arbitrary actor threads), so get_multiplexed_model_id()
+        must be re-established around each pull, not around the call
+        that merely CREATED the generator."""
         from ray_tpu.serve.http_util import StreamingResponse
         status, ctype, it = 200, "text/plain", None
         if isinstance(result, StreamingResponse):
             status, ctype = result.status_code, result.content_type
-            it = (self._drive_asyncgen(result.content)
+            it = (self._drive_asyncgen(result.content, model_id)
                   if inspect.isasyncgen(result.content)
                   else iter(result.content))
         elif inspect.isgenerator(result):
             it = result
         elif inspect.isasyncgen(result):
-            it = self._drive_asyncgen(result)
+            it = self._drive_asyncgen(result, model_id)
         if it is None:
             return result
         import time as _time
@@ -110,17 +130,27 @@ class Replica:
         with self._streams_lock:
             # reap streams abandoned by disconnected clients
             now = _time.time()
-            for old in [s for s, (_, ts) in self._streams.items()
-                        if now - ts > 600]:
+            for old in [s for s, entry in self._streams.items()
+                        if now - entry[1] > 600]:
                 del self._streams[old]
-            self._streams[sid] = (it, now)
+            self._streams[sid] = (it, now, model_id)
         return {"__serve_stream__": sid, "status": status,
                 "content_type": ctype}
 
-    def _drive_asyncgen(self, agen):
+    def _drive_asyncgen(self, agen, model_id: str = ""):
+        from ray_tpu.serve import multiplex as _mux
+
+        async def _next():
+            # async-gen body runs on the LOOP thread: establish the
+            # multiplexed model id in that task's context per pull
+            tok = _mux._set_model_id(model_id)
+            try:
+                return await agen.__anext__()
+            finally:
+                _mux._current_model_id.reset(tok)
+
         while True:
-            fut = asyncio.run_coroutine_threadsafe(agen.__anext__(),
-                                                   self._loop)
+            fut = asyncio.run_coroutine_threadsafe(_next(), self._loop)
             try:
                 yield fut.result()
             except StopAsyncIteration:
@@ -129,23 +159,29 @@ class Replica:
     def stream_next(self, sid: str, max_chunks: int = 16):
         """Pull up to ``max_chunks`` items; returns (chunks, done)."""
         import time as _time
+
+        from ray_tpu.serve import multiplex as _mux
         with self._streams_lock:
             entry = self._streams.get(sid)
         if entry is None:
             return [], True
-        it = entry[0]
+        it, _, model_id = entry
         chunks, done = [], False
-        for _ in range(max_chunks):
-            try:
-                chunks.append(next(it))
-            except StopIteration:
-                done = True
-                break
+        token = _mux._set_model_id(model_id)
+        try:
+            for _ in range(max_chunks):
+                try:
+                    chunks.append(next(it))
+                except StopIteration:
+                    done = True
+                    break
+        finally:
+            _mux._current_model_id.reset(token)
         with self._streams_lock:
             if done:
                 self._streams.pop(sid, None)
             elif sid in self._streams:
-                self._streams[sid] = (it, _time.time())
+                self._streams[sid] = (it, _time.time(), model_id)
         return chunks, done
 
     def check_health(self) -> bool:

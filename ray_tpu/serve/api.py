@@ -13,17 +13,19 @@ from typing import Optional
 
 import ray_tpu
 from ray_tpu.serve._proxy import ProxyActor
-from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve.config import HTTPOptions, gRPCOptions
 from ray_tpu.serve.controller import ServeController
 from ray_tpu.serve.deployment import Application
 from ray_tpu.serve.handle import (CONTROLLER_NAME, DeploymentHandle, Router,
                                   get_controller)
 
 PROXY_NAME = "SERVE_PROXY"
+GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 
 
 def start(http_options: Optional[HTTPOptions] = None, *,
-          proxy: bool = True):
+          proxy: bool = True,
+          grpc_options: Optional[gRPCOptions] = None):
     """Idempotently start the Serve system actors; returns the controller."""
     if not ray_tpu.is_initialized():
         ray_tpu.init()
@@ -49,17 +51,34 @@ def start(http_options: Optional[HTTPOptions] = None, *,
                     "http_options (port=%d) ignored — call serve.shutdown() "
                     "first to change HTTP options", actual[0], actual[1],
                     requested.port)
+    if grpc_options is not None:
+        from ray_tpu.serve._grpc_proxy import GrpcProxyActor
+        g = ray_tpu.remote(GrpcProxyActor).options(
+            name=GRPC_PROXY_NAME, lifetime="detached", num_cpus=0,
+            max_concurrency=32, get_if_exists=True,
+        ).remote(grpc_options.host, grpc_options.port,
+                 grpc_options.request_timeout_s)
+        ray_tpu.get(g.__ray_ready__.remote())
+        actual = ray_tpu.get(controller.get_grpc_address.remote())
+        if actual is not None and grpc_options.port not in (0, actual[1]):
+            from ray_tpu._private import rtlog
+            rtlog.get("serve").warning(
+                "Serve gRPC proxy already running on %s:%d; requested "
+                "grpc_options (port=%d) ignored — call serve.shutdown() "
+                "first to change gRPC options", actual[0], actual[1],
+                grpc_options.port)
     return controller
 
 
 def run(target: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", blocking: bool = False,
         http_options: Optional[HTTPOptions] = None,
+        grpc_options: Optional[gRPCOptions] = None,
         _wait_timeout_s: float = 120.0) -> DeploymentHandle:
     if not isinstance(target, Application):
         raise TypeError("serve.run expects a bound Application "
                         "(use MyDeployment.bind(...))")
-    controller = start(http_options=http_options)
+    controller = start(http_options=http_options, grpc_options=grpc_options)
     nodes: dict = {}
     target._collect(nodes)
     payload = []
@@ -104,6 +123,10 @@ def status() -> dict:
 
 def get_http_address() -> Optional[tuple]:
     return ray_tpu.get(get_controller().get_http_address.remote())
+
+
+def get_grpc_address() -> Optional[tuple]:
+    return ray_tpu.get(get_controller().get_grpc_address.remote())
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:

@@ -47,6 +47,21 @@ class HTTPOptions:
 
 
 @dataclasses.dataclass
+class gRPCOptions:  # noqa: N801 - reference-parity name
+    """Binary ingress options (reference: ``serve.config.gRPCOptions``).
+
+    The reference takes ``grpc_servicer_functions`` (compiled proto
+    servicers); this proxy serves a GENERIC unary-unary handler instead
+    (any method path, raw-bytes payloads, app selection via
+    ``application`` metadata) so no proto toolchain is required — see
+    ``_grpc_proxy.py``."""
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    request_timeout_s: float = 120.0
+
+
+@dataclasses.dataclass
 class DeploymentConfig:
     """Resolved per-deployment options stored by the controller."""
 

@@ -159,6 +159,31 @@ class ServeController:
         with self._lock:
             return self._http_address
 
+    def set_grpc_address(self, host: str, port: int) -> bool:
+        with self._lock:
+            self._grpc_address = (host, port)
+        return True
+
+    def get_grpc_address(self) -> Optional[tuple]:
+        with self._lock:
+            return getattr(self, "_grpc_address", None)
+
+    def list_app_ingress(self) -> Dict[str, str]:
+        """app name → ingress DEPLOYMENT name (grpc proxy routing)."""
+        with self._lock:
+            return {app: meta["ingress"].split("#", 1)[1]
+                    for app, meta in self._apps.items()}
+
+    def ingress_has_method(self, dep_key: str, name: str) -> bool:
+        """Does the deployment's user class define a public method
+        ``name``?  (grpc proxy: map ``/Pkg.Svc/Method`` onto it.)"""
+        with self._lock:
+            st = self._deployments.get(dep_key)
+            if st is None:
+                return False
+            cls = st.payload.get("user_cls")
+        return callable(getattr(cls, name, None)) and not name.startswith("_")
+
     # ------------------------------------------------------------------ stats
     def report_handle_stats(self, router_id: str, dep_key: str,
                             ongoing: int) -> None:

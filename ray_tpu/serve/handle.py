@@ -109,6 +109,10 @@ class Router:
         self._counts: Dict[str, int] = {}        # tag -> my ongoing
         self._outstanding: Dict[str, str] = {}   # ref id -> tag
         self._out_refs: Dict[str, Any] = {}      # ref id -> ObjectRef
+        # model-multiplex affinity: model_id -> replica tags that have
+        # served it (most recent last); the router prefers these so a
+        # loaded (possibly XLA-compiled) model stays resident
+        self._model_affinity: Dict[str, List[str]] = {}
         self._pending = 0        # waiting in assign() — autoscale signal too
         self._max_ongoing = 0    # 0 = unknown/unbounded
         self._version = -1
@@ -120,7 +124,8 @@ class Router:
 
     # ---------------------------------------------------------------- routing
     def assign(self, method: str, args: tuple, kwargs: dict,
-               timeout_s: float = 60.0) -> DeploymentResponse:
+               timeout_s: float = 60.0,
+               multiplexed_model_id: str = "") -> DeploymentResponse:
         # DeploymentResponses anywhere in the args become ObjectRefs (they
         # hold live threads/locks and must never be pickled); the replica
         # resolves refs — nested ones included — back to values.
@@ -134,7 +139,7 @@ class Router:
                 with self._lock:
                     tags = list(self._replicas)
                     if tags:
-                        tag = self._pick(tags)
+                        tag = self._pick(tags, multiplexed_model_id)
                         # Enforce max_ongoing_requests at the router: hold
                         # the request here (counted in _pending → autoscale
                         # signal) instead of queueing it at a full replica.
@@ -153,13 +158,31 @@ class Router:
         finally:
             with self._lock:
                 self._pending -= 1
+        if multiplexed_model_id:
+            kwargs = dict(kwargs)
+            kwargs["__serve_model_id__"] = multiplexed_model_id
+            with self._lock:
+                aff = self._model_affinity.setdefault(
+                    multiplexed_model_id, [])
+                if tag in aff:
+                    aff.remove(tag)
+                aff.append(tag)
+                del aff[:-4]             # keep the few most recent holders
         ref = handle.handle_request.remote(method, args, kwargs)
         with self._lock:
             self._outstanding[str(ref.id)] = tag
             self._out_refs[str(ref.id)] = ref
         return DeploymentResponse(ref, self, tag)
 
-    def _pick(self, tags: List[str]) -> str:
+    def _pick(self, tags: List[str], model_id: str = "") -> str:
+        if model_id:
+            # prefer the most recent non-saturated replica known to hold
+            # this model (reference: multiplex-aware replica scheduler)
+            for tag in reversed(self._model_affinity.get(model_id, [])):
+                if tag in self._replicas and (
+                        not self._max_ongoing
+                        or self._counts.get(tag, 0) < self._max_ongoing):
+                    return tag
         if len(tags) == 1:
             return tags[0]
         a, b = random.sample(tags, 2)
@@ -240,20 +263,30 @@ class _MethodCaller:
         self._method = method
 
     def remote(self, *args: Any, **kwargs: Any) -> DeploymentResponse:
-        return self._handle._router().assign(self._method, args, kwargs)
+        return self._handle._router().assign(
+            self._method, args, kwargs,
+            multiplexed_model_id=self._handle._model_id)
 
 
 class DeploymentHandle:
     """Callable reference to a deployment; picklable across processes."""
 
-    def __init__(self, dep_key: str):
+    def __init__(self, dep_key: str, multiplexed_model_id: str = ""):
         self._dep_key = dep_key
+        self._model_id = multiplexed_model_id
+
+    def options(self, *, multiplexed_model_id: str = "",
+                **_compat: Any) -> "DeploymentHandle":
+        """Per-request routing options (reference:
+        ``handle.options(multiplexed_model_id=...)``)."""
+        return DeploymentHandle(self._dep_key, multiplexed_model_id)
 
     def _router(self) -> Router:
         return Router.for_deployment(self._dep_key)
 
     def remote(self, *args: Any, **kwargs: Any) -> DeploymentResponse:
-        return self._router().assign("__call__", args, kwargs)
+        return self._router().assign("__call__", args, kwargs,
+                                     multiplexed_model_id=self._model_id)
 
     def __getattr__(self, name: str) -> _MethodCaller:
         if name.startswith("_"):
@@ -261,7 +294,7 @@ class DeploymentHandle:
         return _MethodCaller(self, name)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._dep_key,))
+        return (DeploymentHandle, (self._dep_key, self._model_id))
 
     def __repr__(self):
         return f"DeploymentHandle({self._dep_key!r})"

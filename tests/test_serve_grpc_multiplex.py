@@ -1,0 +1,200 @@
+"""gRPC ingress + model multiplexing (VERDICT r3 missing #7).
+
+Reference: Serve 2.x gRPC proxy (``python/ray/serve/_private/grpc_util``)
+and ``serve.multiplexed`` / ``get_multiplexed_model_id``.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- multiplexing
+
+def test_multiplexed_lru_and_model_id(serve_cluster):
+    @serve.deployment(num_replicas=1)
+    class Multi:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id}
+
+        async def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return {"model": model["id"], "x": x, "loads": list(self.loads)}
+
+    h = serve.run(Multi.bind(), route_prefix="/multi", name="multi")
+    r1 = h.options(multiplexed_model_id="a").remote(1).result()
+    assert r1["model"] == "a" and r1["loads"] == ["a"]
+    # cache hit: no second load of "a"
+    r2 = h.options(multiplexed_model_id="a").remote(2).result()
+    assert r2["loads"] == ["a"]
+    # fill to capacity, then evict the LRU ("a" is older than "b")
+    h.options(multiplexed_model_id="b").remote(3).result()
+    r4 = h.options(multiplexed_model_id="c").remote(4).result()
+    assert r4["loads"] == ["a", "b", "c"]
+    r5 = h.options(multiplexed_model_id="a").remote(5).result()
+    assert r5["loads"] == ["a", "b", "c", "a"]   # "a" was evicted, reloads
+
+
+def test_multiplexed_affinity_routing(serve_cluster):
+    @serve.deployment(num_replicas=3)
+    class Which:
+        def __init__(self):
+            import uuid
+            self.tag = uuid.uuid4().hex[:6]
+
+        @serve.multiplexed(max_num_models_per_replica=4)
+        async def get_model(self, model_id: str):
+            return model_id
+
+        async def __call__(self, _):
+            await self.get_model(serve.get_multiplexed_model_id())
+            return self.tag
+
+    h = serve.run(Which.bind(), route_prefix="/w", name="w")
+    # same model id keeps landing on the same replica
+    tags = {h.options(multiplexed_model_id="m1").remote(0).result()
+            for _ in range(8)}
+    assert len(tags) == 1, tags
+    # a different model id may pick a different replica, and also sticks
+    tags2 = {h.options(multiplexed_model_id="m2").remote(0).result()
+             for _ in range(8)}
+    assert len(tags2) == 1, tags2
+
+
+def test_multiplexed_http_header(serve_cluster):
+    import json
+    import urllib.request
+
+    @serve.deployment(num_replicas=1)
+    class M:
+        @serve.multiplexed()
+        async def get_model(self, model_id: str):
+            return model_id
+
+        async def __call__(self, request):
+            mid = serve.get_multiplexed_model_id()
+            await self.get_model(mid)
+            return {"served": mid}
+
+    serve.run(M.bind(), route_prefix="/m", name="m")
+    host, port = serve.get_http_address()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/m", data=b"{}", method="POST",
+        headers={"serve_multiplexed_model_id": "ckpt-9"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        body = json.loads(r.read())
+    assert body["served"] == "ckpt-9"
+
+
+# ------------------------------------------------------------------- gRPC
+
+def _grpc_call(addr, method, payload, metadata=None, timeout=30):
+    import grpc
+    with grpc.insecure_channel(f"{addr[0]}:{addr[1]}") as ch:
+        fn = ch.unary_unary(method,
+                            request_serializer=None,
+                            response_deserializer=None)
+        return fn(payload, metadata=metadata or [], timeout=timeout)
+
+
+def test_grpc_ingress_bytes_and_methods(serve_cluster):
+    @serve.deployment(num_replicas=1)
+    class Svc:
+        def __call__(self, data: bytes):
+            return b"echo:" + data
+
+        def Upper(self, data: bytes):
+            return data.decode().upper()
+
+    serve.run(Svc.bind(), route_prefix="/svc", name="app1",
+              grpc_options=serve.gRPCOptions(port=0))
+    addr = serve.get_grpc_address()
+    assert addr is not None
+    # default method -> __call__, raw bytes round-trip
+    out = _grpc_call(addr, "/user.Svc/Predict2", b"hi",
+                     metadata=[("application", "app1")])
+    # Predict2 is not defined on the class -> falls to __call__
+    assert out == b"echo:hi"
+    # named method dispatch
+    out = _grpc_call(addr, "/user.Svc/Upper", b"abc",
+                     metadata=[("application", "app1")])
+    assert out == b"ABC"
+    # single app: metadata optional
+    out = _grpc_call(addr, "/user.Svc/Upper", b"xy")
+    assert out == b"XY"
+
+
+def test_grpc_pickle_codec_and_multiplex(serve_cluster):
+    import pickle
+
+    @serve.deployment(num_replicas=1)
+    class P:
+        @serve.multiplexed()
+        async def get_model(self, model_id: str):
+            return model_id
+
+        async def __call__(self, obj):
+            mid = serve.get_multiplexed_model_id()
+            await self.get_model(mid)
+            return {"sum": sum(obj), "model": mid}
+
+    serve.run(P.bind(), route_prefix="/p", name="papp",
+              grpc_options=serve.gRPCOptions(port=0))
+    addr = serve.get_grpc_address()
+    out = _grpc_call(addr, "/user.P/__call__", pickle.dumps([1, 2, 3]),
+                     metadata=[("application", "papp"),
+                               ("serve-codec", "pickle"),
+                               ("multiplexed_model_id", "mx")])
+    assert pickle.loads(out) == {"sum": 6, "model": "mx"}
+
+
+def test_grpc_unknown_app_errors(serve_cluster):
+    import grpc
+
+    @serve.deployment(num_replicas=1)
+    class A:
+        def __call__(self, b):
+            return b
+
+    serve.run(A.bind(), route_prefix="/a", name="a1",
+              grpc_options=serve.gRPCOptions(port=0))
+    serve.run(A.bind(), route_prefix="/b", name="a2")
+    addr = serve.get_grpc_address()
+    with pytest.raises(grpc.RpcError) as ei:
+        _grpc_call(addr, "/user.A/__call__", b"x",
+                   metadata=[("application", "nope")])
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_multiplexed_id_inside_streaming_generator(serve_cluster):
+    """Generator bodies execute during stream pulls, not at call time —
+    the model id must be re-established around each pull (r4 review
+    fix)."""
+    @serve.deployment(num_replicas=1)
+    class S:
+        def __call__(self, _):
+            def gen():
+                for i in range(3):
+                    yield f"{serve.get_multiplexed_model_id()}:{i}"
+            return gen()
+
+    h = serve.run(S.bind(), route_prefix="/s", name="s")
+    chunks = list(h.options(multiplexed_model_id="g7").remote(0).result())
+    assert chunks == ["g7:0", "g7:1", "g7:2"]
