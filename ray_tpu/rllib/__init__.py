@@ -15,8 +15,9 @@ from ray_tpu.rllib.evaluation import (
     RolloutWorker, WorkerSet, collect_metrics, synchronous_parallel_sample)
 from ray_tpu.rllib.multi_agent import MultiAgentRolloutWorker
 from ray_tpu.rllib.algorithms import (
-    APEX, APEXConfig, Algorithm, AlgorithmConfig, DQN, DQNConfig, IMPALA,
-    IMPALAConfig, PPO,
+    A3C, A3CConfig, APEX, APEXConfig, APPO, APPOConfig, Algorithm,
+    AlgorithmConfig, BC, BCConfig, DQN, DQNConfig, IMPALA, IMPALAConfig,
+    MARWIL, MARWILConfig, PPO,
     PPOConfig)
 from ray_tpu.rllib.algorithms.impala import vtrace
 
@@ -27,4 +28,6 @@ __all__ = [
     "WorkerSet", "collect_metrics", "synchronous_parallel_sample",
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
     "IMPALAConfig", "DQN", "DQNConfig", "APEX", "APEXConfig", "vtrace",
+    "APPO", "APPOConfig", "A3C", "A3CConfig", "MARWIL", "MARWILConfig",
+    "BC", "BCConfig",
 ]

@@ -1,0 +1,101 @@
+"""A3C: asynchronous advantage actor-critic (gradient-push workers).
+
+Reference: ``rllib/algorithms/a3c/`` (Mnih et al. 2016) — the one
+reference execution pattern where workers push GRADIENTS, not samples:
+each rollout worker computes ∇L on its own fragment locally and the
+learner applies arriving gradients Hogwild-style, re-issuing the worker
+with fresh weights.  Versus IMPALA, the learner never touches
+observations — for fat observations on a thin interconnect the gradient
+(∝ parameter count) is the cheaper thing to ship.
+
+TPU-native shape: the worker-side grad is ONE jitted XLA call over the
+whole fragment (policy.compute_gradients builds it lazily from the same
+actor-critic apply_fn the sampler uses); the learner's apply is a jitted
+optax step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+
+class A3CConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or A3C)
+        self._cfg.update({
+            "lr": 1e-4, "num_workers": 2, "rollout_fragment_length": 50,
+            "vf_loss_coeff": 0.5, "entropy_coeff": 0.01, "grad_clip": 40.0,
+            "grads_per_iteration": 10,
+        })
+
+
+class A3C(Algorithm):
+    _default_config_cls = A3CConfig
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        policy = self.workers.local_worker.policy
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(float(config["grad_clip"])),
+            optax.rmsprop(float(config["lr"]), decay=0.99, eps=0.1))
+        self._opt_state = self._optimizer.init(policy.params)
+        opt = self._optimizer
+
+        def apply_grads(params, opt_state, grads):
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply_grads = jax.jit(apply_grads)
+        self._grad_kw = {
+            "vf_loss_coeff": float(config["vf_loss_coeff"]),
+            "entropy_coeff": float(config["entropy_coeff"]),
+        }
+        self._in_flight: Dict[Any, Any] = {}
+        self._trained_steps = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        remotes = self.workers.remote_workers
+        n = int(self.config["grads_per_iteration"])
+        policy = self.workers.local_worker.policy
+        info: Dict[str, Any] = {}
+        if not remotes:  # degenerate sync mode for tests
+            for _ in range(n):
+                grads, count, info = \
+                    self.workers.local_worker.compute_gradients(
+                        None, **self._grad_kw)
+                policy.params, self._opt_state = self._apply_grads(
+                    policy.params, self._opt_state, grads)
+                self._trained_steps += count
+            info = {k: float(v) for k, v in info.items()}
+            info["num_env_steps_trained"] = self._trained_steps
+            return info
+        # Hogwild: keep one gradient computation in flight per worker;
+        # each completion is applied immediately and the worker re-issued
+        # with the freshest weights.
+        weights_ref = ray_tpu.put(policy.get_weights())
+        for w in remotes:
+            if w not in self._in_flight.values():
+                self._in_flight[w.compute_gradients.remote(
+                    weights_ref, **self._grad_kw)] = w
+        applied = 0
+        while applied < n:
+            ready, _ = ray_tpu.wait(list(self._in_flight), num_returns=1)
+            fut = ready[0]
+            worker = self._in_flight.pop(fut)
+            grads, count, info = ray_tpu.get(fut)
+            policy.params, self._opt_state = self._apply_grads(
+                policy.params, self._opt_state, grads)
+            self._trained_steps += count
+            applied += 1
+            weights_ref = ray_tpu.put(policy.get_weights())
+            self._in_flight[worker.compute_gradients.remote(
+                weights_ref, **self._grad_kw)] = worker
+        info = {k: float(np.asarray(v)) for k, v in info.items()}
+        info["num_env_steps_trained"] = self._trained_steps
+        return info

@@ -93,6 +93,15 @@ class IMPALAConfig(AlgorithmConfig):
 class IMPALA(Algorithm):
     _default_config_cls = IMPALAConfig
 
+    @staticmethod
+    def _policy_surrogate(config):
+        """Policy-loss term over (target_logp, behavior_logp, pg_adv) —
+        plain V-trace policy gradient here; APPO overrides with the
+        clipped PPO surrogate."""
+        def pg(target_logp, behavior_logp, pg_adv):
+            return -(target_logp * pg_adv).mean()
+        return pg
+
     def setup(self, config: Dict[str, Any]) -> None:
         policy = self.workers.local_worker.policy
         apply_fn = policy.apply_fn
@@ -115,6 +124,8 @@ class IMPALA(Algorithm):
         ent_coeff = float(config["entropy_coeff"])
         optimizer = self._optimizer
 
+        surrogate = self._policy_surrogate(config)
+
         def loss_fn(params, batch):
             # batch cols are [T, B, ...]; flatten for the net, reshape back.
             T, B = batch[REWARDS].shape
@@ -132,7 +143,7 @@ class IMPALA(Algorithm):
                 clip_pg_rho=clip_pg_rho)
             vs = jax.lax.stop_gradient(vs)
             pg_adv = jax.lax.stop_gradient(pg_adv)
-            pi_loss = -(target_logp * pg_adv).mean()
+            pi_loss = surrogate(target_logp, batch[ACTION_LOGP], pg_adv)
             vf_loss = 0.5 * jnp.square(vs - values).mean()
             total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
             return total, (pi_loss, vf_loss, entropy)

@@ -1,0 +1,140 @@
+"""MARWIL + BC: offline RL from recorded experiences.
+
+Reference: ``rllib/algorithms/marwil/`` (Wang et al. 2018,
+"Exponentially Weighted Imitation Learning") and
+``rllib/algorithms/bc/`` — learn a policy from a fixed dataset with no
+environment interaction:
+
+- value head regresses monte-carlo returns;
+- advantage = return − V(s), normalized by a running mean-square (the
+  paper's c² estimate);
+- policy loss = −E[exp(β·Â) · log π(a|s)] — β=0 is exactly behavior
+  cloning, which is what the ``BC`` subclass pins.
+
+The env in the config is used only for spaces and ``evaluate()``; the
+training loop touches nothing but the dataset (``config["input"]``, a
+JSON-lines episode dir — see ``rllib/offline.py``), one jitted update
+per minibatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.offline import OfflineData
+from ray_tpu.rllib.sample_batch import ACTIONS, OBS
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        self._cfg.update({
+            "input": None,              # path to JSON-lines episode data
+            "beta": 1.0,                # 0 = behavior cloning
+            "lr": 1e-4, "train_batch_size": 512,
+            "vf_loss_coeff": 1.0, "grad_clip": 40.0,
+            "updates_per_iteration": 50,
+            # running ⟨Â²⟩ update rate (reference: moving_average_sqd_adv_norm)
+            "vf_norm_rate": 1e-3,
+        })
+
+    def offline_data(self, *, input=None, **kw):  # noqa: A002 - ref name
+        if input is not None:
+            self._cfg["input"] = input
+        self._cfg.update(kw)
+        return self
+
+
+class MARWIL(Algorithm):
+    _default_config_cls = MARWILConfig
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if not config.get("input"):
+            raise ValueError(
+                f"{type(self).__name__} is offline: set config['input'] to "
+                "a JSON-lines episode dir (rllib/offline.py)")
+        self.data = OfflineData(config["input"],
+                                gamma=float(config["gamma"]))
+        policy = self.workers.local_worker.policy
+        apply_fn = policy.apply_fn
+        dist = policy.dist_class
+        beta = float(config["beta"])
+        vf_coeff = float(config["vf_loss_coeff"])
+        rate = float(config["vf_norm_rate"])
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(float(config["grad_clip"])),
+            optax.adam(float(config["lr"])))
+        self._opt_state = self._optimizer.init(policy.params)
+        # running ⟨Â²⟩ for the exponent's normalization (paper's c²)
+        self._sq_norm = jnp.asarray(100.0)
+        optimizer = self._optimizer
+
+        def loss_fn(params, sq_norm, obs, actions, returns):
+            inputs, values = apply_fn(params, obs)
+            logp = dist.logp(inputs, actions)
+            adv = returns - values
+            vf_loss = 0.5 * jnp.square(adv).mean()
+            if beta != 0.0:
+                sq_norm = sq_norm + rate * (
+                    jnp.square(jax.lax.stop_gradient(adv)).mean() - sq_norm)
+                w = jnp.exp(beta * jax.lax.stop_gradient(adv)
+                            / jnp.sqrt(sq_norm + 1e-8))
+                # clip the exponentiated weights (paper appendix: bounded
+                # importance keeps the estimator finite)
+                w = jnp.minimum(w, 20.0)
+            else:
+                w = 1.0                  # BC: plain log-likelihood
+            pi_loss = -(w * logp).mean()
+            total = pi_loss + vf_coeff * vf_loss
+            return total, (sq_norm, pi_loss, vf_loss)
+
+        def update(params, opt_state, sq_norm, obs, actions, returns):
+            grads, (sq_norm, pi_l, vf_l) = jax.grad(
+                loss_fn, has_aux=True)(params, sq_norm, obs, actions,
+                                       returns)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    sq_norm, pi_l, vf_l)
+
+        self._update = jax.jit(update)
+        self._rng = np.random.default_rng(config.get("seed") or 0)
+        self._trained = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        policy = self.workers.local_worker.policy
+        if float(self.config["beta"]) != 0.0:
+            # refresh truncated episodes' bootstrapped returns against
+            # the current value head (one batched forward per iteration)
+            self.data.rebuild_returns(policy.value)
+        bs = int(self.config["train_batch_size"])
+        pi_l = vf_l = 0.0
+        for _ in range(int(self.config["updates_per_iteration"])):
+            mb = self.data.minibatch(self._rng, bs)
+            (policy.params, self._opt_state, self._sq_norm, pi_l,
+             vf_l) = self._update(policy.params, self._opt_state,
+                                  self._sq_norm, mb[OBS], mb[ACTIONS],
+                                  mb["returns"])
+            self._trained += len(mb[OBS])
+        return {"policy_loss": float(pi_l), "vf_loss": float(vf_l),
+                "num_steps_trained": self._trained,
+                "dataset_episodes": self.data.episodes,
+                "dataset_transitions": self.data.count}
+
+
+class BCConfig(MARWILConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+        self._cfg.update({"beta": 0.0, "vf_loss_coeff": 0.0})
+
+
+class BC(MARWIL):
+    """Behavior cloning = MARWIL with β=0 (reference: ``rllib/algorithms/
+    bc/`` subclasses MARWIL the same way)."""
+
+    _default_config_cls = BCConfig

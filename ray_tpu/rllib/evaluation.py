@@ -105,6 +105,51 @@ class RolloutWorker:
             self.policy.set_weights(weights)
         return self.sample()
 
+    def compute_gradients(self, weights: Optional[dict],
+                          vf_loss_coeff: float = 0.5,
+                          entropy_coeff: float = 0.01):
+        """A3C worker step: sample a fragment, compute a2c gradients ON
+        THE WORKER, return (numpy grad tree, steps, metrics) — the
+        gradient-push execution pattern (reference: a3c async_optimizer).
+        The jitted grad fn is built lazily from the policy's own
+        apply_fn/dist and reused across calls."""
+        if weights is not None:
+            self.policy.set_weights(weights)
+        batch = self.sample()
+        grad_fn = getattr(self, "_a2c_grad_fn", None)
+        if grad_fn is None:
+            import jax
+            import jax.numpy as jnp
+            apply_fn = self.policy.apply_fn
+            dist = self.policy.dist_class
+            from ray_tpu.rllib.sample_batch import (ADVANTAGES,
+                                                    VALUE_TARGETS)
+
+            def loss(params, obs, actions, adv, targets, vf_c, ent_c):
+                inputs, values = apply_fn(params, obs)
+                logp = dist.logp(inputs, actions)
+                entropy = dist.entropy(inputs).mean()
+                pi_loss = -(logp * adv).mean()
+                vf_loss = 0.5 * jnp.square(values - targets).mean()
+                total = pi_loss + vf_c * vf_loss - ent_c * entropy
+                return total, (pi_loss, vf_loss, entropy)
+
+            grad_fn = jax.jit(jax.grad(loss, has_aux=True))
+            self._a2c_grad_fn = grad_fn
+            self._a2c_cols = (ADVANTAGES, VALUE_TARGETS)
+        adv_k, tgt_k = self._a2c_cols
+        adv = batch[adv_k]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        grads, (pi_l, vf_l, ent) = grad_fn(
+            self.policy.params,
+            np.asarray(batch[OBS], np.float32), batch[ACTIONS], adv,
+            batch[tgt_k], vf_loss_coeff, entropy_coeff)
+        import jax
+        grads = jax.tree_util.tree_map(np.asarray, grads)
+        return grads, batch.count, {
+            "policy_loss": float(pi_l), "vf_loss": float(vf_l),
+            "entropy": float(ent)}
+
     def get_weights(self) -> dict:
         return self.policy.get_weights()
 

@@ -81,16 +81,25 @@ class GrpcProxyActor:
 
     # ---------------------------------------------------------------- routing
     def _apps_cached(self) -> dict:
-        if time.monotonic() - self._apps_ts > 1.0 and \
-                self._cache_lock.acquire(blocking=False):
-            try:
-                self._apps = ray_tpu.get(
-                    self._controller.list_app_ingress.remote(), timeout=10)
-                self._apps_ts = time.monotonic()
-            except Exception:  # noqa: BLE001 - keep serving the stale map
-                pass
-            finally:
-                self._cache_lock.release()
+        if time.monotonic() - self._apps_ts > 1.0:
+            # cold start (empty map) blocks ALL callers on the first
+            # fetch — a non-blocking loser returning {} would abort a
+            # deployed app's request with a spurious NOT_FOUND; once
+            # warm, losers serve the stale map without waiting
+            if self._cache_lock.acquire(blocking=not self._apps):
+                try:
+                    self._apps = ray_tpu.get(
+                        self._controller.list_app_ingress.remote(),
+                        timeout=10)
+                    self._apps_ts = time.monotonic()
+                except Exception:  # noqa: BLE001 - keep the stale map
+                    pass
+                finally:
+                    self._cache_lock.release()
+            elif not self._apps:
+                # lost the cold-start race: wait for the winner's fetch
+                with self._cache_lock:
+                    pass
         return self._apps
 
     def _resolve(self, method: str, meta: dict) -> Optional[str]:
@@ -147,9 +156,16 @@ class GrpcProxyActor:
         if isinstance(result, str):
             return result.encode()
         # structured result over the bytes codec: JSON, matching the
-        # HTTP proxy's coercion
+        # HTTP proxy's coercion (numpy results need the pickle codec)
         import json
-        return json.dumps(result).encode()
+        try:
+            return json.dumps(result).encode()
+        except TypeError as e:
+            context.abort(
+                grpc.StatusCode.INTERNAL,
+                f"result of type {type(result).__name__} is not JSON-"
+                f"serializable over the bytes codec ({e}); use metadata "
+                f"serve-codec=pickle or return bytes/str")
 
     def _dep_has_method(self, router, name: str) -> bool:
         if name in ("", "__call__"):

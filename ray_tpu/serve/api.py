@@ -156,12 +156,13 @@ def shutdown() -> None:
         ray_tpu.get(controller.shutdown_all.remote(), timeout=10)
     except Exception:  # noqa: BLE001
         pass
-    try:
-        proxy = ray_tpu.get_actor(PROXY_NAME)
-        ray_tpu.get(proxy.shutdown.remote(), timeout=5)
-        ray_tpu.kill(proxy)
-    except Exception:  # noqa: BLE001
-        pass
+    for proxy_name in (PROXY_NAME, GRPC_PROXY_NAME):
+        try:
+            proxy = ray_tpu.get_actor(proxy_name)
+            ray_tpu.get(proxy.shutdown.remote(), timeout=5)
+            ray_tpu.kill(proxy)
+        except Exception:  # noqa: BLE001
+            pass
     try:
         ray_tpu.kill(controller)
     except Exception:  # noqa: BLE001

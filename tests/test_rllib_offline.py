@@ -1,0 +1,163 @@
+"""APPO, A3C, and offline RL (BC/MARWIL) — VERDICT r3 missing #6
+remainder (reference: rllib/algorithms/{appo,a3c,bc,marwil}/ +
+rllib/offline/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (A3C, A3CConfig, APPO, APPOConfig, BC, BCConfig,
+                           MARWIL, MARWILConfig, PPO, PPOConfig)
+from ray_tpu.rllib.offline import JsonReader, OfflineData, record_rollouts
+
+
+# --------------------------------------------------------------------- APPO
+
+def test_appo_smoke(ray_start_regular):
+    algo = APPOConfig().environment("CartPole-v1").rollouts(
+        num_workers=2, rollout_fragment_length=32,
+        num_envs_per_worker=2).training(
+        num_batches_per_iteration=4, lr=3e-4).debugging(seed=0).build()
+    for _ in range(3):
+        r = algo.train()
+    assert r["info"]["num_env_steps_trained"] >= 4 * 64
+    assert np.isfinite(r["info"]["policy_loss"])
+    algo.stop()
+
+
+def test_appo_surrogate_clips():
+    """The APPO surrogate must be PPO-clipped: for a large positive
+    advantage and ratio >> 1+clip, the gradient w.r.t. target_logp is 0
+    (clipped branch), unlike IMPALA's plain pg."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib import IMPALA
+    appo_s = APPO._policy_surrogate({"clip_param": 0.2})
+    imp_s = IMPALA._policy_surrogate({})
+    b_logp = jnp.zeros((4, 2))
+    adv = jnp.ones((4, 2))
+    g_appo = jax.grad(lambda t: appo_s(t, b_logp, adv))(b_logp + 1.0)
+    g_imp = jax.grad(lambda t: imp_s(t, b_logp, adv))(b_logp + 1.0)
+    assert float(jnp.abs(g_appo).sum()) == 0.0      # ratio e>1.2: clipped
+    assert float(jnp.abs(g_imp).sum()) > 0.0
+
+
+# ---------------------------------------------------------------------- A3C
+
+def test_a3c_gradient_push(ray_start_regular):
+    algo = A3CConfig().environment("CartPole-v1").rollouts(
+        num_workers=2, rollout_fragment_length=32).training(
+        grads_per_iteration=4, lr=1e-3).debugging(seed=0).build()
+    for _ in range(3):
+        r = algo.train()
+    assert r["info"]["num_env_steps_trained"] >= 4 * 32
+    assert np.isfinite(r["info"]["policy_loss"])
+    algo.stop()
+
+
+def test_a3c_local_mode():
+    algo = A3CConfig().environment("CartPole-v1").rollouts(
+        num_workers=0, rollout_fragment_length=32).training(
+        grads_per_iteration=3).debugging(seed=0).build()
+    r = algo.train()
+    assert r["info"]["num_env_steps_trained"] == 3 * 32
+    algo.stop()
+
+
+# ------------------------------------------------------------------ offline
+
+@pytest.fixture(scope="module")
+def cartpole_dataset(tmp_path_factory):
+    """An expert-ish dataset: train PPO briefly, then record rollouts."""
+    path = str(tmp_path_factory.mktemp("offline_data"))
+    algo = PPOConfig().environment("CartPole-v1").rollouts(
+        num_workers=0, rollout_fragment_length=256).training(
+        train_batch_size=1024, num_sgd_iter=6, lr=3e-4).debugging(
+        seed=0).build()
+    for _ in range(6):
+        algo.train()
+    steps = record_rollouts(algo.get_policy(), "CartPole-v1", path,
+                            episodes=40, explore=True, seed=0)
+    algo.stop()
+    assert steps > 400
+    return path
+
+
+def test_json_reader_and_offline_data(cartpole_dataset):
+    rows = list(JsonReader(cartpole_dataset))
+    assert len(rows) == 40
+    assert {"obs", "actions", "rewards", "terminated"} <= set(rows[0])
+    data = OfflineData(cartpole_dataset, gamma=0.99)
+    assert data.episodes == 40
+    assert data.count == sum(len(r["rewards"]) for r in rows)
+    # MC returns: last step's return equals its reward
+    ep0 = rows[0]
+    np.testing.assert_allclose(
+        data.returns[len(ep0["rewards"]) - 1], ep0["rewards"][-1],
+        rtol=1e-5)
+    mb = data.minibatch(np.random.default_rng(0), 64)
+    assert len(mb["obs"]) == 64
+
+
+def test_bc_clones_behavior(cartpole_dataset):
+    algo = BCConfig().environment("CartPole-v1").offline_data(
+        input=cartpole_dataset).training(
+        train_batch_size=256, updates_per_iteration=60,
+        lr=3e-3).debugging(seed=0).build()
+    losses = []
+    for _ in range(5):
+        r = algo.train()
+        losses.append(r["info"]["policy_loss"])
+    # negative log-likelihood of the dataset actions falls
+    assert losses[-1] < losses[0], losses
+    # and the cloned policy is meaningfully better than random on the env
+    score = algo.evaluate(num_episodes=5)["evaluation"][
+        "episode_reward_mean"]
+    assert score > 50, score      # random CartPole is ~20
+    algo.stop()
+
+
+def test_marwil_requires_input():
+    with pytest.raises(ValueError):
+        MARWILConfig().environment("CartPole-v1").build()
+
+
+def test_marwil_trains(cartpole_dataset):
+    algo = MARWILConfig().environment("CartPole-v1").offline_data(
+        input=cartpole_dataset, beta=1.0).training(
+        train_batch_size=256, updates_per_iteration=60,
+        lr=3e-3).debugging(seed=0).build()
+    for _ in range(4):
+        r = algo.train()
+    assert np.isfinite(r["info"]["policy_loss"])
+    assert np.isfinite(r["info"]["vf_loss"])
+    assert r["info"]["dataset_transitions"] > 400
+    score = algo.evaluate(num_episodes=5)["evaluation"][
+        "episode_reward_mean"]
+    assert score > 50, score
+    algo.stop()
+
+
+def test_truncated_episode_bootstrap(tmp_path):
+    """Truncated episodes record final_obs; rebuild_returns(value_fn)
+    seeds their accumulator with V(final_obs) instead of zero (r4 review
+    fix: unbootstrapped tails bias the MARWIL value targets)."""
+    import json as _json
+    path = str(tmp_path / "data")
+    import os
+    os.makedirs(path)
+    with open(os.path.join(path, "ep.json"), "w") as f:
+        f.write(_json.dumps({
+            "obs": [[0.0], [1.0]], "actions": [0, 1],
+            "rewards": [1.0, 1.0], "terminated": False,
+            "final_obs": [2.0]}) + "\n")
+        f.write(_json.dumps({
+            "obs": [[3.0]], "actions": [0], "rewards": [5.0],
+            "terminated": True}) + "\n")
+    data = OfflineData(path, gamma=0.5)
+    # without bootstrap: truncated tail treated as zero
+    np.testing.assert_allclose(data.returns, [1.5, 1.0, 5.0])
+    # with a value fn: V([2.0]) = 8 seeds the truncated episode only
+    data.rebuild_returns(lambda obs: np.full(len(obs), 8.0))
+    np.testing.assert_allclose(data.returns, [1.0 + 0.5 * (1.0 + 0.5 * 8),
+                                              1.0 + 0.5 * 8, 5.0])
