@@ -35,6 +35,11 @@ def apply_groupby(block: Block, key: str, aggs: List[AggSpec]) -> Block:
     # stays numpy — the reduce output is small)
     needed = {key} | {on for _, on, _ in aggs if on}
     cols = {c: acc.get_column(c) for c in needed}
+    missing = sorted(c for c, v in cols.items() if v is None)
+    if missing:
+        raise KeyError(
+            f"groupby/aggregate column(s) {missing} not found in block "
+            f"(available: {sorted(acc.columns())})")
     keys = cols[key]
     order = np.argsort(keys, kind="stable")
     sorted_keys = keys[order]

@@ -9,7 +9,9 @@ proto toolchain:
 
 - the request payload is raw bytes, handed to the deployment as-is
   (codec=``bytes``) or unpickled first (metadata ``serve-codec:
-  pickle``, for trusted in-cluster callers);
+  pickle``).  The pickle codec executes arbitrary code on load, so it
+  is DISABLED unless the server opts in with
+  ``gRPCOptions(allow_pickle=True)`` — only for trusted callers;
 - the target application is named by the ``application`` metadata key
   (reference contract) — absent, the method path's service name is
   tried as an app name, then the lone app wins;
@@ -39,11 +41,13 @@ logger = rtlog.get("serve.grpc")
 
 class GrpcProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 request_timeout_s: float = 120.0, max_workers: int = 32):
+                 request_timeout_s: float = 120.0, max_workers: int = 32,
+                 allow_pickle: bool = False):
         import grpc
 
         self._controller = get_controller()
         self._timeout = request_timeout_s
+        self._allow_pickle = allow_pickle
         # 1s-TTL caches (same pattern as the HTTP proxy's route table):
         # the hot path must not pay a controller RPC per request
         self._apps: dict = {}
@@ -78,6 +82,9 @@ class GrpcProxyActor:
 
     def address(self) -> tuple:
         return (self.host, self.port)
+
+    def get_allow_pickle(self) -> bool:
+        return self._allow_pickle
 
     # ---------------------------------------------------------------- routing
     def _apps_cached(self) -> dict:
@@ -126,6 +133,14 @@ class GrpcProxyActor:
                           f"no application for {method!r} "
                           f"(set 'application' metadata)")
         codec = meta.get("serve-codec", "bytes")
+        if codec == "pickle" and not self._allow_pickle:
+            # pickle.loads on caller-supplied bytes is code execution;
+            # require the server-side opt-in (gRPCOptions.allow_pickle)
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "the pickle codec is disabled on this proxy; start serve "
+                "with gRPCOptions(allow_pickle=True) to enable it for "
+                "trusted callers")
         try:
             payload = pickle.loads(request) if codec == "pickle" else request
         except Exception as e:  # noqa: BLE001
@@ -144,13 +159,40 @@ class GrpcProxyActor:
                 multiplexed_model_id=meta.get("multiplexed_model_id", ""))
             remaining = max(0.1, self._timeout -
                             (time.monotonic() - start))
-            result = resp.result(timeout_s=remaining)
+            # raw value, NOT resp.result(): result() turns a stream
+            # marker into a live generator, which a unary response
+            # cannot carry — we need the marker to reject + cancel
+            result = ray_tpu.get(resp._to_object_ref(),
+                                 timeout=remaining)
         except ray_tpu.exceptions.RayServeError as e:
             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         except Exception as e:  # noqa: BLE001 - user code raised
             context.abort(grpc.StatusCode.INTERNAL, str(e)[:500])
+        if isinstance(result, dict) and "__serve_stream__" in result:
+            # streaming deployments need a pull loop against the owning
+            # replica; unary gRPC has nowhere to put it — reject cleanly
+            # (and free the replica-side generator entry) instead of
+            # leaking the stream until the idle reap
+            handle = None
+            with router._lock:
+                handle = router._replicas.get(resp._replica_tag)
+            if handle is not None:
+                try:
+                    handle.stream_cancel.remote(result["__serve_stream__"])
+                except Exception:  # noqa: BLE001 - replica may be gone
+                    pass
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "streaming deployments are not supported over the unary "
+                "gRPC ingress; use the HTTP proxy or a handle")
         if codec == "pickle":
-            return pickle.dumps(result)
+            try:
+                return pickle.dumps(result)
+            except Exception as e:  # noqa: BLE001 - unpicklable result
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    f"result of type {type(result).__name__} is not "
+                    f"picklable: {str(e)[:200]}")
         if isinstance(result, (bytes, bytearray, memoryview)):
             return bytes(result)
         if isinstance(result, str):

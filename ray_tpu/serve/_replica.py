@@ -149,12 +149,23 @@ class Replica:
             finally:
                 _mux._current_model_id.reset(tok)
 
-        while True:
-            fut = asyncio.run_coroutine_threadsafe(_next(), self._loop)
+        try:
+            while True:
+                fut = asyncio.run_coroutine_threadsafe(_next(), self._loop)
+                try:
+                    yield fut.result()
+                except StopAsyncIteration:
+                    return
+        finally:
+            # closing this sync wrapper (stream_cancel / abandoned-stream
+            # reap) must also close the UNDERLYING async generator so
+            # ``finally`` blocks in the deployment body run now — aclose
+            # has to execute on the loop thread that owns the agen
             try:
-                yield fut.result()
-            except StopAsyncIteration:
-                return
+                asyncio.run_coroutine_threadsafe(
+                    agen.aclose(), self._loop).result(timeout=5)
+            except Exception:  # noqa: BLE001 - already closed / loop gone
+                pass
 
     def stream_next(self, sid: str, max_chunks: int = 16):
         """Pull up to ``max_chunks`` items; returns (chunks, done)."""
@@ -183,6 +194,24 @@ class Replica:
             elif sid in self._streams:
                 self._streams[sid] = (it, _time.time(), model_id)
         return chunks, done
+
+    def stream_cancel(self, sid: str) -> bool:
+        """Drop a stream's generator without draining it (e.g. the unary
+        gRPC ingress rejecting a streaming result); closes the generator
+        so ``finally`` blocks in the deployment body run now, not at the
+        600s abandoned-stream reap."""
+        with self._streams_lock:
+            entry = self._streams.pop(sid, None)
+        if entry is None:
+            return False
+        it = entry[0]
+        close = getattr(it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 - user finally raised
+                pass
+        return True
 
     def check_health(self) -> bool:
         chk = getattr(self._instance, "check_health", None)

@@ -57,7 +57,8 @@ def start(http_options: Optional[HTTPOptions] = None, *,
             name=GRPC_PROXY_NAME, lifetime="detached", num_cpus=0,
             max_concurrency=32, get_if_exists=True,
         ).remote(grpc_options.host, grpc_options.port,
-                 grpc_options.request_timeout_s)
+                 grpc_options.request_timeout_s,
+                 allow_pickle=getattr(grpc_options, "allow_pickle", False))
         ray_tpu.get(g.__ray_ready__.remote())
         actual = ray_tpu.get(controller.get_grpc_address.remote())
         if actual is not None and grpc_options.port not in (0, actual[1]):
@@ -67,6 +68,18 @@ def start(http_options: Optional[HTTPOptions] = None, *,
                 "grpc_options (port=%d) ignored — call serve.shutdown() "
                 "first to change gRPC options", actual[0], actual[1],
                 grpc_options.port)
+        # get_if_exists can hand back a proxy started with a DIFFERENT
+        # pickle posture — __init__ options don't re-apply.  A silent
+        # mismatch in either direction is a security surprise; warn.
+        requested_ap = getattr(grpc_options, "allow_pickle", False)
+        actual_ap = ray_tpu.get(g.get_allow_pickle.remote())
+        if actual_ap != requested_ap:
+            from ray_tpu._private import rtlog
+            rtlog.get("serve").warning(
+                "Serve gRPC proxy already running with allow_pickle=%s; "
+                "requested allow_pickle=%s ignored — call serve.shutdown() "
+                "first to change the pickle codec posture",
+                actual_ap, requested_ap)
     return controller
 
 

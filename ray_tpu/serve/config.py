@@ -59,6 +59,12 @@ class gRPCOptions:  # noqa: N801 - reference-parity name
     host: str = "127.0.0.1"
     port: int = 0
     request_timeout_s: float = 120.0
+    # The ``serve-codec: pickle`` metadata deserializes attacker-supplied
+    # bytes with pickle — arbitrary code execution for anyone who can reach
+    # the port.  It therefore requires an explicit server-side opt-in; only
+    # enable it when every possible caller is trusted (e.g. in-cluster
+    # callers on a private network).
+    allow_pickle: bool = False
 
 
 @dataclasses.dataclass

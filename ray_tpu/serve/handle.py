@@ -16,6 +16,7 @@ import random
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -25,6 +26,9 @@ logger = rtlog.get("serve.router")
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 _REPORT_INTERVAL_S = float(os.environ.get("RTPU_SERVE_REPORT_S", "0.5"))
+# Max distinct multiplexed model ids tracked for replica affinity; LRU
+# beyond this (each entry is ≤4 replica tags — a few thousand ids is KBs).
+_AFFINITY_MAX_IDS = int(os.environ.get("RTPU_SERVE_AFFINITY_MAX_IDS", "4096"))
 
 
 def get_controller():
@@ -111,8 +115,10 @@ class Router:
         self._out_refs: Dict[str, Any] = {}      # ref id -> ObjectRef
         # model-multiplex affinity: model_id -> replica tags that have
         # served it (most recent last); the router prefers these so a
-        # loaded (possibly XLA-compiled) model stays resident
-        self._model_affinity: Dict[str, List[str]] = {}
+        # loaded (possibly XLA-compiled) model stays resident.  Bounded
+        # LRU over model ids — a long-lived router seeing an unbounded id
+        # stream must not grow without limit.
+        self._model_affinity: "OrderedDict[str, List[str]]" = OrderedDict()
         self._pending = 0        # waiting in assign() — autoscale signal too
         self._max_ongoing = 0    # 0 = unknown/unbounded
         self._version = -1
@@ -168,6 +174,9 @@ class Router:
                     aff.remove(tag)
                 aff.append(tag)
                 del aff[:-4]             # keep the few most recent holders
+                self._model_affinity.move_to_end(multiplexed_model_id)
+                while len(self._model_affinity) > _AFFINITY_MAX_IDS:
+                    self._model_affinity.popitem(last=False)
         ref = handle.handle_request.remote(method, args, kwargs)
         with self._lock:
             self._outstanding[str(ref.id)] = tag
@@ -245,6 +254,14 @@ class Router:
                         continue
             self._replicas = new
             self._counts = {t: self._counts.get(t, 0) for t in new}
+            # drop affinity tags for replicas that no longer exist (and
+            # the id entirely once no live replica holds it)
+            for mid in list(self._model_affinity):
+                live = [t for t in self._model_affinity[mid] if t in new]
+                if live:
+                    self._model_affinity[mid] = live
+                else:
+                    del self._model_affinity[mid]
 
     def _report(self) -> None:
         if self._controller is None:
@@ -268,6 +285,9 @@ class _MethodCaller:
             multiplexed_model_id=self._handle._model_id)
 
 
+_warned_handle_options: set = set()
+
+
 class DeploymentHandle:
     """Callable reference to a deployment; picklable across processes."""
 
@@ -278,7 +298,20 @@ class DeploymentHandle:
     def options(self, *, multiplexed_model_id: str = "",
                 **_compat: Any) -> "DeploymentHandle":
         """Per-request routing options (reference:
-        ``handle.options(multiplexed_model_id=...)``)."""
+        ``handle.options(multiplexed_model_id=...)``).
+
+        Unrecognized reference options (``method_name``, ``stream``, …)
+        are NOT silently honored here — warn so callers porting reference
+        code see the behavior difference instead of a silent no-op."""
+        if _compat:
+            unknown = tuple(sorted(_compat))
+            if unknown not in _warned_handle_options:   # once per shape,
+                _warned_handle_options.add(unknown)     # not per request
+                logger.warning(
+                    "DeploymentHandle.options(): unsupported option(s) %s "
+                    "ignored — only multiplexed_model_id is honored "
+                    "(call methods as handle.method.remote(...) instead of "
+                    "method_name=...)", list(unknown))
         return DeploymentHandle(self._dep_key, multiplexed_model_id)
 
     def _router(self) -> Router:

@@ -156,13 +156,65 @@ def test_grpc_pickle_codec_and_multiplex(serve_cluster):
             return {"sum": sum(obj), "model": mid}
 
     serve.run(P.bind(), route_prefix="/p", name="papp",
-              grpc_options=serve.gRPCOptions(port=0))
+              grpc_options=serve.gRPCOptions(port=0, allow_pickle=True))
     addr = serve.get_grpc_address()
     out = _grpc_call(addr, "/user.P/__call__", pickle.dumps([1, 2, 3]),
                      metadata=[("application", "papp"),
                                ("serve-codec", "pickle"),
                                ("multiplexed_model_id", "mx")])
     assert pickle.loads(out) == {"sum": 6, "model": "mx"}
+
+
+def test_grpc_pickle_codec_disabled_by_default(serve_cluster):
+    """pickle.loads on caller bytes is code execution — the codec must be
+    rejected unless the server opted in (r4 advisor, medium)."""
+    import grpc
+    import pickle
+
+    @serve.deployment(num_replicas=1)
+    class Q:
+        def __call__(self, obj):
+            return obj
+
+    serve.run(Q.bind(), route_prefix="/q", name="qapp",
+              grpc_options=serve.gRPCOptions(port=0))
+    addr = serve.get_grpc_address()
+    with pytest.raises(grpc.RpcError) as ei:
+        _grpc_call(addr, "/user.Q/__call__", pickle.dumps([1]),
+                   metadata=[("application", "qapp"),
+                             ("serve-codec", "pickle")])
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "allow_pickle" in ei.value.details()
+    # the bytes codec still works on the same proxy
+    out = _grpc_call(addr, "/user.Q/__call__", b"raw",
+                     metadata=[("application", "qapp")])
+    assert out == b"raw"
+
+
+def test_grpc_streaming_rejected_unimplemented(serve_cluster):
+    """Streaming results cannot ride a unary gRPC response: expect
+    UNIMPLEMENTED and the replica-side stream entry to be freed (r4
+    advisor, low)."""
+    import grpc
+
+    @serve.deployment(num_replicas=1)
+    class St:
+        def __call__(self, _):
+            def gen():
+                yield b"a"
+                yield b"b"
+            return gen()
+
+    serve.run(St.bind(), route_prefix="/st", name="stapp",
+              grpc_options=serve.gRPCOptions(port=0))
+    addr = serve.get_grpc_address()
+    with pytest.raises(grpc.RpcError) as ei:
+        _grpc_call(addr, "/user.St/__call__", b"x",
+                   metadata=[("application", "stapp")])
+    assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    # HTTP/handle streaming still works against the same deployment
+    h = serve.get_deployment_handle("St", "stapp")
+    assert list(h.remote(0).result()) == [b"a", b"b"]
 
 
 def test_grpc_unknown_app_errors(serve_cluster):
