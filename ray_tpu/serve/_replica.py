@@ -128,12 +128,20 @@ class Replica:
         import uuid
         sid = uuid.uuid4().hex
         with self._streams_lock:
-            # reap streams abandoned by disconnected clients
+            # reap streams abandoned by disconnected clients — pop under
+            # the lock, close OUTSIDE it (a generator finally can block;
+            # it must not stall every concurrent stream on the replica)
             now = _time.time()
-            for old in [s for s, entry in self._streams.items()
-                        if now - entry[1] > 600]:
-                del self._streams[old]
+            reaped = [self._streams.pop(s) for s, entry in
+                      list(self._streams.items()) if now - entry[1] > 600]
             self._streams[sid] = (it, now, model_id)
+        for entry in reaped:
+            close = getattr(entry[0], "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 - user finally raised
+                    pass
         return {"__serve_stream__": sid, "status": status,
                 "content_type": ctype}
 

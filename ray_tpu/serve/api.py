@@ -72,8 +72,11 @@ def start(http_options: Optional[HTTPOptions] = None, *,
         # pickle posture — __init__ options don't re-apply.  A silent
         # mismatch in either direction is a security surprise; warn.
         requested_ap = getattr(grpc_options, "allow_pickle", False)
-        actual_ap = ray_tpu.get(g.get_allow_pickle.remote())
-        if actual_ap != requested_ap:
+        try:
+            actual_ap = ray_tpu.get(g.get_allow_pickle.remote(), timeout=10)
+        except Exception:  # noqa: BLE001 - pre-upgrade proxy lacks the RPC
+            actual_ap = None
+        if actual_ap is not None and actual_ap != requested_ap:
             from ray_tpu._private import rtlog
             rtlog.get("serve").warning(
                 "Serve gRPC proxy already running with allow_pickle=%s; "

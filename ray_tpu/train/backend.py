@@ -48,15 +48,30 @@ class Backend:
 class JaxConfig(BackendConfig):
     """JAX/TPU worker-group backend.
 
+    use_distributed: multi-controller JAX — every worker process calls
+        ``jax.distributed.initialize`` against a coordinator the driver
+        allocates, and the group becomes ONE program domain
+        (``jax.devices()`` = global device list; one pjit spans all
+        workers).  ``True`` forces it anywhere — including the CPU rig,
+        where N processes × ``local_device_count`` virtual devices with
+        gloo collectives stand in for an N-host slice.  ``None`` (auto)
+        enables it on real accelerators with >1 worker when
+        ``RTPU_JAX_DISTRIBUTED=1``.
+    local_device_count: per-worker virtual device count on the CPU rig
+        (ignored on real accelerators — the platform defines locals).
     init_collective_group: also install a shm collective group named
-        ``train_default`` across the workers (gradient sync path on the CPU
-        rig; on a real pod the compiled pjit program handles it and the shm
-        group is only used for control-plane style reductions of metrics).
+        ``train_default`` across the workers (gradient sync path for the
+        non-multi-controller CPU mode; on a real pod the compiled pjit
+        program handles it and the shm group is only used for
+        control-plane style reductions of metrics).
     """
 
     use_distributed: Optional[bool] = None   # None = auto (TPU only)
     init_collective_group: bool = True
     coordinator_port: int = 0
+    local_device_count: Optional[int] = None
+    cpu_collectives: str = "gloo"
+    init_timeout_s: float = 120.0
 
     @property
     def backend_cls(self):
@@ -64,12 +79,16 @@ class JaxConfig(BackendConfig):
 
 
 def _jax_worker_setup(rank: int, world_size: int, coord_addr: Optional[str],
-                      group_name: str, init_col: bool) -> None:
+                      group_name: str, init_col: bool,
+                      local_devices: Optional[int] = None,
+                      cpu_collectives: str = "gloo",
+                      init_timeout_s: float = 120.0) -> None:
     if coord_addr is not None and world_size > 1:
-        import jax
-        jax.distributed.initialize(coordinator_address=coord_addr,
-                                   num_processes=world_size,
-                                   process_id=rank)
+        from ray_tpu.parallel import multihost
+        multihost.initialize(coord_addr, world_size, rank,
+                             local_device_count=local_devices,
+                             cpu_collectives=cpu_collectives,
+                             init_timeout_s=init_timeout_s)
     if init_col and world_size > 1:
         from ray_tpu.util import collective as col
         if not col.is_group_initialized(group_name):
@@ -85,29 +104,52 @@ class _JaxBackend(Backend):
         world = worker_group.num_workers
         use_dist = backend_config.use_distributed
         if use_dist is None:
-            # multi-controller init only makes sense on real accelerators
+            # auto: multi-controller init on real accelerators only (the
+            # CPU rig opts in explicitly with use_distributed=True)
             use_dist = (os.environ.get("JAX_PLATFORMS", "") not in
                         ("cpu", "cpu,axon") and world > 1
                         and os.environ.get("RTPU_JAX_DISTRIBUTED") == "1")
         coord = None
-        if use_dist:
+        if use_dist and world > 1:
             import socket
             port = backend_config.coordinator_port or _free_port()
             coord = f"{socket.gethostbyname(socket.gethostname())}:{port}"
         import ray_tpu
         ray_tpu.get(worker_group.execute_async(
             _jax_worker_setup_by_rank, world, coord, self.GROUP,
-            backend_config.init_collective_group))
+            backend_config.init_collective_group,
+            backend_config.local_device_count,
+            backend_config.cpu_collectives,
+            backend_config.init_timeout_s))
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig) -> None:
+        # best-effort: leave the jax.distributed domain so coordinator
+        # sockets close before the actors are torn down (a force-killed
+        # group skips this — the OS reaps)
+        import ray_tpu
+        try:
+            ray_tpu.get(worker_group.execute_async(_jax_worker_teardown),
+                        timeout=10)
+        except Exception:  # noqa: BLE001 - workers may already be dead
+            pass
 
 
-def _jax_worker_setup_by_rank(world, coord, alias, init_col):
+def _jax_worker_teardown():
+    from ray_tpu.parallel import multihost
+    multihost.shutdown()
+
+
+def _jax_worker_setup_by_rank(world, coord, alias, init_col,
+                              local_devices=None, cpu_collectives="gloo",
+                              init_timeout_s=120.0):
     # Executed via WorkerGroup.execute_async → same fn on every worker; the
     # rank is read from the session (set before backend hooks run).
     from ray_tpu.train._internal.session import get_session
     from ray_tpu.util.collective import collective as col_mod
     s = get_session()
     group = f"train_{s.run_id}_a{s.attempt}"
-    _jax_worker_setup(s.rank, world, coord, group, init_col)
+    _jax_worker_setup(s.rank, world, coord, group, init_col,
+                      local_devices, cpu_collectives, init_timeout_s)
     if init_col and world > 1:
         col_mod._register_alias(alias, group)
 
