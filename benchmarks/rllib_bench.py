@@ -269,14 +269,262 @@ def bench_scaling(out: str = None) -> None:
             json.dump(doc, f, indent=1)
 
 
+def bench_apex(out: str = None) -> None:
+    """VERDICT r4 missing #6: the Ape-X replay-shard fleet measured —
+    adds/s into the sharded buffers, prioritized samples/s consumed by
+    the learner, the priority push-back RPC latency, at 2 vs 4 shards.
+    Reference: APEX's whole point is throughput (SURVEY §2.5 RLlib row).
+    """
+    import os
+
+    from ray_tpu.rllib.algorithms.apex import APEXConfig
+
+    doc = {"baseline_row": "SURVEY §2.5 RLlib / VERDICT r4 missing #6",
+           "date": time.strftime("%Y-%m-%d"), "cpus": os.cpu_count(),
+           "note": ("1-physical-core host: driver/learner/4 rollout "
+                    "workers/replay shards all time-share one core, so "
+                    "shard-count scaling measures CONTENTION here, not "
+                    "the parallel replay bandwidth a multi-core head "
+                    "would see.  The structural metrics (fragment refs "
+                    "routed worker->shard without driver transit, "
+                    "per-shard in-flight sample chains, priority "
+                    "push-back) are shard-count-independent."),
+           "shards": {}}
+    for n_shards in (2, 4):
+        algo = (APEXConfig().environment("CartPole-v1")
+                .rollouts(num_workers=4, num_envs_per_worker=2,
+                          rollout_fragment_length=32)
+                .training(num_replay_shards=n_shards, buffer_size=50_000,
+                          train_batch_size=64, learning_starts=512,
+                          num_updates_per_iteration=16)
+                .debugging(seed=0).build())
+        r = algo.train()   # warm: fleet + shard spawn + first compiles
+        added0 = r["info"]["num_env_steps_sampled"]
+        updates0 = r["info"]["learner_updates"]
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 30:
+            r = algo.train()
+        wall = time.perf_counter() - t0
+        adds = r["info"]["num_env_steps_sampled"] - added0
+        updates = r["info"]["learner_updates"] - updates0
+        row = {
+            "adds_per_s": round(adds / wall, 1),
+            "learner_updates_per_s": round(updates / wall, 2),
+            "prioritized_samples_per_s": round(updates * 64 / wall, 1),
+            "wall_s": round(wall, 1),
+        }
+        algo.stop()
+        doc["shards"][str(n_shards)] = row
+        print(json.dumps({"n_shards": n_shards, **row}), flush=True)
+
+    # Priority push-back latency: the learner->shard update_priorities RPC
+    # measured directly against a live shard actor holding real data.
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.apex import PrioritizedReplay
+    from ray_tpu.rllib.sample_batch import SampleBatch
+    shard = ray_tpu.remote(PrioritizedReplay).options(num_cpus=0) \
+        .remote(10_000, 0.6, seed=0)
+    batch = SampleBatch({
+        "obs": np.zeros((512, 4), np.float32),
+        "actions": np.zeros((512,), np.int64),
+        "rewards": np.zeros((512,), np.float32),
+        "new_obs": np.zeros((512, 4), np.float32),
+        "terminateds": np.zeros((512,), bool),
+        "truncateds": np.zeros((512,), bool)})
+    ray_tpu.get(shard.add_batch.remote(batch))
+    cols, idx, w = ray_tpu.get(shard.sample.remote(64, 0.4))
+    lat = []
+    for _ in range(200):
+        t0 = time.perf_counter()
+        ray_tpu.get(shard.update_priorities.remote(
+            idx, np.abs(np.random.randn(len(idx))).astype(np.float32)))
+        lat.append((time.perf_counter() - t0) * 1e6)
+    ray_tpu.kill(shard)
+    lat.sort()
+    doc["priority_pushback_rpc_us"] = {
+        "p50": round(lat[len(lat) // 2], 1),
+        "p99": round(lat[int(len(lat) * 0.99)], 1)}
+    print(json.dumps(doc))
+    if out:
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+
+
+def bench_gradpush(out: str = None) -> None:
+    """VERDICT r4 missing #6: A3C gradient-push vs IMPALA sample-ship on
+    the latency-bound workload — throughput AND bytes shipped to the
+    learner per trained env step (the quantity that decides which
+    execution pattern wins on a thin interconnect)."""
+    import os
+
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.a3c import A3CConfig
+
+    doc = {"baseline_row": "SURVEY §2.5 RLlib / VERDICT r4 missing #6",
+           "date": time.strftime("%Y-%m-%d"), "cpus": os.cpu_count(),
+           "note": ("bytes/step: A3C ships one gradient pytree "
+                    "(= parameter count x 4B) per fragment; IMPALA ships "
+                    "the fragment's observations+actions+rewards+logits. "
+                    "On CartPole (16B obs) sample-ship is cheaper; the "
+                    "crossover is obs_bytes x frag > param_bytes — for "
+                    "84x84x4 pixel obs (28KB/step) gradient-push wins "
+                    "by ~100x per step, which is why the pattern exists. "
+                    "1-core host: throughputs are contention-bound."),
+           "modes": {}}
+    frag = 16
+
+    # --- A3C: gradients travel ---------------------------------------
+    algo = (A3CConfig().environment("SlowEnv", env_config={
+                "inner": "CartPole-v1", "step_delay_ms": 4.0})
+            .rollouts(num_workers=4, rollout_fragment_length=frag)
+            .training(grads_per_iteration=8)
+            .debugging(seed=0).build())
+    policy = algo.workers.local_worker.policy
+    param_bytes = sum(
+        np.prod(p.shape) * 4
+        for p in __import__("jax").tree_util.tree_leaves(policy.params))
+    r = algo.train()
+    trained0 = r["info"]["num_env_steps_trained"]
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 25:
+        r = algo.train()
+    wall = time.perf_counter() - t0
+    trained = r["info"]["num_env_steps_trained"] - trained0
+    grads_shipped = trained / frag       # one grad pytree per fragment
+    doc["modes"]["a3c_gradient_push"] = {
+        "trained_steps_per_s": round(trained / wall, 1),
+        "payload_bytes_per_trained_step": round(
+            grads_shipped * param_bytes / max(trained, 1)),
+        "grad_pytree_bytes": int(param_bytes),
+        "wall_s": round(wall, 1)}
+    algo.stop()
+    print(json.dumps({"mode": "a3c",
+                      **doc["modes"]["a3c_gradient_push"]}), flush=True)
+
+    # --- IMPALA: samples travel --------------------------------------
+    algo = (IMPALAConfig().environment("SlowEnv", env_config={
+                "inner": "CartPole-v1", "step_delay_ms": 4.0})
+            .rollouts(num_workers=4, num_envs_per_worker=1,
+                      rollout_fragment_length=frag)
+            .training(learner_device="cpu", num_batches_per_iteration=4,
+                      num_fragments_per_update=4)
+            .debugging(seed=0).build())
+    r = algo.train()
+    trained0 = int(r["info"]["num_env_steps_trained"])
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 25:
+        r = algo.train()
+    wall = time.perf_counter() - t0
+    trained = int(r["info"]["num_env_steps_trained"]) - trained0
+    # CartPole fragment row: obs(4f32) + next_obs is absent in IMPALA
+    # (policy-gradient), actions(i64) + rewards(f32) + dones(2b) +
+    # behavior logits(2f32) ≈ 16+8+4+2+8 = 38B/step
+    sample_bytes_per_step = 4 * 4 + 8 + 4 + 2 + 2 * 4
+    doc["modes"]["impala_sample_ship"] = {
+        "trained_steps_per_s": round(trained / wall, 1),
+        "payload_bytes_per_trained_step": sample_bytes_per_step,
+        "wall_s": round(wall, 1)}
+    algo.stop()
+    print(json.dumps({"mode": "impala",
+                      **doc["modes"]["impala_sample_ship"]}), flush=True)
+
+    a, b = (doc["modes"]["a3c_gradient_push"],
+            doc["modes"]["impala_sample_ship"])
+    doc["bytes_ratio_a3c_over_impala_cartpole"] = round(
+        a["payload_bytes_per_trained_step"]
+        / b["payload_bytes_per_trained_step"], 1)
+    # the pixel-obs crossover, computed from the same measured grad size
+    doc["pixel_obs_crossover"] = {
+        "obs_bytes_per_step_84x84x4": 84 * 84 * 4,
+        "a3c_bytes_per_step_unchanged": a["payload_bytes_per_trained_step"],
+        "ratio_impala_over_a3c": round(
+            (84 * 84 * 4) / max(a["payload_bytes_per_trained_step"], 1), 1)}
+    print(json.dumps(doc))
+    if out:
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+
+
+def bench_marwil(out: str = None) -> None:
+    """VERDICT r4 missing #6: offline-RL learner throughput — MARWIL
+    (beta=1) and BC (beta=0) updates/s + trained steps/s over a recorded
+    CartPole dataset."""
+    import os
+    import tempfile
+
+    from ray_tpu.rllib.algorithms.marwil import MARWILConfig
+    from ray_tpu.rllib.offline import record_rollouts
+
+    doc = {"baseline_row": "SURVEY §2.5 RLlib / VERDICT r4 missing #6",
+           "date": time.strftime("%Y-%m-%d"), "cpus": os.cpu_count(),
+           "modes": {}}
+    data_dir = tempfile.mkdtemp(prefix="rtpu_marwil_bench_")
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig as _PPO
+    seed_algo = (_PPO().environment("CartPole-v1")
+                 .rollouts(num_workers=0).debugging(seed=0).build())
+    record_rollouts(seed_algo.workers.local_worker.policy, "CartPole-v1",
+                    data_dir, episodes=80, seed=0)
+    seed_algo.stop()
+    for label, beta in (("marwil_beta1", 1.0), ("bc_beta0", 0.0)):
+        algo = (MARWILConfig().environment("CartPole-v1")
+                .offline_data(input=data_dir, beta=beta)
+                .training(train_batch_size=512, updates_per_iteration=50)
+                .debugging(seed=0).build())
+        r = algo.train()   # warm: dataset load + jit compile
+        t0 = time.perf_counter()
+        updates = trained0 = 0
+        trained0 = algo._trained
+        while time.perf_counter() - t0 < 20:
+            algo.train()
+            updates += 50
+        wall = time.perf_counter() - t0
+        row = {"updates_per_s": round(updates / wall, 1),
+               "trained_steps_per_s": round(
+                   (algo._trained - trained0) / wall, 1),
+               "batch_size": 512, "wall_s": round(wall, 1)}
+        algo.stop()
+        doc["modes"][label] = row
+        print(json.dumps({"mode": label, **row}), flush=True)
+    print(json.dumps(doc))
+    if out:
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+
+
+def bench_r05(out: str = None) -> None:
+    """One artifact for VERDICT r4 missing #6: APEX fleet + gradient-push
+    A/B + offline learners, merged."""
+    import contextlib
+    import io
+
+    merged = {"date": time.strftime("%Y-%m-%d")}
+    for name, fn in (("apex", bench_apex), ("gradpush", bench_gradpush),
+                     ("marwil", bench_marwil)):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            fn(None)
+        lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+        merged[name] = json.loads(lines[-1])
+        print(json.dumps({"section": name, "done": True}), flush=True)
+    print(json.dumps(merged))
+    if out:
+        with open(out, "w") as f:
+            json.dump(merged, f, indent=1)
+
+
 if __name__ == "__main__":
     import os
     # logical CPUs: rollout actors + learner oversubscribe small hosts fine
     ray_tpu.init(num_cpus=max(10, os.cpu_count() or 1),
                  ignore_reinit_error=True)
     which = sys.argv[1] if len(sys.argv) > 1 else "ppo"
-    if which in ("scaling", "impala_overlap"):
-        fn = bench_scaling if which == "scaling" else bench_impala_overlap
+    if which in ("scaling", "impala_overlap", "apex", "gradpush", "marwil",
+                 "r05"):
+        fn = {"scaling": bench_scaling, "impala_overlap": bench_impala_overlap,
+              "apex": bench_apex, "gradpush": bench_gradpush,
+              "marwil": bench_marwil, "r05": bench_r05}[which]
         fn(sys.argv[2] if len(sys.argv) > 2 else None)
     else:
         {"ppo": bench_ppo, "impala": bench_impala,

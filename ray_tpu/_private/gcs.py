@@ -1298,10 +1298,16 @@ class GcsServer:
         from ray_tpu._private.config import GLOBAL_CONFIG
         client_id: Optional[str] = None
         ver = 0  # negotiated wire version for THIS connection
+        # Codec mirroring: a peer that sends rtmsg frames may not speak
+        # pickle at all (the C client, any polyglot worker) — its replies
+        # must come back rtmsg even for hot kinds.  Pickle-speaking peers
+        # keep the C-speed pickle reply on hot kinds.
+        peer_rtmsg = False
         try:
             while not self._shutdown:
                 try:
-                    msg, seen_ver = wire.conn_recv(conn)
+                    msg, seen_ver, seen_codec = wire.conn_recv_ex(conn)
+                    peer_rtmsg = seen_codec == wire._CODEC_RTMSG
                 except (EOFError, OSError):
                     break
                 except wire.WireError as e:
@@ -1361,7 +1367,8 @@ class GcsServer:
                         if rid is not None:
                             try:
                                 wire.conn_send(conn, {"rid": rid, **replay},
-                                               ver, kind in wire._HOT_KINDS)
+                                               ver, kind in wire._HOT_KINDS
+                                               and not peer_rtmsg)
                             except (OSError, ValueError):
                                 break
                         continue
@@ -1383,7 +1390,8 @@ class GcsServer:
                 if rid is not None:
                     try:
                         wire.conn_send(conn, {"rid": rid, **reply}, ver,
-                                       kind in wire._HOT_KINDS)
+                                       kind in wire._HOT_KINDS
+                                       and not peer_rtmsg)
                     except (OSError, ValueError):
                         break
         finally:

@@ -88,7 +88,15 @@ def _rtmsg_encode_into(buf: bytearray, obj: Any) -> None:
         buf.append(0x20)
         buf += _pack_u32(len(raw))
         buf += raw
-    elif type(obj) is bytes:
+    elif type(obj) in (bytes, bytearray, memoryview):
+        # buffer widening: bytearray/memoryview encode as the bytes tag
+        # and DECODE as bytes — fine for wire payloads (out-of-band
+        # buffers, inline object data), where only content round-trips.
+        # memoryview len() counts ELEMENTS, not bytes: cast to a flat
+        # byte view first (non-contiguous views raise TypeError and fall
+        # to the caller's pickle fallback, same as other unencodables).
+        if type(obj) is memoryview:
+            obj = obj.cast("B")
         buf.append(0x21)
         buf += _pack_u32(len(obj))
         buf += obj
@@ -204,16 +212,26 @@ def encode_frame(obj: Any, version: int,
 
 
 def decode_frame(raw: bytes) -> Tuple[Any, int]:
-    """Decode one message → (obj, observed_version).
+    """Decode one message → (obj, observed_version)."""
+    obj, ver, _codec = decode_frame_ex(raw)
+    return obj, ver
 
-    Accepts legacy raw-pickle streams (version 0) alongside framed
-    messages, so a versioned reader can serve un-upgraded peers.
+
+def decode_frame_ex(raw: bytes) -> Tuple[Any, int, int]:
+    """Decode one message → (obj, observed_version, observed_codec).
+
+    Accepts legacy raw-pickle streams (version 0, codec reported as
+    pickle) alongside framed messages, so a versioned reader can serve
+    un-upgraded peers.  The codec matters to SERVERS: replies to a peer
+    that spoke rtmsg must come back rtmsg (it may not be able to read
+    pickle at all — the polyglot contract), while pickle-speaking peers
+    keep the C-speed hot-kind reply path.
     """
     if not raw:
         raise WireError("empty frame")
     first = raw[0]
     if first == _PICKLE_OPCODE:
-        return pickle.loads(raw), 0
+        return pickle.loads(raw), 0, _CODEC_PICKLE
     if first > PROTO_MAX:
         raise ProtocolVersionError(
             f"frame version {first} > supported max {PROTO_MAX}")
@@ -221,9 +239,9 @@ def decode_frame(raw: bytes) -> Tuple[Any, int]:
         raise WireError("truncated frame header")
     codec = raw[1]
     if codec == _CODEC_RTMSG:
-        return rtmsg_loads(raw[2:]), first
+        return rtmsg_loads(raw[2:]), first, _CODEC_RTMSG
     if codec == _CODEC_PICKLE:
-        return pickle.loads(raw[2:]), first
+        return pickle.loads(raw[2:]), first, _CODEC_PICKLE
     raise WireError(f"unknown codec {codec}")
 
 
@@ -238,6 +256,11 @@ def conn_send(conn, obj: Any, version: int,
 def conn_recv(conn) -> Tuple[Any, int]:
     """recv one message from a Connection → (obj, observed_version)."""
     return decode_frame(conn.recv_bytes())
+
+
+def conn_recv_ex(conn) -> Tuple[Any, int, int]:
+    """recv one message → (obj, observed_version, observed_codec)."""
+    return decode_frame_ex(conn.recv_bytes())
 
 
 def negotiate_version(client_versions, server_min: int,

@@ -8,8 +8,10 @@ how many of each.  Shipped providers:
 - :class:`FakeMultiNodeProvider` — adds/removes logical nodes in a running
   cluster via the control-plane ``add_node``/``remove_node`` RPCs (the
   reference's ``fake_multi_node`` test provider).
-- :class:`GkeTpuNodeProvider` — a stub documenting the production path
-  (GKE node pools of TPU slices); requires cloud APIs unavailable here.
+- :class:`~ray_tpu.autoscaler.kube.GkeTpuNodeProvider` — the real K8s
+  REST provider (``autoscaler/kube.py``): node pools of TPU slices via
+  the apiserver, GKE TPU node selectors, e2e-tested against a fake
+  apiserver (``tests/test_autoscaler_kube.py``).
 """
 
 from __future__ import annotations

@@ -21,6 +21,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 import pytest
 
 import ray_tpu
+from conftest import time_scale
 from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
 from ray_tpu.autoscaler.kube import (
     KubeClient, KubernetesNodeProvider, GkeTpuNodeProvider)
@@ -219,7 +220,7 @@ def test_e2e_scale_up_schedule_scale_down(ray_start_regular):
         assert srv.pods, "no pod created on the fake apiserver"
 
         # the fake kubelet ran a real agent: the node joins with labels
-        deadline = time.time() + 90
+        deadline = time.time() + 90 * time_scale()
         joined = None
         while time.time() < deadline and joined is None:
             for n in state.list_nodes():
@@ -234,7 +235,7 @@ def test_e2e_scale_up_schedule_scale_down(ray_start_regular):
         # release demand; after idle_timeout the pod is terminated
         from ray_tpu.util.placement_group import remove_placement_group
         remove_placement_group(pg)
-        deadline = time.time() + 60
+        deadline = time.time() + 60 * time_scale()
         while time.time() < deadline and srv.pods:
             autoscaler.update()
             time.sleep(1.0)

@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from conftest import time_scale
 from ray_tpu.util import state
 
 
@@ -93,7 +94,7 @@ def test_actor_state_reset_on_chaos_restart(ray_start_regular):
     a = A.remote()
     n1, pid1 = ray_tpu.get(a.incr.remote())
     os.kill(pid1, signal.SIGKILL)
-    deadline = time.time() + 60
+    deadline = time.time() + 60 * time_scale()
     while True:
         try:
             n2, pid2 = ray_tpu.get(a.incr.remote(), timeout=30)

@@ -19,6 +19,7 @@ import time
 import pytest
 
 import ray_tpu
+from conftest import time_scale
 
 _HEAD_SCRIPT = r"""
 import signal, sys, time
@@ -98,7 +99,7 @@ def test_repeated_head_kill_under_task_load(tmp_path):
         # named actor survived every restart WITH state (idempotent probe)
         h = ray_tpu.get_actor("chaos_keeper")
         val = None
-        deadline = time.time() + 60
+        deadline = time.time() + 60 * time_scale()
         while time.time() < deadline:
             try:
                 val = ray_tpu.get(h.add.remote(0), timeout=20)
@@ -149,7 +150,7 @@ def test_head_kill_around_pg_commit(tmp_path):
         heads.append(h2)
 
         from ray_tpu.util import state
-        deadline = time.time() + 90
+        deadline = time.time() + 90 * time_scale()
 
         def table():
             while True:

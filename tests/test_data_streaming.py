@@ -59,8 +59,15 @@ def test_ingest_overlaps_slow_map(ray_start_regular):
         f"no read/map overlap: stages executed as sequential waves "
         f"(reads={reads}, maps={maps})")
     # and the overlap must actually buy wall-clock: strictly less than the
-    # fully serialized sum (6*0.15 + 6*0.15 = 1.8s) even with dispatch cost
-    serial = n_blocks * 0.3
+    # fully serialized sum (6*0.15 + 6*0.15 = 1.8s) even with dispatch cost.
+    # Dispatch cost is CPU time; on a CONTENDED host it eats the sleep-
+    # overlap margin, so the bound stretches with the host-speed probe —
+    # but only when the probe actually detects contention (>1.3×): an idle
+    # host keeps the tight bound so sequential-wave regressions still trip
+    # it (the interval-overlap assertion above is the structural check).
+    from conftest import time_scale
+    scale = time_scale() if time_scale() > 1.3 else 1.0
+    serial = n_blocks * 0.3 * scale
     assert wall < serial, f"wall {wall:.2f}s not better than serial {serial}s"
 
 

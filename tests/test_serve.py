@@ -13,6 +13,7 @@ import urllib.request
 import pytest
 
 import ray_tpu
+from conftest import time_scale
 from ray_tpu import serve
 
 
@@ -164,7 +165,7 @@ def test_autoscaling_up_and_down(serve_cluster):
     threads = [threading.Thread(target=pound, daemon=True) for _ in range(6)]
     for t in threads:
         t.start()
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 30 * time_scale()
     while time.monotonic() < deadline:
         if serve.status()[key]["target"] >= 2:
             break
@@ -173,7 +174,7 @@ def test_autoscaling_up_and_down(serve_cluster):
     stop.set()
     for t in threads:
         t.join()
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 30 * time_scale()
     while time.monotonic() < deadline:
         if serve.status()[key]["target"] == 1:
             break
@@ -193,7 +194,7 @@ def test_redeploy_and_delete(serve_cluster):
     handle = serve.run(V.bind(1), route_prefix="/v")
     assert handle.remote(None).result() == 1
     handle = serve.run(V.bind(2), route_prefix="/v")
-    deadline = time.monotonic() + 20
+    deadline = time.monotonic() + 20 * time_scale()
     while time.monotonic() < deadline:
         if handle.remote(None).result() == 2:
             break
@@ -242,7 +243,7 @@ def test_replica_death_recovery(serve_cluster):
     tg = ray_tpu.get(ctrl.get_deployment_targets.remote(key))
     victim = next(iter(tg["replicas"].values()))
     ray_tpu.kill(ray_tpu.get_actor(victim), no_restart=True)
-    deadline = time.monotonic() + 60
+    deadline = time.monotonic() + 60 * time_scale()
     while time.monotonic() < deadline:
         st = ray_tpu.get(ctrl.status.remote())[key]
         tg = ray_tpu.get(ctrl.get_deployment_targets.remote(key))
@@ -252,5 +253,16 @@ def test_replica_death_recovery(serve_cluster):
     else:
         raise AssertionError(
             f"replica not replaced: {st} {tg['replicas']}")
-    # and the deployment still serves
-    assert h.remote(7).result() == 7
+    # and the deployment still serves — retry through the router's
+    # refresh window (its cached replica set may briefly include the
+    # dead actor after the controller already swapped it out)
+    deadline = time.monotonic() + 30 * time_scale()
+    while True:
+        try:
+            assert h.remote(7).result() == 7
+            break
+        except (ray_tpu.exceptions.RayActorError,
+                ray_tpu.exceptions.RayServeError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
