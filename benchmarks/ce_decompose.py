@@ -1,0 +1,163 @@
+"""LM-head + cross-entropy block decomposition (VERDICT r4 weak #3).
+
+The r4 step breakdown attributed ~45ms of the 124M step to the LM-head
+matmul + CE with a one-line floor claim.  This bench decomposes it the
+way r4 decomposed the flash backward:
+
+- **floor**: the block's three irreducible matmuls — logits = x@W^T
+  (fwd), dx = dlogits@W, dW = x^T@dlogits — timed bare at the exact
+  shapes (M=B*T=32768, K=768, N=50257), pipelined, bf16.  Everything
+  the block costs beyond this is elementwise/reduction overhead XLA
+  did not fuse away.
+- **isolated block**: value_and_grad of the CE given a precomputed
+  hidden-state tensor, per variant (fused / seq-chunked / vocab-chunked
+  online-softmax).
+- **full step**: the flagship 124M train step per variant — the number
+  that flows to the headline if a variant wins.
+
+Usage: python benchmarks/ce_decompose.py [out.json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _sync(jax, x):
+    return float(jax.device_get(jax.numpy.asarray(x).ravel()[0]))
+
+
+def _time_pipelined(jax, fn, args, steps=10):
+    out = fn(*args)
+    _sync(jax, out[0] if isinstance(out, tuple) else out)   # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    _sync(jax, out[0] if isinstance(out, tuple) else out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main() -> int:
+    import os
+
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    GLOBAL_CONFIG.apply_xla_cache_env(os.environ)
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import mesh as mesh_lib, spmd
+    from ray_tpu.parallel.mesh import MeshConfig
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        print(json.dumps({"skipped": "needs the real chip"}))
+        return 0
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+    B, T, E, V = 32, 1024, 768, 50257
+    doc = {"date": time.strftime("%Y-%m-%d"),
+           "device": getattr(dev, "device_kind", dev.platform),
+           "shape": {"B": B, "T": T, "E": E, "V": V},
+           "baseline_row": "VERDICT r4 weak #3 (LM-head+CE ~45ms block)"}
+
+    # ---- floor: the three bare matmuls --------------------------------
+    key = jax.random.key(0)
+    x2 = jax.random.normal(key, (B * T, E), jnp.bfloat16)
+    w = jax.random.normal(key, (E, V), jnp.bfloat16)
+    dl = jax.random.normal(key, (B * T, V), jnp.bfloat16)
+
+    fwd = jax.jit(lambda a, b: (a @ b).astype(jnp.bfloat16))
+    dxm = jax.jit(lambda g, b: (g @ b.T).astype(jnp.bfloat16))
+    dwm = jax.jit(lambda a, g: (a.T @ g).astype(jnp.bfloat16))
+    t_fwd = _time_pipelined(jax, fwd, (x2, w))
+    t_dx = _time_pipelined(jax, dxm, (dl, w))
+    t_dw = _time_pipelined(jax, dwm, (x2, dl))
+    flop = 2.0 * B * T * E * V
+    doc["matmul_floor"] = {
+        "logits_ms": round(t_fwd * 1e3, 2),
+        "dx_ms": round(t_dx * 1e3, 2),
+        "dw_ms": round(t_dw * 1e3, 2),
+        "total_ms": round((t_fwd + t_dx + t_dw) * 1e3, 2),
+        "tflops_each": round(flop / 1e12, 2),
+        "delivered_tflops": [round(flop / t / 1e12, 1)
+                             for t in (t_fwd, t_dx, t_dw)]}
+    print(json.dumps({"matmul_floor": doc["matmul_floor"]}), flush=True)
+
+    # ---- isolated block per variant -----------------------------------
+    x3 = jax.random.normal(key, (B, T, E), jnp.bfloat16)
+    wte = jax.random.normal(key, (V, E), jnp.bfloat16)
+    tgt = jnp.asarray(np.random.default_rng(0).integers(0, V, (B, T)),
+                      jnp.int32)
+
+    def fused(xh, wv):
+        logits = jnp.einsum("bte,ve->btv", xh, wv)
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        correct = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
+        return (lse - correct.astype(jnp.float32)).mean()
+
+    variants = {"fused": jax.jit(jax.value_and_grad(fused, argnums=(0, 1)))}
+    for nc in (4, 8):
+        variants[f"seq_chunk_{nc}"] = jax.jit(jax.value_and_grad(
+            lambda xh, wv, n=nc: gpt2._chunked_ce(xh, wv, tgt, n),
+            argnums=(0, 1)))
+    for nc in (8, 16):
+        variants[f"vocab_chunk_{nc}"] = jax.jit(jax.value_and_grad(
+            lambda xh, wv, n=nc: gpt2._vocab_chunked_ce(xh, wv, tgt, n),
+            argnums=(0, 1)))
+    doc["isolated_block_fwd_bwd_ms"] = {}
+    for name, fn in variants.items():
+        t = _time_pipelined(jax, lambda a, b: fn(a, b)[0], (x3, wte))
+        doc["isolated_block_fwd_bwd_ms"][name] = round(t * 1e3, 2)
+        print(json.dumps({"isolated": name, "ms": round(t * 1e3, 2)}),
+              flush=True)
+
+    # ---- full flagship step per variant -------------------------------
+    doc["full_step_ms"] = {}
+    for name, over in (("fused", {}),
+                       ("seq_chunk_4", {"loss_chunks": 4}),
+                       ("vocab_chunk_8", {"loss_vocab_chunks": 8})):
+        cfg = dataclasses.replace(gpt2.gpt2_small(), attn_impl="flash",
+                                  remat_policy="attn_qkv", **over)
+        mc = MeshConfig(data=1).resolved(1)
+        mesh = mesh_lib.build_mesh(mc, [dev])
+        prog = spmd.build_train_program(
+            loss_fn=lambda p, b: gpt2.loss_fn(p, b, cfg),
+            init_params_fn=lambda rng: gpt2.init_params(rng, cfg),
+            optimizer=spmd.default_optimizer(moments_dtype=jnp.bfloat16),
+            mesh=mesh, mesh_config=mc)
+        state = prog.init_fn(jax.random.key(0))
+        toks = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (B, T + 1)).astype(np.int32)
+        b = spmd.shard_batch(prog, {"inputs": toks[:, :-1],
+                                    "targets": toks[:, 1:]})
+        state, m = prog.step_fn(state, b)
+        float(jax.device_get(m["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            state, m = prog.step_fn(state, b)
+        float(jax.device_get(m["loss"]))
+        doc["full_step_ms"][name] = round((time.perf_counter() - t0) * 100, 2)
+        print(json.dumps({"full_step": name,
+                          "ms": doc["full_step_ms"][name]}), flush=True)
+        del state, prog, b
+
+    iso = doc["isolated_block_fwd_bwd_ms"]
+    doc["analysis"] = {
+        "block_overhead_vs_floor_ms": round(
+            iso["fused"] - doc["matmul_floor"]["total_ms"], 2),
+        "best_variant": min(iso, key=iso.get),
+    }
+    print(json.dumps(doc))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,37 +177,68 @@ def rtmsg_loads(raw: bytes) -> Any:
 
 
 # ----------------------------------------------------------------- frames
-# µs-critical kinds stay on the pickle codec (C-speed) even at v2: the
-# pure-Python rtmsg encoder costs ~20µs/frame (measured, cProfile on the
-# actor serial-RT loop) vs ~2µs for C pickle, and these kinds sit on the
-# serial round-trip path.  The codec BYTE is per-frame, so a polyglot peer
-# that cannot speak pickle can still negotiate v2 and read every
-# non-payload control kind as rtmsg; same-language peers keep C-speed
-# where latency is the contract (BASELINE #7).
+# Codec selection at v2 (measured, this host):
+#   C rtmsg (native/src/wirecodec.c)  ~2.2µs/frame roundtrip
+#   C pickle                          ~4.4µs
+#   pure-Python rtmsg                 ~31µs
+# With the native codec built (the normal case — gcc is in the image and
+# the build caches), EVERY encodable frame rides rtmsg: fastest AND
+# language-neutral, so hot kinds need no pickle carve-out.  Without it,
+# the µs-critical kinds below stay on pickle and only non-hot control
+# messages pay the pure-Python encoder; a polyglot peer can still
+# negotiate v2 and read every non-payload control kind as rtmsg either
+# way (BASELINE #7 latency contract unchanged).
 _HOT_KINDS = frozenset({
     "submit_batch", "submit_task", "get_meta", "peek_meta", "wait",
     "add_refs", "release", "release_batch", "task_done", "call",
     "put_object", "put_chunk", "fetch_chunk"})
+
+_c_codec = None
+_c_codec_tried = False
+
+
+def _native_codec():
+    """The C rtmsg codec, or None (no toolchain / RTPU_NO_NATIVE).
+    Lazy: wire.py imports during package init, ray_tpu.native cannot."""
+    global _c_codec, _c_codec_tried
+    if not _c_codec_tried:
+        _c_codec_tried = True
+        try:
+            from ray_tpu.native import load_wirecodec
+            _c_codec = load_wirecodec()
+        except Exception:  # noqa: BLE001 - any failure → pure-Python path
+            _c_codec = None
+    return _c_codec
 
 
 def encode_frame(obj: Any, version: int,
                  prefer_pickle: bool = False) -> bytes:
     """Encode one message at the negotiated version (0 = legacy pickle).
 
-    ``prefer_pickle`` marks a hot-path frame (reply to a hot kind); hot
-    requests are detected from their own "kind" field.
+    ``prefer_pickle`` marks a hot-path frame (reply to a hot kind); it
+    only matters when the native codec is absent — C rtmsg beats pickle,
+    so with it built there is nothing to prefer.
     """
     if version == 0:
         return pickle.dumps(obj)
     if not PROTO_MIN <= version <= PROTO_MAX:
         raise ProtocolVersionError(f"cannot encode version {version}")
-    if version >= 2 and not prefer_pickle \
-            and (not isinstance(obj, dict)
-                 or obj.get("kind") not in _HOT_KINDS):
-        try:
-            return bytes((version, _CODEC_RTMSG)) + rtmsg_dumps(obj)
-        except TypeError:
-            pass  # Python-payload message → pickle codec, same version
+    if version >= 2:
+        cc = _native_codec()
+        if cc is not None:
+            # ValueError: >200-deep nesting (C recursion guard);
+            # BufferError: non-contiguous memoryview — both mean "not
+            # rtmsg-able", same as TypeError: fall back to pickle
+            try:
+                return bytes((version, _CODEC_RTMSG)) + cc.dumps(obj)
+            except (TypeError, ValueError, BufferError):
+                pass  # Python-payload message → pickle codec
+        elif not prefer_pickle and (not isinstance(obj, dict)
+                                    or obj.get("kind") not in _HOT_KINDS):
+            try:
+                return bytes((version, _CODEC_RTMSG)) + rtmsg_dumps(obj)
+            except TypeError:
+                pass
     return bytes((version, _CODEC_PICKLE)) + pickle.dumps(obj)
 
 
@@ -239,6 +270,12 @@ def decode_frame_ex(raw: bytes) -> Tuple[Any, int, int]:
         raise WireError("truncated frame header")
     codec = raw[1]
     if codec == _CODEC_RTMSG:
+        cc = _native_codec()
+        if cc is not None:
+            try:
+                return cc.loads(raw[2:]), first, _CODEC_RTMSG
+            except ValueError as e:
+                raise WireError(str(e))
         return rtmsg_loads(raw[2:]), first, _CODEC_RTMSG
     if codec == _CODEC_PICKLE:
         return pickle.loads(raw[2:]), first, _CODEC_PICKLE

@@ -1589,6 +1589,17 @@ class _ActorChannel:
         self.max_task_retries = max_task_retries
         self.closed = False
         self._lock = threading.Lock()
+        # Sends serialize on their OWN lock: _read_loop must never park
+        # behind a blocked conn.send while holding up reply draining.
+        # With the actor executing serial calls on its connection-reader
+        # thread (actor_server direct-exec), the actor stops recv'ing
+        # during a method — if the caller ALSO stopped draining replies
+        # (reader parked on the state lock a blocked sender holds), a
+        # pipelined burst of ~100KB inline args/results could fill both
+        # socket buffers and deadlock all three parties.  The caller
+        # draining unconditionally breaks every such cycle: the actor's
+        # reply send always completes, so its reader always resumes.
+        self._send_lock = threading.Lock()
         self._outstanding: Dict[str, dict] = {}
         self._conn = None
         self._incarnation = -1
@@ -1636,8 +1647,13 @@ class _ActorChannel:
             if self.closed:
                 raise exc.RayActorError(self.actor_id, "channel closed")
             self._outstanding[msg["call_id"]] = msg
+            conn = self._conn
+        # the possibly-blocking socket write happens OUTSIDE the state
+        # lock (see _send_lock comment in __init__); registered-but-
+        # unsent calls are safe — a channel break resubmits outstanding
+        with self._send_lock:
             try:
-                self._conn.send(msg)
+                conn.send(msg)
             except (OSError, ValueError):
                 # reconnect path handles resubmission via _read_loop EOF
                 pass

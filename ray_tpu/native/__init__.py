@@ -31,12 +31,20 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
 
-def _compile(src: Path, out: Path) -> bool:
+def _compile(src: Path, out: Path, *, cmd_prefix: Optional[list] = None
+             ) -> bool:
+    """Compile src → out (atomic replace; concurrent builders race
+    benignly).  Default toolchain is the C++ shared-lib build;
+    ``cmd_prefix`` overrides everything before the ``-o tmp src`` tail
+    (used by the CPython-extension build)."""
     out.parent.mkdir(parents=True, exist_ok=True)
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(out.parent))
     os.close(fd)
-    cmd = ["g++", "-O2", "-g", "-shared", "-fPIC", "-std=c++17",
-           "-o", tmp, str(src), "-lpthread"]
+    prefix = cmd_prefix or ["g++", "-O2", "-g", "-shared", "-fPIC",
+                            "-std=c++17"]
+    cmd = [*prefix, "-o", tmp, str(src)]
+    if cmd_prefix is None:
+        cmd.append("-lpthread")
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
@@ -59,6 +67,47 @@ def _ensure_built(name: str) -> Optional[Path]:
     if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
         return out
     return out if _compile(src, out) else None
+
+
+_wirecodec = None
+_wirecodec_tried = False
+
+
+def load_wirecodec():
+    """Build + import the C rtmsg codec (``src/wirecodec.c``, a CPython
+    extension); None if no toolchain.  wire.py prefers it over the
+    pure-Python encoder — same language-neutral format, ~10x the speed,
+    which lets v2 frames ride rtmsg even on the µs-critical hot kinds."""
+    global _wirecodec, _wirecodec_tried
+    if _wirecodec is not None or _wirecodec_tried:
+        return _wirecodec
+    with _build_lock:
+        if _wirecodec is not None or _wirecodec_tried:
+            return _wirecodec
+        _wirecodec_tried = True
+        if os.environ.get("RTPU_NO_NATIVE"):
+            return None
+        import sysconfig
+        src = _SRC_DIR / "wirecodec.c"
+        out = _BUILD_DIR / "wirecodec.so"
+        if not (out.exists()
+                and out.stat().st_mtime >= src.stat().st_mtime):
+            if not _compile(src, out, cmd_prefix=[
+                    "gcc", "-O2", "-shared", "-fPIC",
+                    "-I", sysconfig.get_path("include")]):
+                return None
+        try:
+            import importlib.util
+            # NOTE: the name's last component must be "wirecodec" — the
+            # extension's init symbol is PyInit_wirecodec
+            spec = importlib.util.spec_from_file_location(
+                "wirecodec", str(out))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except (ImportError, OSError):
+            return None
+        _wirecodec = mod
+        return _wirecodec
 
 
 def load_slab_lib() -> Optional[ctypes.CDLL]:

@@ -351,7 +351,8 @@ static int dec_val(const uint8_t *p, uint32_t n, uint32_t *off, val_t *v) {
     }
     case 0x20: case 0x21:
         if (dec_u32(p, n, off, &v->len)) return -1;
-        if (*off + v->len > n) return -1;
+        /* no u32 wrap: compare against the REMAINING bytes */
+        if (v->len > n - *off) return -1;
         v->data = p + *off;
         *off += v->len;
         return 0;

@@ -1,0 +1,351 @@
+/* wirecodec — C implementation of the rtmsg control-message codec.
+ *
+ * Reference analog: the reference's protobuf C++ codegen — the wire
+ * schema compiled to native encode/decode so the control plane never
+ * pays interpreter cost per field.  This module implements wire.py's
+ * rtmsg tag table (the SAME language-neutral format the C client
+ * speaks, native/src/rtmsg_client.c) as a CPython extension:
+ *
+ *     from ray_tpu.native import wirecodec
+ *     wirecodec.dumps(obj) -> bytes      # ~10x the pure-Python encoder
+ *     wirecodec.loads(b)   -> obj
+ *
+ * wire.py prefers this module when it builds (g++ against Python.h at
+ * first import, cached in native/_build/) and falls back to the pure-
+ * Python codec otherwise — with the C codec present, v2 frames ride
+ * rtmsg even on the µs-critical hot kinds, replacing pickle with the
+ * polyglot codec at the same (C) speed.
+ *
+ * Tag table (wire.py):
+ *   0x01 None | 0x02 False | 0x03 True
+ *   0x10 int64 (BE) | 0x11 float64 (BE IEEE-754)
+ *   0x20 str(u32 len, utf-8) | 0x21 bytes(u32 len)
+ *   0x30 list(u32 n) | 0x31 tuple(u32 n) | 0x32 dict(u32 n)
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+/* ------------------------------------------------------------ encoder */
+typedef struct {
+    char *p;
+    Py_ssize_t n, cap;
+} wbuf;
+
+static int wb_reserve(wbuf *b, Py_ssize_t add) {
+    if (b->n + add <= b->cap)
+        return 0;
+    Py_ssize_t cap = b->cap ? b->cap : 256;
+    while (cap < b->n + add)
+        cap *= 2;
+    char *p = PyMem_Realloc(b->p, cap);
+    if (!p)
+        return -1;
+    b->p = p;
+    b->cap = cap;
+    return 0;
+}
+
+static int wb_u8(wbuf *b, uint8_t v) {
+    if (wb_reserve(b, 1)) return -1;
+    b->p[b->n++] = (char)v;
+    return 0;
+}
+
+static int wb_u32(wbuf *b, uint32_t v) {
+    if (wb_reserve(b, 4)) return -1;
+    b->p[b->n++] = (char)(v >> 24);
+    b->p[b->n++] = (char)(v >> 16);
+    b->p[b->n++] = (char)(v >> 8);
+    b->p[b->n++] = (char)v;
+    return 0;
+}
+
+static int wb_raw(wbuf *b, const void *src, Py_ssize_t len) {
+    if (wb_reserve(b, len)) return -1;
+    memcpy(b->p + b->n, src, len);
+    b->n += len;
+    return 0;
+}
+
+static int enc_obj(wbuf *b, PyObject *o, int depth);
+
+static int enc_buffer(wbuf *b, PyObject *o) {
+    Py_buffer view;
+    /* flat byte view; non-contiguous raises (matches wire.py contract) */
+    if (PyObject_GetBuffer(o, &view, PyBUF_CONTIG_RO))
+        return -1;
+    if (view.len > UINT32_MAX) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_TypeError, "bytes too long for rtmsg");
+        return -1;
+    }
+    int rc = wb_u8(b, 0x21) || wb_u32(b, (uint32_t)view.len) ||
+             wb_raw(b, view.buf, view.len);
+    PyBuffer_Release(&view);
+    return rc ? -1 : 0;
+}
+
+static int enc_obj(wbuf *b, PyObject *o, int depth) {
+    if (depth > 200) {
+        PyErr_SetString(PyExc_ValueError, "rtmsg nesting too deep");
+        return -1;
+    }
+    if (o == Py_None)
+        return wb_u8(b, 0x01);
+    if (o == Py_False)
+        return wb_u8(b, 0x02);
+    if (o == Py_True)
+        return wb_u8(b, 0x03);
+    PyTypeObject *t = Py_TYPE(o);
+    /* exact-type checks, same as the Python encoder: subclasses (numpy
+     * scalars, IntEnum) must NOT silently lose their identity */
+    if (t == &PyLong_Type) {
+        int overflow = 0;
+        long long v = PyLong_AsLongLongAndOverflow(o, &overflow);
+        if (overflow || (v == -1 && PyErr_Occurred())) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_TypeError, "int out of i64 range");
+            return -1;
+        }
+        if (wb_u8(b, 0x10) || wb_reserve(b, 8))
+            return -1;
+        for (int i = 7; i >= 0; i--)
+            b->p[b->n++] = (char)((unsigned long long)v >> (8 * i));
+        return 0;
+    }
+    if (t == &PyFloat_Type) {
+        double d = PyFloat_AS_DOUBLE(o);
+        uint64_t u;
+        memcpy(&u, &d, 8);
+        if (wb_u8(b, 0x11) || wb_reserve(b, 8))
+            return -1;
+        for (int i = 7; i >= 0; i--)
+            b->p[b->n++] = (char)(u >> (8 * i));
+        return 0;
+    }
+    if (t == &PyUnicode_Type) {
+        Py_ssize_t len;
+        const char *s = PyUnicode_AsUTF8AndSize(o, &len);
+        if (!s)
+            return -1;
+        if (len > UINT32_MAX) {
+            PyErr_SetString(PyExc_TypeError, "str too long for rtmsg");
+            return -1;
+        }
+        return (wb_u8(b, 0x20) || wb_u32(b, (uint32_t)len) ||
+                wb_raw(b, s, len)) ? -1 : 0;
+    }
+    if (t == &PyBytes_Type) {
+        Py_ssize_t len = PyBytes_GET_SIZE(o);
+        if (len > UINT32_MAX) {
+            PyErr_SetString(PyExc_TypeError, "bytes too long for rtmsg");
+            return -1;
+        }
+        return (wb_u8(b, 0x21) || wb_u32(b, (uint32_t)len) ||
+                wb_raw(b, PyBytes_AS_STRING(o), len)) ? -1 : 0;
+    }
+    if (t == &PyByteArray_Type || t == &PyMemoryView_Type)
+        return enc_buffer(b, o);
+    if (t == &PyList_Type || t == &PyTuple_Type) {
+        int is_list = t == &PyList_Type;
+        Py_ssize_t n = is_list ? PyList_GET_SIZE(o) : PyTuple_GET_SIZE(o);
+        if (wb_u8(b, is_list ? 0x30 : 0x31) || wb_u32(b, (uint32_t)n))
+            return -1;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *it = is_list ? PyList_GET_ITEM(o, i)
+                                   : PyTuple_GET_ITEM(o, i);
+            if (enc_obj(b, it, depth + 1))
+                return -1;
+        }
+        return 0;
+    }
+    if (t == &PyDict_Type) {
+        if (wb_u8(b, 0x32) || wb_u32(b, (uint32_t)PyDict_GET_SIZE(o)))
+            return -1;
+        Py_ssize_t pos = 0;
+        PyObject *k, *v;
+        while (PyDict_Next(o, &pos, &k, &v)) {
+            if (enc_obj(b, k, depth + 1) || enc_obj(b, v, depth + 1))
+                return -1;
+        }
+        return 0;
+    }
+    PyErr_Format(PyExc_TypeError, "not rtmsg-encodable: %s", t->tp_name);
+    return -1;
+}
+
+static PyObject *codec_dumps(PyObject *self, PyObject *arg) {
+    (void)self;
+    wbuf b = {NULL, 0, 0};
+    if (enc_obj(&b, arg, 0)) {
+        PyMem_Free(b.p);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(b.p, b.n);
+    PyMem_Free(b.p);
+    return out;
+}
+
+/* ------------------------------------------------------------ decoder */
+typedef struct {
+    const unsigned char *p;
+    Py_ssize_t n, off;
+} rbuf;
+
+static int rb_need(rbuf *r, Py_ssize_t need) {
+    if (r->off + need > r->n) {
+        PyErr_SetString(PyExc_ValueError, "truncated rtmsg value");
+        return -1;
+    }
+    return 0;
+}
+
+static PyObject *dec_obj(rbuf *r, int depth) {
+    if (depth > 200) {
+        PyErr_SetString(PyExc_ValueError, "rtmsg nesting too deep");
+        return NULL;
+    }
+    if (rb_need(r, 1))
+        return NULL;
+    uint8_t tag = r->p[r->off++];
+    switch (tag) {
+    case 0x01:
+        Py_RETURN_NONE;
+    case 0x02:
+        Py_RETURN_FALSE;
+    case 0x03:
+        Py_RETURN_TRUE;
+    case 0x10: {
+        if (rb_need(r, 8))
+            return NULL;
+        uint64_t u = 0;
+        for (int i = 0; i < 8; i++)
+            u = (u << 8) | r->p[r->off++];
+        return PyLong_FromLongLong((long long)u);
+    }
+    case 0x11: {
+        if (rb_need(r, 8))
+            return NULL;
+        uint64_t u = 0;
+        for (int i = 0; i < 8; i++)
+            u = (u << 8) | r->p[r->off++];
+        double d;
+        memcpy(&d, &u, 8);
+        return PyFloat_FromDouble(d);
+    }
+    case 0x20:
+    case 0x21: {
+        if (rb_need(r, 4))
+            return NULL;
+        uint32_t len = ((uint32_t)r->p[r->off] << 24) |
+                       ((uint32_t)r->p[r->off + 1] << 16) |
+                       ((uint32_t)r->p[r->off + 2] << 8) |
+                       r->p[r->off + 3];
+        r->off += 4;
+        if (rb_need(r, (Py_ssize_t)len))
+            return NULL;
+        PyObject *o = tag == 0x20
+            ? PyUnicode_DecodeUTF8((const char *)r->p + r->off, len, NULL)
+            : PyBytes_FromStringAndSize((const char *)r->p + r->off, len);
+        r->off += len;
+        return o;
+    }
+    case 0x30:
+    case 0x31: {
+        if (rb_need(r, 4))
+            return NULL;
+        uint32_t n = ((uint32_t)r->p[r->off] << 24) |
+                     ((uint32_t)r->p[r->off + 1] << 16) |
+                     ((uint32_t)r->p[r->off + 2] << 8) | r->p[r->off + 3];
+        r->off += 4;
+        PyObject *o = tag == 0x30 ? PyList_New(n) : PyTuple_New(n);
+        if (!o)
+            return NULL;
+        for (uint32_t i = 0; i < n; i++) {
+            PyObject *it = dec_obj(r, depth + 1);
+            if (!it) {
+                Py_DECREF(o);
+                return NULL;
+            }
+            if (tag == 0x30)
+                PyList_SET_ITEM(o, i, it);
+            else
+                PyTuple_SET_ITEM(o, i, it);
+        }
+        return o;
+    }
+    case 0x32: {
+        if (rb_need(r, 4))
+            return NULL;
+        uint32_t n = ((uint32_t)r->p[r->off] << 24) |
+                     ((uint32_t)r->p[r->off + 1] << 16) |
+                     ((uint32_t)r->p[r->off + 2] << 8) | r->p[r->off + 3];
+        r->off += 4;
+        PyObject *o = PyDict_New();
+        if (!o)
+            return NULL;
+        for (uint32_t i = 0; i < n; i++) {
+            PyObject *k = dec_obj(r, depth + 1);
+            if (!k) {
+                Py_DECREF(o);
+                return NULL;
+            }
+            PyObject *v = dec_obj(r, depth + 1);
+            if (!v) {
+                Py_DECREF(k);
+                Py_DECREF(o);
+                return NULL;
+            }
+            if (PyDict_SetItem(o, k, v)) {
+                Py_DECREF(k);
+                Py_DECREF(v);
+                Py_DECREF(o);
+                return NULL;
+            }
+            Py_DECREF(k);
+            Py_DECREF(v);
+        }
+        return o;
+    }
+    default:
+        PyErr_Format(PyExc_ValueError, "bad rtmsg tag 0x%02x at %zd",
+                     tag, r->off - 1);
+        return NULL;
+    }
+}
+
+static PyObject *codec_loads(PyObject *self, PyObject *arg) {
+    (void)self;
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_CONTIG_RO))
+        return NULL;
+    rbuf r = {(const unsigned char *)view.buf, view.len, 0};
+    PyObject *o = dec_obj(&r, 0);
+    if (o && r.off != r.n) {
+        Py_DECREF(o);
+        o = NULL;
+        PyErr_Format(PyExc_ValueError,
+                     "trailing bytes after rtmsg value (%zd)", r.n - r.off);
+    }
+    PyBuffer_Release(&view);
+    return o;
+}
+
+static PyMethodDef codec_methods[] = {
+    {"dumps", codec_dumps, METH_O, "rtmsg-encode one value to bytes"},
+    {"loads", codec_loads, METH_O, "decode one rtmsg value"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef codec_module = {
+    PyModuleDef_HEAD_INIT, "wirecodec",
+    "C rtmsg codec (wire.py tag table)", -1, codec_methods,
+    NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit_wirecodec(void) {
+    return PyModule_Create(&codec_module);
+}

@@ -218,3 +218,23 @@ def test_actor_large_payload(ray_start_regular):
     assert ray_tpu.get(h.store.remote(arr)) == arr.nbytes
     out = ray_tpu.get(h.fetch.remote())
     assert (out == arr).all()
+
+
+def test_actor_pipelined_inline_burst_no_deadlock(ray_start_regular):
+    """r5 regression: serial actors execute on the connection-reader
+    thread (direct-exec), so the actor stops recv'ing mid-method — a
+    pipelined burst of near-inline-max args+results must still complete
+    because the CALLER's reply reader never parks behind a blocked send
+    (_ActorChannel._send_lock).  Before that fix this could fill both
+    socket buffers and deadlock all three parties."""
+
+    @ray_tpu.remote
+    class Echo:
+        def big(self, blob):
+            return blob + b"!" * 50_000
+
+    e = Echo.remote()
+    blob = b"x" * 90_000          # inline_object_max_bytes is 100KB
+    refs = [e.big.remote(blob) for _ in range(24)]   # pipelined burst
+    out = ray_tpu.get(refs, timeout=120)
+    assert all(o == blob + b"!" * 50_000 for o in out)
