@@ -4,6 +4,7 @@ from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.apex import APEX, APEXConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.a3c import A3C, A3CConfig
@@ -12,4 +13,5 @@ from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
 __all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig",
            "IMPALA", "IMPALAConfig", "DQN", "DQNConfig", "APEX", "APEXConfig",
            "SAC", "SACConfig", "ES", "ESConfig", "APPO", "APPOConfig",
-           "A3C", "A3CConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig"]
+           "A3C", "A3CConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig",
+           "DDPG", "DDPGConfig", "TD3", "TD3Config"]

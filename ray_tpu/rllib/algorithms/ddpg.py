@@ -1,0 +1,270 @@
+"""DDPG + TD3: deterministic-policy-gradient continuous control.
+
+Reference: ``rllib/algorithms/ddpg/`` and ``rllib/algorithms/td3/``
+(SURVEY.md §2.5; Lillicrap et al. 2016, Fujimoto et al. 2018).  The
+continuous off-policy family SAC didn't cover (VERDICT r4 missing #7):
+
+- **DDPG**: deterministic tanh actor μ(s), ONE Q critic, polyak target
+  networks for both, Gaussian action-space exploration noise.
+- **TD3** = DDPG + the paper's three fixes, each a config knob here:
+  ``twin_q`` (clipped double-Q), ``policy_delay`` (delayed actor
+  updates), ``target_noise``/``target_noise_clip`` (target policy
+  smoothing).
+
+TPU-native shape: actor+critics+targets update in ONE jitted step (the
+policy delay rides ``lax.cond`` on the update counter, so the delayed
+variant is still a single compiled program, not Python branching).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import models
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
+from ray_tpu.rllib.evaluation import synchronous_parallel_sample
+from ray_tpu.rllib.sample_batch import (
+    ACTION_DIST_INPUTS, ACTION_LOGP, NEXT_OBS, OBS, REWARDS,
+    TERMINATEDS, VF_PREDS)
+
+
+class DDPGPolicy:
+    """Deterministic tanh actor for Box action spaces; exploration adds
+    Gaussian noise in the raw (-1,1) action space (reference:
+    ``ou_base_scale``/gaussian exploration — gaussian here, the TD3
+    paper's choice)."""
+
+    def __init__(self, observation_space, action_space,
+                 config: Optional[dict] = None):
+        config = config or {}
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config
+        obs_dim = models.flat_obs_dim(observation_space)
+        self.act_dim = int(np.prod(action_space.shape))
+        self.low = np.asarray(action_space.low, np.float32)
+        self.high = np.asarray(action_space.high, np.float32)
+        hiddens = tuple(config.get("fcnet_hiddens", (256, 256)))
+        self._num_layers = len(hiddens) + 1
+        self.model_config = models.ModelConfig(
+            obs_dim=obs_dim, num_outputs=self.act_dim, hiddens=hiddens)
+        seed = config.get("seed", 0)
+        self.params = models.init_q_net(jax.random.key(seed),
+                                        self.model_config)
+        self.explore_noise = float(config.get("exploration_noise", 0.1))
+        self._rng = np.random.default_rng(seed + 1)
+        n_layers = self._num_layers
+
+        @jax.jit
+        def _mu(params, obs):
+            return jnp.tanh(models.q_net_apply(params, obs, n_layers))
+
+        self._mu = _mu
+
+    def _scale(self, a: np.ndarray) -> np.ndarray:
+        return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True):
+        a = np.asarray(self._mu(self.params, jnp.asarray(obs, jnp.float32)))
+        if explore:
+            a = np.clip(a + self._rng.normal(
+                0.0, self.explore_noise, a.shape).astype(np.float32),
+                -1.0, 1.0)
+        n = len(a)
+        extras = {VF_PREDS: np.zeros(n, np.float32),
+                  ACTION_LOGP: np.zeros(n, np.float32),
+                  ACTION_DIST_INPUTS: np.zeros((n, self.act_dim),
+                                               np.float32)}
+        return self._scale(a).astype(np.float32), {**extras, "raw_action": a}
+
+    def compute_single_action(self, obs, explore: bool = True):
+        a, extras = self.compute_actions(obs[None], explore)
+        return a[0], {k: v[0] for k, v in extras.items()}
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.zeros(len(obs), np.float32)  # replay-based learner
+
+    def get_weights(self):
+        return {"params": jax.tree_util.tree_map(np.asarray, self.params)}
+
+    def set_weights(self, weights):
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights["params"])
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DDPG)
+        self._cfg.update({
+            "policy_class": DDPGPolicy,
+            "actor_lr": 1e-3, "critic_lr": 1e-3,
+            "gamma": 0.99, "tau": 0.005,
+            "buffer_size": 100_000, "learning_starts": 256,
+            "train_batch_size": 256, "num_sgd_per_step": 1,
+            "rollout_fragment_length": 1,
+            "fcnet_hiddens": (256, 256),
+            "exploration_noise": 0.1,
+            # --- the TD3 knobs (DDPG defaults = all off) ---
+            "twin_q": False,
+            "policy_delay": 1,
+            "target_noise": 0.0,
+            "target_noise_clip": 0.5,
+        })
+
+
+class TD3Config(DDPGConfig):
+    """DDPG + twin critics + delayed policy + target smoothing
+    (reference: ``TD3Config`` defaults)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or TD3)
+        self._cfg.update({
+            "twin_q": True,
+            "policy_delay": 2,
+            "target_noise": 0.2,
+            "target_noise_clip": 0.5,
+            "exploration_noise": 0.1,
+        })
+
+
+class DDPG(Algorithm):
+    _default_config_cls = DDPGConfig
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        policy: DDPGPolicy = self.workers.local_worker.policy
+        obs_dim = policy.model_config.obs_dim
+        act_dim = policy.act_dim
+        hiddens = tuple(config["fcnet_hiddens"])
+        q_cfg = models.ModelConfig(obs_dim=obs_dim + act_dim, num_outputs=1,
+                                   hiddens=hiddens)
+        q_layers = len(hiddens) + 1
+        seed = config.get("seed") or 0
+        k1, k2 = jax.random.split(jax.random.key(seed + 100))
+        self.q1 = models.init_q_net(k1, q_cfg)
+        self.q2 = models.init_q_net(k2, q_cfg)   # unused unless twin_q
+        self.actor_t = policy.params
+        self.q1_t, self.q2_t = self.q1, self.q2
+        self.buffer = ReplayBuffer(
+            int(config["buffer_size"]),
+            keys=(OBS, "raw_action", REWARDS, NEXT_OBS, TERMINATEDS))
+        self._rng = np.random.default_rng(seed)
+        self._learn_key = jax.random.key(seed + 7)
+        self._n_updates = 0
+
+        actor_opt = optax.adam(config["actor_lr"])
+        critic_opt = optax.adam(config["critic_lr"])
+        self._actor_state = actor_opt.init(policy.params)
+        self._critic_state = critic_opt.init((self.q1, self.q2))
+
+        gamma = float(config["gamma"])
+        tau = float(config["tau"])
+        twin_q = bool(config["twin_q"])
+        policy_delay = int(config["policy_delay"])
+        t_noise = float(config["target_noise"])
+        t_clip = float(config["target_noise_clip"])
+        a_layers = policy._num_layers
+
+        def mu(ap, obs):
+            return jnp.tanh(models.q_net_apply(ap, obs, a_layers))
+
+        def q_apply(qp, obs, act):
+            return models.q_net_apply(
+                qp, jnp.concatenate([obs, act], -1), q_layers)[:, 0]
+
+        def update(actor_p, actor_t, q1, q2, q1_t, q2_t,
+                   actor_s, critic_s, n_updates, mb, key):
+            # target action with TD3 smoothing noise (0 noise = DDPG)
+            next_a = mu(actor_t, mb[NEXT_OBS])
+            if t_noise > 0.0:
+                noise = jnp.clip(
+                    t_noise * jax.random.normal(key, next_a.shape),
+                    -t_clip, t_clip)
+                next_a = jnp.clip(next_a + noise, -1.0, 1.0)
+            qn1 = q_apply(q1_t, mb[NEXT_OBS], next_a)
+            q_next = jnp.minimum(qn1, q_apply(q2_t, mb[NEXT_OBS], next_a)) \
+                if twin_q else qn1
+            target = mb[REWARDS] + gamma * (1 - mb["dones"]) * \
+                jax.lax.stop_gradient(q_next)
+
+            def critic_loss(qs):
+                q1_, q2_ = qs
+                loss = jnp.square(
+                    q_apply(q1_, mb[OBS], mb["raw_action"]) - target).mean()
+                if twin_q:
+                    loss = loss + jnp.square(
+                        q_apply(q2_, mb[OBS], mb["raw_action"])
+                        - target).mean()
+                return loss
+
+            c_grads = jax.grad(critic_loss)((q1, q2))
+            c_updates, critic_s = critic_opt.update(c_grads, critic_s,
+                                                    (q1, q2))
+            q1, q2 = optax.apply_updates((q1, q2), c_updates)
+
+            # delayed deterministic-policy-gradient actor step: compute
+            # the candidate update, apply it via lax.cond so the delayed
+            # variant stays ONE compiled program
+            def actor_loss(ap):
+                return -q_apply(q1, mb[OBS], mu(ap, mb[OBS])).mean()
+
+            a_grads = jax.grad(actor_loss)(actor_p)
+            a_updates, cand_actor_s = actor_opt.update(a_grads, actor_s,
+                                                       actor_p)
+            cand_actor = optax.apply_updates(actor_p, a_updates)
+            do_actor = (n_updates % policy_delay) == 0
+            pick = lambda new, old: jnp.where(do_actor, new, old)  # noqa: E731
+            actor_p = jax.tree_util.tree_map(pick, cand_actor, actor_p)
+            actor_s = jax.tree_util.tree_map(pick, cand_actor_s, actor_s)
+            # polyak target sync (actor target only moves with the actor)
+            sync = lambda t, s: (1 - tau) * t + tau * s  # noqa: E731
+            q1_t = jax.tree_util.tree_map(sync, q1_t, q1)
+            q2_t = jax.tree_util.tree_map(sync, q2_t, q2)
+            actor_t = jax.tree_util.tree_map(
+                lambda t, s: jnp.where(do_actor, (1 - tau) * t + tau * s,
+                                       t), actor_t, actor_p)
+            metrics = {"critic_loss": critic_loss((q1, q2)),
+                       "q_mean": q_apply(q1, mb[OBS],
+                                         mb["raw_action"]).mean()}
+            return (actor_p, actor_t, q1, q2, q1_t, q2_t, actor_s,
+                    critic_s, metrics)
+
+        self._update = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        policy = self.workers.local_worker.policy
+        batch = synchronous_parallel_sample(self.workers)
+        self.buffer.add_batch(batch)
+        info: Dict[str, Any] = {"buffer_size": len(self.buffer)}
+        if len(self.buffer) < int(self.config["learning_starts"]):
+            return info
+        for _ in range(int(self.config["num_sgd_per_step"])):
+            mb = self.buffer.sample(int(self.config["train_batch_size"]),
+                                    self._rng)
+            device_mb = {
+                OBS: jnp.asarray(mb[OBS]),
+                "raw_action": jnp.asarray(mb["raw_action"]),
+                REWARDS: jnp.asarray(mb[REWARDS]),
+                NEXT_OBS: jnp.asarray(mb[NEXT_OBS]),
+                "dones": jnp.asarray(mb[TERMINATEDS].astype(np.float32)),
+            }
+            self._learn_key, sub = jax.random.split(self._learn_key)
+            (policy.params, self.actor_t, self.q1, self.q2, self.q1_t,
+             self.q2_t, self._actor_state, self._critic_state,
+             metrics) = self._update(
+                policy.params, self.actor_t, self.q1, self.q2, self.q1_t,
+                self.q2_t, self._actor_state, self._critic_state,
+                jnp.asarray(self._n_updates), device_mb, sub)
+            self._n_updates += 1
+            info.update({k: float(v) for k, v in metrics.items()})
+        self.workers.sync_weights()
+        info["num_updates"] = self._n_updates
+        return info
+
+
+class TD3(DDPG):
+    _default_config_cls = TD3Config

@@ -11,7 +11,7 @@ from ray_tpu.train import get_checkpoint, report  # noqa: F401
 from ray_tpu.train._checkpoint import Checkpoint  # noqa: F401
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler, AsyncHyperBandScheduler, FIFOScheduler,
-    MedianStoppingRule, PopulationBasedTraining, TrialScheduler,
+    MedianStoppingRule, PB2, PopulationBasedTraining, TrialScheduler,
 )
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator, choice, grid_search, loguniform, qrandint,

@@ -12,6 +12,7 @@ from ray_tpu.tune.schedulers.median_stopping import (  # noqa: F401
 from ray_tpu.tune.schedulers.pbt import (  # noqa: F401
     PopulationBasedTraining,
 )
+from ray_tpu.tune.schedulers.pb2 import PB2  # noqa: F401
 from ray_tpu.tune.schedulers.hyperband import (  # noqa: F401
     HyperBandScheduler,
 )

@@ -121,11 +121,35 @@ def test_cross_process_spmd_matches_single_process(ray_start_regular,
     assert multi[-1] < multi[0]
 
 
+def _build_small_program():
+    """4-device variant for the kill test: fewer gloo channels → far less
+    exposure to the 30s cross-process rendezvous timeout when a loaded
+    1-core host restarts the group (each extra device multiplies the
+    transfer keys both processes must publish in time)."""
+    import jax
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import mesh as mesh_lib, spmd
+    from ray_tpu.parallel.mesh import MeshConfig
+
+    mc = MeshConfig(data=2, fsdp=1, context=1, tensor=2)
+    mesh = mesh_lib.build_mesh(mc, jax.devices()[:4])
+    cfg = gpt2.tiny(vocab=128, seq=32)
+    prog = spmd.build_train_program(
+        loss_fn=lambda p, b: gpt2.loss_fn(p, b, cfg),
+        init_params_fn=lambda rng: gpt2.init_params(rng, cfg),
+        mesh=mesh, mesh_config=mc)
+    toks = (np.arange(8 * 33, dtype=np.int32).reshape(8, 33)
+            % cfg.vocab_size)
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+    return prog, batch
+
+
 def test_worker_death_restarts_group_from_checkpoint(ray_start_regular,
                                                      tmp_path):
     """Kill one process of the domain mid-run: the WHOLE group restarts
     (slice = failure domain) and resumes from the gathered checkpoint."""
-    build = _build_program
+    build = _build_small_program
 
     def loop(config):
         import jax
@@ -151,6 +175,23 @@ def test_worker_death_restarts_group_from_checkpoint(ray_start_regular,
         for step in range(start, 6):
             state, m = prog.step_fn(state, db)
             if sess.attempt == 0 and step == 2 and sess.rank == 1:
+                # Die only after the driver has CONSUMED both ranks'
+                # step-0/1 reports (it deletes report keys on record):
+                # async dispatch lets this rank's Python race ahead of
+                # rank 0's, and an exit before those iterations complete
+                # leaves no checkpoint — a legitimate from-scratch
+                # restart that would make the resume assertions vacuous.
+                import time as _t
+
+                from ray_tpu.experimental import internal_kv as _kv
+                deadline = _t.monotonic() + 120
+                while _t.monotonic() < deadline:
+                    if all(_kv._internal_kv_get(
+                            f"{sess.run_id}/r/{it}/{r}",
+                            namespace="train") is None
+                            for it in (1, 2) for r in (0, 1)):
+                        break
+                    _t.sleep(0.05)
                 os._exit(1)  # simulate a host dropping out of the slice
             host_state = multihost.gather_to_host(state)
             train.report(
@@ -162,23 +203,26 @@ def test_worker_death_restarts_group_from_checkpoint(ray_start_regular,
 
     trainer = JaxTrainer(
         loop,
-        jax_config=JaxConfig(use_distributed=True, local_device_count=4,
+        jax_config=JaxConfig(use_distributed=True, local_device_count=2,
                              init_collective_group=False),
         scaling_config=ScalingConfig(num_workers=2),
+        # budget > 1: on a saturated host the RESTARTED group's gloo
+        # rendezvous can itself time out (XLA's fixed 30s cross-process
+        # key exchange) — that burns an extra restart, which exercises
+        # the same recovery path and must not fail the test
         run_config=RunConfig(storage_path=str(tmp_path),
-                             failure_config=FailureConfig(max_failures=1)))
+                             failure_config=FailureConfig(max_failures=4)))
     result = trainer.fit()
     assert result.error is None, result.error
     hist = result.metrics_history
-    # attempt 0 reported steps 0,1 (rank 1 died at step 2 pre-report);
-    # attempt 1 restored step=2 and reported steps 2..5
     attempts = [m["attempt"] for m in hist]
-    assert 0 in attempts and 1 in attempts, attempts
+    # attempt 0's recorded progress survived, and at least one restart ran
+    assert 0 in attempts, attempts
+    assert attempts[-1] != 0 and len(set(attempts)) >= 2, attempts
     # step continuity: the optimizer step counter increases monotonically
-    # across the restart — proof the restore took effect
+    # ACROSS every restart and finishes the run — a from-scratch restart
+    # would re-run steps and break the sort; a lost checkpoint would
+    # shrink the final count
     steps = [m["state_step"] for m in hist]
     assert steps == sorted(steps), steps
     assert steps[-1] == 6, steps
-    # the restarted attempt resumed from step 2, not from scratch
-    first_a1 = next(m for m in hist if m["attempt"] == 1)
-    assert first_a1["state_step"] == 3, first_a1
