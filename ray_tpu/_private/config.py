@@ -100,6 +100,10 @@ _FLAG_DEFS = [
           "scale-ups and first tasks skip the worker boot; reference: "
           "prestart_worker_first_driver)."),
     _flag("worker_register_timeout_s", 30.0, "Timeout for a spawned worker to register."),
+    _flag("actor_connect_timeout_s", 60.0,
+          "Caller-side wait for a pending actor to come ALIVE before its "
+          "first method call fails (a saturated host spawning a large "
+          "fleet can need more; RTPU_ACTOR_CONNECT_TIMEOUT_S)."),
     _flag("worker_lease_cache", True, "Reuse leased idle workers for same-shape tasks."),
     _flag("worker_pipeline_depth", 4,
           "Same-shape tasks queued on a busy worker's lease (scheduler-"

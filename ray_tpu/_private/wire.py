@@ -16,11 +16,13 @@ transparently: framed and legacy senders interoperate on one socket.
 **Codecs.**  ``codec=1`` is *rtmsg*, a ~100-line tagged binary format for
 the JSON-plus-bytes subset control messages actually use (None/bool/int/
 float/str/bytes/list/tuple/dict).  Decoding rtmsg executes no code — unlike
-pickle — and the format is trivially implementable in any language (that is
-the "polyglot" in the reference's protobuf contract; the schema is the tag
-table below).  ``codec=0`` is pickle, used ONLY when a message smuggles a
-genuinely Python payload (task arg objects, exceptions); the encoder falls
-back automatically, per frame.
+pickle — and the format is demonstrably implementable in any language: the
+C client ``native/src/rtmsg_client.c`` speaks it against a live head
+(tests/test_polyglot_client.py), and ``native/src/wirecodec.c`` implements
+it as a CPython extension at 2.2µs/frame — faster than C pickle — so with
+the native build present EVERY encodable frame rides rtmsg, hot kinds
+included.  ``codec=0`` is pickle, the per-frame fallback for genuinely
+Python payloads (task arg objects, exceptions) and the no-toolchain path.
 
 **Negotiation.**  A client opens at version 0 (legacy), sends a
 ``__proto_hello__`` RPC advertising ``[PROTO_MIN..PROTO_MAX]``; the server

@@ -1603,7 +1603,7 @@ class _ActorChannel:
         self._outstanding: Dict[str, dict] = {}
         self._conn = None
         self._incarnation = -1
-        self._connect(timeout=60.0)
+        self._connect(timeout=GLOBAL_CONFIG.actor_connect_timeout_s)
 
     def _connect(self, timeout: float) -> None:
         deadline = time.monotonic() + timeout

@@ -11,9 +11,13 @@ continuous off-policy family SAC didn't cover (VERDICT r4 missing #7):
   updates), ``target_noise``/``target_noise_clip`` (target policy
   smoothing).
 
-TPU-native shape: actor+critics+targets update in ONE jitted step (the
-policy delay rides ``lax.cond`` on the update counter, so the delayed
-variant is still a single compiled program, not Python branching).
+TPU-native shape: actor+critics+targets update in ONE jitted step.  The
+policy delay is a ``jnp.where`` mask over the candidate actor update
+(the actor grad is computed every step and DISCARDED on non-actor
+steps — compiled-program uniformity traded against ~half an actor
+backward of wasted FLOPs, negligible beside the critic work), so the
+delayed variant is still a single compiled program, not Python
+branching.
 """
 
 from __future__ import annotations
@@ -206,9 +210,10 @@ class DDPG(Algorithm):
                                                     (q1, q2))
             q1, q2 = optax.apply_updates((q1, q2), c_updates)
 
-            # delayed deterministic-policy-gradient actor step: compute
-            # the candidate update, apply it via lax.cond so the delayed
-            # variant stays ONE compiled program
+            # delayed deterministic-policy-gradient actor step: the
+            # candidate grad+update is computed EVERY step and masked in
+            # with jnp.where only on actor steps (uniform program; the
+            # discarded actor backward is cheap beside the critics)
             def actor_loss(ap):
                 return -q_apply(q1, mb[OBS], mu(ap, mb[OBS])).mean()
 

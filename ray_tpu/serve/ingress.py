@@ -64,7 +64,13 @@ class _Route:
         if method != self.method:
             return None
         m = self._re.match(path or "/")
-        return m.groupdict() if m else None
+        if m is None:
+            return None
+        # percent-decode captures: query params arrive decoded (parse_qsl
+        # in Request.from_parts), path params must match — and FastAPI,
+        # which this API mirrors, decodes them too
+        from urllib.parse import unquote
+        return {k: unquote(v) for k, v in m.groupdict().items()}
 
 
 class HTTPApp:
@@ -133,7 +139,15 @@ def _call_handler(fn: Callable, instance: Any, request: Request,
             raise TypeError(
                 f"route handler {fn.__name__}: required parameter "
                 f"{name!r} not found in path or query")
-    return fn(instance, **kwargs)
+    out = fn(instance, **kwargs)
+    if inspect.iscoroutine(out):
+        # async handlers: the ingress __call__ is sync (the replica
+        # dispatches on the METHOD being a coroutine function, and
+        # __call__ isn't one) — drive the coroutine here, blocking this
+        # executor thread exactly like a sync handler would
+        import asyncio
+        return asyncio.run(out)
+    return out
 
 
 def ingress(app: Any) -> Callable[[type], type]:

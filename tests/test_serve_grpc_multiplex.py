@@ -334,3 +334,25 @@ def test_ingress_composes_with_dag_bind(serve_cluster):
     with urllib.request.urlopen(
             f"http://{host}:{port}/c/double/21", timeout=30) as r:
         assert json.loads(r.read()) == {"doubled": 42}
+
+
+def test_ingress_async_handler_and_percent_decoding(serve_cluster):
+    """r5 review fixes: async route handlers are driven to completion,
+    and path params arrive percent-DECODED (query params already do)."""
+    import json
+    import urllib.request
+
+    app = serve.HTTPApp()
+
+    @serve.deployment
+    @serve.ingress(app)
+    class A:
+        @app.get("/echo/{name}")
+        async def echo(self, name, request):
+            return {"name": name, "q": request.query_params.get("q")}
+
+    serve.run(A.bind(), route_prefix="/ad", name="ad")
+    host, port = serve.get_http_address()
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/ad/echo/a%20b?q=c%20d", timeout=30) as r:
+        assert json.loads(r.read()) == {"name": "a b", "q": "c d"}
