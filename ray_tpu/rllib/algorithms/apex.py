@@ -29,7 +29,7 @@ import ray_tpu
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.dqn import DQNConfig, DQNPolicy
 from ray_tpu.rllib.sample_batch import (
-    ACTIONS, NEXT_OBS, OBS, REWARDS, SampleBatch, TERMINATEDS)
+    ACTIONS, NEXT_OBS, OBS, REWARDS, TERMINATEDS)
 
 _REPLAY_KEYS = (OBS, ACTIONS, REWARDS, NEXT_OBS, TERMINATEDS)
 

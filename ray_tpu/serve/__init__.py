@@ -24,6 +24,7 @@ from ray_tpu.serve.multiplex import (  # noqa: F401
     get_multiplexed_model_id, multiplexed,
 )
 from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
+from ray_tpu.serve.drivers import DAGDriver  # noqa: F401
 from ray_tpu.serve.ingress import HTTPApp, ingress  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from ray_tpu.serve.http_util import (Request, Response,  # noqa: F401

@@ -95,15 +95,8 @@ class ProxyActor:
         return self._routes
 
     def _match(self, path: str) -> Optional[tuple]:
-        routes = self._get_routes()
-        best = None
-        for prefix, dep_key in routes.items():
-            if path == prefix or path.startswith(
-                    prefix if prefix.endswith("/") else prefix + "/") \
-                    or prefix == "/":
-                if best is None or len(prefix) > len(best[0]):
-                    best = (prefix, dep_key)
-        return best
+        from ray_tpu.serve.http_util import match_route
+        return match_route(path, self._get_routes())
 
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
         path = req.path.split("?")[0]

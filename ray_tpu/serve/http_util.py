@@ -44,6 +44,21 @@ class Request:
                    body=body)
 
 
+def match_route(path: str, routes: Dict[str, object]):
+    """Longest-prefix route match → (prefix, value) or None.
+
+    Shared by the HTTP proxy's route table and DAGDriver so prefix
+    semantics (exact match, or prefix + "/" boundary, "/" catches all)
+    can never diverge between the two dispatchers."""
+    best = None
+    for prefix, value in routes.items():
+        if prefix == "/" or path == prefix or path.startswith(
+                prefix if prefix.endswith("/") else prefix + "/"):
+            if best is None or len(prefix) > len(best[0]):
+                best = (prefix, value)
+    return best
+
+
 @dataclasses.dataclass
 class Response:
     """Explicit response; any other return value is coerced (see coerce)."""

@@ -170,14 +170,22 @@ def test_async_actor(ray_start_regular):
     class AsyncActor:
         async def slow_echo(self, x):
             import asyncio
+            import time as _t
+            start = _t.monotonic()
             await asyncio.sleep(0.2)
-            return x
+            return (x, start, _t.monotonic())
 
     a = AsyncActor.remote()
-    t0 = time.time()
     refs = [a.slow_echo.remote(i) for i in range(4)]
-    assert ray_tpu.get(refs, timeout=20) == [0, 1, 2, 3]
-    assert time.time() - t0 < 2.0  # ran concurrently
+    out = ray_tpu.get(refs, timeout=30)
+    assert [o[0] for o in out] == [0, 1, 2, 3]
+    # concurrency proof that is load-robust: the four sleeps' execution
+    # INTERVALS must overlap (latest start before earliest end) — true
+    # iff they ran concurrently, regardless of how slow dispatch was;
+    # a wall-clock bound alone could pass fully-serial execution
+    latest_start = max(o[1] for o in out)
+    earliest_end = min(o[2] for o in out)
+    assert latest_start < earliest_end, out
 
 
 def test_exit_actor(ray_start_regular):

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 import ray_tpu
-from ray_tpu.rllib import (A3C, A3CConfig, APPO, APPOConfig, BC, BCConfig,
+from ray_tpu.rllib import (A3C, A3CConfig, APPO, APPOConfig, BCConfig,
                            MARWIL, MARWILConfig, PPO, PPOConfig)
 from ray_tpu.rllib.offline import JsonReader, OfflineData, record_rollouts
 

@@ -356,3 +356,51 @@ def test_ingress_async_handler_and_percent_decoding(serve_cluster):
     with urllib.request.urlopen(
             f"http://{host}:{port}/ad/echo/a%20b?q=c%20d", timeout=30) as r:
         assert json.loads(r.read()) == {"name": "a b", "q": "c d"}
+
+
+def test_dag_driver_routes_and_predict(serve_cluster):
+    """DAGDriver (serve/drivers.py): one ingress fronting several bound
+    sub-graphs — longest-prefix HTTP routing with prefix stripping, plus
+    the non-HTTP predict(route, ...) contract."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    @serve.deployment
+    class Adder:
+        def __call__(self, request_or_x):
+            x = (request_or_x.json()["x"]
+                 if hasattr(request_or_x, "json") else request_or_x)
+            return {"sum": x + 1}
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, request_or_x):
+            x = (request_or_x.json()["x"]
+                 if hasattr(request_or_x, "json") else request_or_x)
+            return {"doubled": x * 2}
+
+    from ray_tpu.serve import DAGDriver
+    serve.run(DAGDriver.bind({"/add": Adder.bind(),
+                              "/double": Doubler.bind()}),
+              route_prefix="/g", name="graph")
+    host, port = serve.get_http_address()
+    base = f"http://{host}:{port}/g"
+
+    req = urllib.request.Request(f"{base}/add", method="POST",
+                                 data=json.dumps({"x": 4}).encode())
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.loads(r.read()) == {"sum": 5}
+    req = urllib.request.Request(f"{base}/double/extra", method="POST",
+                                 data=json.dumps({"x": 4}).encode())
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.loads(r.read()) == {"doubled": 8}
+    try:
+        urllib.request.urlopen(f"{base}/nope", timeout=30)
+        raise AssertionError("404 expected")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    # non-HTTP: predict through a handle
+    h = serve.get_deployment_handle("DAGDriver", "graph")
+    assert h.predict.remote("/add", 10).result() == {"sum": 11}
+    assert h.predict.remote("double", 10).result() == {"doubled": 20}
