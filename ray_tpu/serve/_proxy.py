@@ -19,7 +19,7 @@ from typing import Dict, Optional
 
 from ray_tpu._private import rtlog
 from ray_tpu.serve.handle import DeploymentHandle, get_controller
-from ray_tpu.serve.http_util import Request, coerce_response
+from ray_tpu.serve.http_util import Request, coerce_response, match_route
 
 import ray_tpu
 
@@ -95,7 +95,6 @@ class ProxyActor:
         return self._routes
 
     def _match(self, path: str) -> Optional[tuple]:
-        from ray_tpu.serve.http_util import match_route
         return match_route(path, self._get_routes())
 
     def _handle(self, req: BaseHTTPRequestHandler) -> None:

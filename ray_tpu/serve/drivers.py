@@ -18,31 +18,50 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from ray_tpu.serve.deployment import deployment
-from ray_tpu.serve.http_util import Request, Response
+from ray_tpu.serve.deployment import Deployment
+from ray_tpu.serve.http_util import Request, Response, match_route
+
+# HTTP dispatch bound: matches the proxy's request_timeout_s default so
+# a hung child cannot pin a driver replica slot forever
+_CHILD_TIMEOUT_S = 120.0
 
 
-@deployment
-class DAGDriver:
+def _norm_prefix(prefix: str) -> str:
+    if not prefix.startswith("/"):
+        prefix = "/" + prefix
+    return prefix.rstrip("/") or "/"
+
+
+def _validate_route_table(route_table: Any) -> None:
+    """Raises at BIND time (driver side) — a replica-side failure would
+    only surface as an opaque not-ready deploy timeout."""
+    if not isinstance(route_table, dict) or not route_table:
+        raise TypeError(
+            "DAGDriver.bind takes {route_prefix: bound_app} (a "
+            "non-empty dict)")
+    seen: Dict[str, str] = {}
+    for p in route_table:
+        norm = _norm_prefix(p)
+        if norm in seen:
+            # silent last-wins would deploy the earlier sub-graph but
+            # leave it unroutable — fail loudly instead
+            raise ValueError(
+                f"DAGDriver route prefixes collide after normalization: "
+                f"{seen[norm]!r} and {p!r} -> {norm!r}")
+        seen[norm] = p
+
+
+class _DAGDriverImpl:
     """Route-table ingress over child deployment handles."""
 
     def __init__(self, route_table: Dict[str, Any]):
-        if not isinstance(route_table, dict) or not route_table:
-            raise TypeError(
-                "DAGDriver.bind takes {route_prefix: bound_app} (a "
-                "non-empty dict)")
+        _validate_route_table(route_table)  # defense in depth
         # init args arrive with Application nodes already resolved to
         # DeploymentHandles (HandleMarker resolution in the replica)
-        self._routes = {self._norm(p): h for p, h in route_table.items()}
-
-    @staticmethod
-    def _norm(prefix: str) -> str:
-        if not prefix.startswith("/"):
-            prefix = "/" + prefix
-        return prefix.rstrip("/") or "/"
+        self._routes = {_norm_prefix(p): h
+                        for p, h in route_table.items()}
 
     def _match(self, path: str):
-        from ray_tpu.serve.http_util import match_route
         return match_route(path, self._routes)
 
     def __call__(self, request):
@@ -62,13 +81,27 @@ class DAGDriver:
             method=request.method, path=sub or "/",
             raw_path=request.raw_path, query_params=request.query_params,
             headers=request.headers, body=request.body)
-        return handle.remote(child_req).result()
+        return handle.remote(child_req).result(timeout_s=_CHILD_TIMEOUT_S)
 
     def predict(self, route: str, *args: Any, **kwargs: Any) -> Any:
         """Reference contract: invoke the sub-graph registered at
         ``route`` with raw arguments (non-HTTP path)."""
-        m = self._routes.get(self._norm(route))
+        m = self._routes.get(_norm_prefix(route))
         if m is None:
             raise KeyError(f"no DAG route {route!r} "
                            f"(have {sorted(self._routes)})")
-        return m.remote(*args, **kwargs).result()
+        return m.remote(*args, **kwargs).result(timeout_s=_CHILD_TIMEOUT_S)
+
+
+class _DAGDriverDeployment(Deployment):
+    """Bind-time validation wrapper: route-table mistakes surface as an
+    immediate ValueError/TypeError at ``DAGDriver.bind(...)`` instead of
+    a replica-crash → opaque not-ready deploy timeout."""
+
+    def bind(self, *args: Any, **kwargs: Any):
+        table = args[0] if args else kwargs.get("route_table")
+        _validate_route_table(table)
+        return super().bind(*args, **kwargs)
+
+
+DAGDriver = _DAGDriverDeployment(_DAGDriverImpl, name="DAGDriver")

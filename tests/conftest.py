@@ -37,7 +37,7 @@ from ray_tpu.cluster_utils import Cluster  # noqa: E402
 _time_scale: list = []
 
 
-def time_scale() -> float:
+def time_scale(fresh: bool = False) -> float:
     """Deadline multiplier for wall-clock-sensitive polls (VERDICT r4
     weak #1: a loaded 1-core host needs wider recovery margins).
 
@@ -46,20 +46,31 @@ def time_scale() -> float:
     stretches test deadlines proportionally when the host is contended —
     an idle host keeps ~1× deadlines, a saturated core gets up to 6×.
     Override with ``RTPU_TEST_TIME_SCALE``.
+
+    ``fresh=True`` re-probes NOW instead of using the session-start
+    measurement — for tests whose margin depends on contention at the
+    moment they run (load can arrive mid-session).  A fresh probe never
+    REPLACES the cached session value: a transient lull must not shrink
+    every later test's deadlines.
     """
+    env = os.environ.get("RTPU_TEST_TIME_SCALE")
+    if env:
+        return max(1.0, float(env))
+    if fresh:
+        return _probe_scale()
     if not _time_scale:
-        env = os.environ.get("RTPU_TEST_TIME_SCALE")
-        if env:
-            _time_scale.append(max(1.0, float(env)))
-        else:
-            import time
-            t0 = time.perf_counter()
-            acc = 0
-            for i in range(1_500_000):
-                acc += i * i
-            dt = time.perf_counter() - t0
-            _time_scale.append(min(6.0, max(1.0, dt / 0.2)))
+        _time_scale.append(_probe_scale())
     return _time_scale[0]
+
+
+def _probe_scale() -> float:
+    import time
+    t0 = time.perf_counter()
+    acc = 0
+    for i in range(1_500_000):
+        acc += i * i
+    dt = time.perf_counter() - t0
+    return min(6.0, max(1.0, dt / 0.2))
 
 
 @pytest.fixture

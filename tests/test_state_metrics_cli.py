@@ -174,10 +174,22 @@ def test_stack_dump(ray_start_regular):
         return 1
 
     ref = sleepy.remote()
-    _t.sleep(0.8)  # let it dispatch
-    resp = _wm.global_worker().rpc("stack")
-    assert resp["expected"] >= 1
-    joined = "\n".join(resp["stacks"].values())
+    # poll until the task is actually ON a worker stack: under host
+    # contention dispatch can take seconds, and a dump taken before the
+    # task starts legitimately contains no 'sleepy' frame
+    deadline = _t.monotonic() + 60
+    joined = ""
+    expected = 0
+    while _t.monotonic() < deadline:
+        resp = _wm.global_worker().rpc("stack")
+        # expected==0 just means the worker pool hasn't spawned yet on a
+        # loaded host — keep polling, don't assert mid-spawn
+        expected = max(expected, resp["expected"])
+        joined = "\n".join(resp["stacks"].values())
+        if "sleepy" in joined or "sleep" in joined:
+            break
+        _t.sleep(0.3)
+    assert expected >= 1
     assert "sleepy" in joined or "sleep" in joined
     ray_tpu.cancel(ref)
 
@@ -214,6 +226,11 @@ def test_device_memory_gauges(monkeypatch):
         def memory_stats(self):
             return {"bytes_in_use": 123.0, "bytes_limit": 1000.0}
 
+    # the collector only reads devices from an ALREADY-initialized
+    # backend (it must never pay PJRT init itself) — initialize the CPU
+    # backend so this test passes standalone, not only after other
+    # jax-touching tests in the same session
+    jax.devices()
     monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
     out = metrics_lib.device_memory_gauges()
     s = out["rtpu_device_hbm_bytes_in_use"]["series"][0]
