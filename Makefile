@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test sanitize fuzz bench lint
+.PHONY: test sanitize fuzz bench lint check-metrics
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -11,6 +11,12 @@ test:
 # stand-in — ast checks for Python, g++ -fsyntax-only -Wall for C++.
 lint:
 	$(PY) tools/lint.py
+	$(PY) tools/check_metrics_catalog.py
+
+# Every built-in rtpu_* metric used in the tree must be declared in
+# ray_tpu/util/metrics_catalog.py (also runs as part of `make lint`).
+check-metrics:
+	$(PY) tools/check_metrics_catalog.py
 
 # ASAN + TSAN over the native slab store (SURVEY.md §5.2): longer runs
 # than the in-suite smoke (tests/test_native_sanitizers.py).

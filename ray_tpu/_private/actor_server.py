@@ -19,6 +19,7 @@ import time
 from typing import Any, List, Optional
 
 from ray_tpu._private import protocol, rtlog
+from ray_tpu.util import metrics_catalog as mcat
 from ray_tpu.util import tracing
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.serialization import serialize_to_bytes
@@ -171,8 +172,25 @@ class ActorServer:
         asyncio.get_running_loop().run_in_executor(
             None, self._complete_async_call, conn, msg, value, err)
 
+    def _observe_call(self, msg: dict, t0: Optional[float]) -> None:
+        """Actor methods feed the same exec histogram as plain tasks,
+        tagged ``Class.method`` — one series family for 'where did the
+        worker's time go' across both execution paths.  Control-plane
+        methods (``__ray_ready__``, ``__ray_terminate__``, ...) are
+        excluded: their durations measure bring-up/teardown round-trips,
+        not user work, and would add a control series per class."""
+        method = msg.get("method", "?")
+        if t0 is None or method.startswith("__ray_") \
+                or not GLOBAL_CONFIG.metrics_enabled:
+            return
+        mcat.get("rtpu_task_exec_seconds").observe(
+            time.monotonic() - t0,
+            tags={"name": f"{self.spec.get('class_name', 'Actor')}."
+                          f"{method}"})
+
     def _complete_async_call(self, conn, msg, value, err) -> None:
         return_ids: List[str] = msg["return_ids"]
+        self._observe_call(msg, msg.pop("_exec_t0", None))
         w = self.worker
         try:
             if err is None:
@@ -225,6 +243,7 @@ class ActorServer:
                     return
             except (OSError, EOFError):
                 pass  # control plane hiccup: at-least-once fallback
+        t_exec = time.monotonic()
         try:
             args, kwargs = w._unpack_args(msg)
             method_name = msg["method"]
@@ -232,6 +251,7 @@ class ActorServer:
                     "__ray_terminate__", "__ray_ready__", "__ray_apply__"):
                 method = getattr(self.instance, method_name, None)
                 if method is not None and inspect.iscoroutinefunction(method):
+                    msg["_exec_t0"] = t_exec
                     asyncio.run_coroutine_threadsafe(
                         self._run_async_call(method, args, kwargs, conn, msg),
                         self._loop)
@@ -274,6 +294,7 @@ class ActorServer:
             err_res = {"loc": "error", "data": serialize_to_bytes(err)[0]}
             results = [err_res for _ in return_ids]
             ok = False
+        self._observe_call(msg, t_exec)
         self._seal_and_reply(conn, msg, results, ok)
 
     def _seal_and_reply(self, conn, msg: dict, results: List[dict], ok: bool) -> None:

@@ -137,7 +137,16 @@ _FLAG_DEFS = [
           "version-skew guard the reference gets from protobuf/gRPC "
           "(src/ray/protobuf/).  See _private/wire.py."),
     # --- metrics / tracing ---------------------------------------------------
-    _flag("metrics_export_period_s", 5.0, "Metrics agent export period."),
+    _flag("metrics_enabled", True,
+          "Always-on metrics plane: every non-client ray_tpu process runs "
+          "a background publisher thread pushing its metric registry "
+          "snapshot to the GCS KV, so `/metrics` and `ray_tpu metrics` "
+          "show live built-in series with zero user wiring.  False "
+          "disables both the publisher and built-in instrumentation "
+          "(metrics.publish() still works manually)."),
+    _flag("metrics_export_period_s", 5.0,
+          "Background metrics publisher period (jittered per cycle; "
+          "clamped to >= 1s so publishing stays off the task hot path)."),
     _flag("timeline_enabled", True, "Record profile events for `ray_tpu timeline`."),
 ]
 

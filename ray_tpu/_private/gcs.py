@@ -41,6 +41,8 @@ from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.serialization import dumps_call
 from ray_tpu._private.session import Session
 from ray_tpu._private.shm_store import ShmObjectStore
+from ray_tpu.util import metrics_catalog as mcat
+from ray_tpu.util.metrics import is_metrics_key
 from ray_tpu import exceptions as exc
 
 logger = rtlog.get("gcs")
@@ -214,6 +216,11 @@ class GcsServer:
         self._staging: Dict[str, dict] = {}   # in-flight chunked uploads
         self._remote_pulls: Dict[str, threading.Event] = {}  # relay dedup
         self._graceful_free: Dict[str, float] = {}  # rc-0-at-seal grace
+        self._last_metrics_sweep = 0.0        # dead-snapshot KV hygiene
+        # head-side receipt time per __metrics__/ key: the sweep's grace
+        # window must not trust publisher-host wall clocks (cross-host
+        # skew > grace would reap a dying worker's final flush instantly)
+        self._metrics_key_seen: Dict[str, float] = {}
         # reply cache for client-supplied request ids: makes the worker's
         # one post-reconnect retry exactly-once against a still-live GCS
         # (non-idempotent mutations must not double-apply when only the
@@ -314,7 +321,13 @@ class GcsServer:
         with self._persist_lock:
             with self.lock:
                 state = {
-                    "kv": {ns: dict(t) for ns, t in self.kv.items()},
+                    # __metrics__/ snapshots are ephemeral telemetry: a
+                    # restored head must not resurrect dead workers'
+                    # series, and busy-cluster snapshots must not grow by
+                    # one metrics payload per worker
+                    "kv": {ns: {k: v for k, v in t.items()
+                                if not is_metrics_key(k)}
+                           for ns, t in self.kv.items()},
                     "functions": dict(self.functions),
                     "named_actors": dict(self.named_actors),
                     "actors": {
@@ -362,7 +375,13 @@ class GcsServer:
             (pid, PgState(pid, rec["bundles"], rec["strategy"],
                           rec["name"]))
             for pid, rec in state["pgs"].items()]
-        kv_tables = {ns: dict(t) for ns, t in state["kv"].items()}
+        # strip metrics keys defensively: current snapshots never contain
+        # them, but a pre-exemption snapshot must not resurrect dead
+        # publishers' series (and such keys would be invisible to the
+        # sweep's receipt index)
+        kv_tables = {ns: {k: v for k, v in t.items()
+                          if not is_metrics_key(k)}
+                     for ns, t in state["kv"].items()}
         functions = dict(state["functions"])
         named = dict(state["named_actors"])
         # only segments this snapshot knows about — a host-global scan
@@ -867,14 +886,39 @@ class GcsServer:
         strand it."""
         spec.pop("_prepushed", None)
         spec.pop("_dseq", None)
+        # setdefault: a pump-miss requeue continues the same wait; only a
+        # spec that actually DISPATCHED (stamp popped by
+        # _observe_queue_latency) restarts the clock on re-entry (retry,
+        # worker-death reschedule, actor restart)
+        spec.setdefault("_enqueued_at", time.monotonic())
         self._pending_counts[self._spec_class(spec)] += 1
         self.pending_tasks.append(spec)
 
     def _push_pending_left(self, spec: dict) -> None:
         spec.pop("_prepushed", None)
         spec.pop("_dseq", None)
+        # setdefault: a scan-skip requeue (_take_matching_pending's
+        # non-matches) continues the same wait.  A requeue AFTER an
+        # observed dispatch that never executed (handoff push to a
+        # freshly-dead worker) restarts the clock — one logical wait
+        # then shows as two shorter samples, an accepted bias during
+        # worker churn (the alternative, carrying un-observation state,
+        # isn't worth it for a histogram).
+        spec.setdefault("_enqueued_at", time.monotonic())
         self._pending_counts[self._spec_class(spec)] += 1
         self.pending_tasks.appendleft(spec)
+
+    def _observe_queue_latency(self, spec: dict) -> None:
+        """A spec is leaving the scheduler queue for a worker: record the
+        submit->dispatch wait (rtpu_task_queue_seconds).  pop: a retried
+        or resubmitted spec re-enters the queue and re-measures."""
+        t = spec.pop("_enqueued_at", None)
+        if t is None or not GLOBAL_CONFIG.metrics_enabled:
+            return
+        mcat.get("rtpu_task_queue_seconds").observe(
+            time.monotonic() - t,
+            tags={"name": spec.get("name") or spec.get("class_name")
+                  or "task"})
 
     def _pop_pending(self) -> dict:
         spec = self.pending_tasks.popleft()
@@ -1030,6 +1074,11 @@ class GcsServer:
                             and not spec.get("is_actor_creation"):
                         tgt = self._piggyback_worker(node, req, need_tpu)
                         if tgt is not None:
+                            # leaving the queue for a worker's pipeline:
+                            # observe now, or a later retry would inherit
+                            # the stale stamp and record submit-to-
+                            # SECOND-dispatch as queue wait
+                            self._observe_queue_latency(spec)
                             tgt.pipeline.append(spec)
                             progressed = True
                             misses = 0
@@ -1048,6 +1097,7 @@ class GcsServer:
                 spec["_req"] = req
                 spec["_node"] = node.node_id
                 spec["_started_at"] = time.monotonic()
+                self._observe_queue_latency(spec)
                 worker.state = "busy"
                 worker.current_task = spec
                 self.running[spec["task_id"]] = (worker.worker_id, spec)
@@ -1113,8 +1163,17 @@ class GcsServer:
         for dep in list(spec.get("deps", ())) + list(spec.get("borrows", ())):
             self._decref(dep)
 
+    @staticmethod
+    def _count_task_terminal(state: str) -> None:
+        """rtpu_tasks_total: counted HERE (the one authority on terminal
+        task states) so worker- and owner-side views can never double
+        count."""
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_tasks_total").inc(tags={"state": state})
+
     def _fail_task_with_dep_error(self, spec: dict, dep_oid: str) -> None:
         dep_meta = self.objects[dep_oid]
+        self._count_task_terminal("dep_error")
         for oid in spec["return_ids"]:
             self._seal_error(oid, dep_meta.data)
         if spec.get("is_actor_creation"):
@@ -1130,6 +1189,11 @@ class GcsServer:
 
     def _fail_task(self, spec: dict, err: BaseException) -> None:
         from ray_tpu._private.serialization import serialize_to_bytes
+        # user-initiated cancellation is not a system failure — it must
+        # not move an operator's sys_error alert rate
+        self._count_task_terminal(
+            "cancelled" if isinstance(err, exc.TaskCancelledError)
+            else "sys_error")
         data = serialize_to_bytes(err)[0]
         for oid in spec["return_ids"]:
             self._seal_error(oid, data)
@@ -1216,6 +1280,9 @@ class GcsServer:
             respec["attempt"] = respec.get("attempt", 0) + 1
             a.spec = respec
             self._push_pending(respec)
+            if GLOBAL_CONFIG.metrics_enabled:
+                mcat.get("rtpu_actor_restarts_total").inc(
+                    tags={"class": respec.get("class_name", "Actor")})
             logger.info("restarting actor %s (incarnation %d)", actor_id, a.incarnation)
         else:
             a.state = A_DEAD
@@ -1226,6 +1293,36 @@ class GcsServer:
         # head restart doesn't resurrect a dead actor or reset its budget
         # (just sets the writer thread's event; safe under cv)
         self._persist_durable()
+
+    def _sweep_dead_metrics(self) -> None:
+        """Bound the ``__metrics__/`` KV plane without needing a reader:
+        collect_cluster() reaps on scrape, but an unscraped cluster
+        churning workers must not accumulate one snapshot per dead
+        process forever.  Dead publishers' snapshots survive the same
+        grace window the collector honors (their shutdown flush stays
+        readable), then go.  Ages by HEAD-side receipt time
+        (_metrics_key_seen), not the payload's publisher-host wall clock
+        — cross-host skew larger than the grace must not reap a dying
+        worker's final flush instantly."""
+        from ray_tpu.util.metrics import DEAD_SNAPSHOT_GRACE_S
+        with self.lock:
+            ns = self.kv.get("default")
+            if not ns:
+                return
+            live = {w.worker_id for w in self.workers.values()
+                    if w.state != "dead"}
+            now = time.monotonic()
+            # iterate the receipt index, not the namespace: the sweep
+            # must cost O(#publishers), not an O(|kv|) scan under the
+            # global lock every minute (every metrics key passes through
+            # _h_kv_put, and restores strip the prefix, so the index is
+            # complete)
+            for key, seen in list(self._metrics_key_seen.items()):
+                if key.split("/", 1)[1] in live:
+                    continue
+                if now - seen > DEAD_SNAPSHOT_GRACE_S:
+                    ns.pop(key, None)
+                    self._metrics_key_seen.pop(key, None)
 
     def _monitor_loop(self) -> None:
         from ray_tpu._private.memory_monitor import MemoryMonitor
@@ -1267,6 +1364,18 @@ class GcsServer:
                         logger.warning("worker %s pid=%s exited", w.worker_id, w.pid)
                         self._handle_worker_death(w)
                 self._pump()
+            # reap dead publishers' stale metrics snapshots server-side:
+            # collect_cluster() reaps on read, but a cluster nobody
+            # scrapes must not accumulate one KV snapshot per dead
+            # process forever (they are excluded from durable
+            # persistence, so nothing else bounds them)
+            now = time.monotonic()
+            if now - self._last_metrics_sweep > 60.0:
+                self._last_metrics_sweep = now
+                try:
+                    self._sweep_dead_metrics()
+                except Exception:  # noqa: BLE001 - telemetry hygiene only
+                    logger.exception("metrics snapshot sweep failed")
             # purge chunked uploads abandoned by a dead uploader
             with self.lock:
                 now = time.time()
@@ -1659,6 +1768,9 @@ class GcsServer:
             skipped.append(spec)
         for spec in reversed(skipped):
             self._push_pending_left(spec)
+        if found is not None:
+            # lease inheritance / prepush: the spec leaves the queue here
+            self._observe_queue_latency(found)
         return found
 
     def _on_task_done(self, worker_id: str, msg: dict) -> None:
@@ -1727,6 +1839,7 @@ class GcsServer:
                     for tid in [t for t in self.lineage if t not in live]:
                         self.lineage.pop(tid, None)
                 self._release_deps(spec)
+                self._count_task_terminal("ok")
             elif msg["status"] == "app_error":
                 retries = spec.get("max_retries", 0) if spec.get("retry_exceptions") \
                     else 0
@@ -1738,6 +1851,7 @@ class GcsServer:
                     for oid in spec["return_ids"]:
                         self._seal_error(oid, msg["error"])
                     self._release_deps(spec)
+                    self._count_task_terminal("app_error")
             # next leased task, or worker back to pool
             if nxt is not None and w.state == "busy" \
                     and nxt.pop("_prepushed", None):
@@ -1796,6 +1910,9 @@ class GcsServer:
                 return
             self.running.pop(a.spec["task_id"], None)
             if msg["status"] == "ok":
+                # creation task reached its terminal state: count it, or
+                # the ok/error ratio under-reports actor-heavy workloads
+                self._count_task_terminal("ok")
                 a.state = A_ALIVE
                 a.worker_id = worker_id
                 a.addr = msg["addr"]
@@ -2421,12 +2538,32 @@ class GcsServer:
             return {"blob": self.functions[msg["fn_id"]]}
 
     def _h_kv_put(self, msg: dict) -> dict:
+        if is_metrics_key(msg["key"]) and \
+                (msg.get("namespace", "default") != "default"
+                 or msg["key"] != f"__metrics__/{msg.get('client_id')}"):
+            # reserved prefix IN EVERY NAMESPACE: metrics snapshots are
+            # non-durable (the persistence filter is namespace-blind) and
+            # swept ~2min after their publisher dies — silently vacuuming
+            # a USER's key that happened to collide would be data loss.
+            # Each process may only write its own snapshot key, and only
+            # in the default namespace the publisher/sweep operate on.
+            raise ValueError(
+                "the '__metrics__/' KV prefix is reserved for metric "
+                "snapshot publishing (ephemeral, auto-reaped); store "
+                "application data under a different key")
         with self.lock:
             ns = self.kv[msg.get("namespace", "default")]
             existed = msg["key"] in ns
             if not (msg.get("overwrite", True) is False and existed):
                 ns[msg["key"]] = msg["value"]
-        self._persist_durable()
+        if not is_metrics_key(msg["key"]):
+            # telemetry snapshots are ephemeral by design (re-published
+            # every period, reaped when the publisher dies) — every
+            # process's publisher dirtying the durable snapshot each
+            # cycle would turn steady-state idle into constant disk churn
+            self._persist_durable()
+        else:
+            self._metrics_key_seen[msg["key"]] = time.monotonic()
         return {"existed": existed}
 
     def _h_kv_get(self, msg: dict) -> dict:
@@ -2437,8 +2574,26 @@ class GcsServer:
         with self.lock:
             existed = self.kv[msg.get("namespace", "default")].pop(msg["key"], None)
         if existed is not None:
-            self._persist_durable()
+            if is_metrics_key(msg["key"]):
+                self._metrics_key_seen.pop(msg["key"], None)
+            else:
+                # same ephemeral-telemetry exemption as _h_kv_put:
+                # metrics keys are excluded from the snapshot, so reaping
+                # one must not rewrite the durable state for nothing
+                self._persist_durable()
         return {"deleted": existed is not None}
+
+    def _h_kv_mget(self, msg: dict) -> dict:
+        """Batched prefix read: every (key, value) under a prefix in ONE
+        round trip.  The metrics collector scrapes N publishers'
+        snapshots per /metrics hit — N serial kv_get RPCs would make
+        scrape latency and head load linear in fleet size."""
+        pref = msg["prefix"]
+        with self.lock:
+            ns = self.kv[msg.get("namespace", "default")]
+            return {"entries": {k: v for k, v in ns.items()
+                                if isinstance(k, type(pref))
+                                and k.startswith(pref)}}
 
     def _h_kv_keys(self, msg: dict) -> dict:
         with self.lock:

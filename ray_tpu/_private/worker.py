@@ -35,6 +35,7 @@ from ray_tpu._private.serialization import (
 )
 from ray_tpu._private.session import Session
 from ray_tpu._private.shm_store import ShmObjectStore
+from ray_tpu.util import metrics_catalog as mcat
 from ray_tpu.util import tracing
 from ray_tpu import exceptions as exc
 
@@ -244,6 +245,60 @@ class Worker:
         self.node_id = info["node_id"]
         if self._gcs_epoch is None:
             self._gcs_epoch = info.get("epoch")
+        self._start_metrics_publisher()
+
+    # ------------------------------------------------------ metrics publisher
+    def _start_metrics_publisher(self) -> None:
+        """Always-on telemetry (reference: the per-node metrics agent's
+        export loop): a daemon thread pushes this process's metric
+        registry to the GCS KV every ``metrics_export_period_s`` so
+        `/metrics` and `ray_tpu metrics` show live data with zero user
+        wiring.  Off the task hot path by construction — one kv_put per
+        period (>= 1s), nothing per task.  Clients skip it: they have no
+        built-in instrumentation and every publish would tunnel through
+        the head proxy."""
+        if self.is_client or not GLOBAL_CONFIG.metrics_enabled:
+            return
+        threading.Thread(target=self._metrics_publish_loop,
+                         name="metrics-publisher", daemon=True).start()
+
+    def _metrics_publish_loop(self) -> None:
+        import random
+
+        from ray_tpu.util import metrics as metrics_mod
+        period = max(1.0, GLOBAL_CONFIG.metrics_export_period_s)
+        err_logged = False
+        # jittered: a fleet of workers forked together must not land
+        # synchronized kv_puts on the head every period
+        while not self._stop.wait(period * random.uniform(0.75, 1.25)):
+            try:
+                metrics_mod.publish(self)
+                err_logged = False
+            except Exception:  # noqa: BLE001 - head restarting / shutting
+                # down: telemetry must never take a process with it; the
+                # next cycle retries against the healed control plane.
+                # Logged (once per failure streak) because the cause may
+                # be PERSISTENT — e.g. a user metric whose tag value
+                # json.dumps can't serialize — and a silently dark
+                # process is undiagnosable.
+                if self._stop.is_set():
+                    return
+                if not err_logged:
+                    err_logged = True
+                    logger.warning("metrics publish failed (will keep "
+                                   "retrying every %.0fs)", period,
+                                   exc_info=True)
+
+    def _final_metrics_flush(self) -> None:
+        """One last publish on clean shutdown so short-lived processes'
+        series (e.g. a task worker that just finished) are visible."""
+        if self.is_client or not GLOBAL_CONFIG.metrics_enabled:
+            return
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+            metrics_mod.publish(self)
+        except Exception:  # noqa: BLE001 - control plane already gone
+            pass
 
     # ------------------------------------------------------------- plumbing
     def _on_new_channel(self, ch: protocol.RpcChannel) -> None:
@@ -292,7 +347,7 @@ class Worker:
             return srv
         return None
 
-    def rpc(self, kind: str, **fields: Any) -> dict:
+    def rpc(self, kind: str, _reconnect: bool = True, **fields: Any) -> dict:
         # Two-way calls observe prior submits (FIFO illusion): flush the
         # submit batch first — e.g. a get_meta on a buffered task's return
         # must find the task registered.
@@ -312,8 +367,11 @@ class Worker:
         except (EOFError, OSError, ConnectionError):
             # GCS conn lost (head crash/restart).  Reconnect with grace and
             # re-issue ONCE (reference: retryable gRPC clients + raylets
-            # reconnecting to a restarted GCS).
-            if self.is_client or self._stop.is_set():
+            # reconnecting to a restarted GCS).  _reconnect=False callers
+            # (best-effort telemetry) must never drive the heal themselves:
+            # a background pool.invalidate() can yank a channel the MAIN
+            # thread's reconnect dance just re-established.
+            if self.is_client or self._stop.is_set() or not _reconnect:
                 raise
             self._reconnect_pool()
             return self.pool.call(kind, client_id=self.worker_id, **fields)
@@ -441,6 +499,8 @@ class Worker:
         oid = ObjectID.make(self.worker_id, _owner_kind, self._put_seq())
         pickled, buffers, refs = serialize(value)
         size = serialized_size(pickled, buffers)
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_object_store_put_bytes").inc(size)
         contained = [str(r.id) for r in refs]
         slab = self.slab
         tiny = size <= GLOBAL_CONFIG.inline_object_max_bytes or \
@@ -473,6 +533,18 @@ class Worker:
         return ObjectRef(str(oid), worker=self)
 
     def _materialize(self, oid: str, meta: dict) -> Any:
+        value = self._materialize_value(oid, meta)
+        # counted AFTER the bytes were actually obtained: a failed fetch
+        # (or a slab-miss retry re-entering here) must not inflate the
+        # counter with bytes that were never delivered
+        if GLOBAL_CONFIG.metrics_enabled:
+            size = meta.get("size") or (len(meta["data"])
+                                        if meta.get("data") is not None else 0)
+            if size:
+                mcat.get("rtpu_object_store_get_bytes").inc(size)
+        return value
+
+    def _materialize_value(self, oid: str, meta: dict) -> Any:
         if meta["state"] == "error":
             err = deserialize_from(memoryview(meta["data"]))
             raise err
@@ -1269,7 +1341,11 @@ class Worker:
     # -------------------------------------------------------------- shutdown
     def shutdown(self) -> None:
         self._flush_releases(all_threads=True)
+        # _stop first: with it set, rpc() raises instead of entering the
+        # 30s reconnect grace — a dead head must not stall shutdown for a
+        # best-effort telemetry flush
         self._stop.set()
+        self._final_metrics_flush()
         with self._actor_chan_lock:
             for ch in self._actor_channels.values():
                 ch.close()
@@ -1385,6 +1461,7 @@ class Worker:
                     self._execute_task(spec)
             elif msg["kind"] == "create_actor":
                 self._become_actor(msg["spec"], tasks)
+        self._final_metrics_flush()
         sys.exit(0)
 
     def _cancel_current(self, task_id: str) -> None:
@@ -1492,7 +1569,8 @@ class Worker:
         renv.restore(saved)
 
     def _execute_task(self, spec: dict) -> None:
-        t0 = time.time()
+        t0 = time.time()          # wall clock: timeline events
+        t0m = time.monotonic()    # monotonic: latency metric (NTP-safe)
         self._current_spec = spec
         self.ctx.in_task = True
         self.ctx.task_id = spec["task_id"]
@@ -1537,6 +1615,10 @@ class Worker:
             self.ctx.task_id = None
             if task_span is not None:
                 tracing._set_span(None)
+            if GLOBAL_CONFIG.metrics_enabled:
+                mcat.get("rtpu_task_exec_seconds").observe(
+                    time.monotonic() - t0m,
+                    tags={"name": spec.get("name", "task")})
             if GLOBAL_CONFIG.timeline_enabled:
                 ev = {"name": spec.get("name", "task"), "cat": "task",
                       "ph": "X", "pid": self.node_id, "tid": os.getpid(),

@@ -45,7 +45,10 @@ INDEX_HTML = """<!doctype html>
 <body>
 <header><h1>ray_tpu</h1>
   <span class="sub" id="session"></span>
-  <span class="sub" id="updated"></span></header>
+  <span class="sub" id="updated"></span>
+  <span class="sub" style="margin-left:auto">
+    <a href="/metrics">metrics</a> &middot;
+    <a href="/api/timeline">timeline</a></span></header>
 <div class="tiles" id="tiles"></div>
 <nav id="tabs"></nav>
 <main id="table"></main>

@@ -18,12 +18,29 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from ray_tpu._private import rtlog
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu.serve.handle import DeploymentHandle, get_controller
 from ray_tpu.serve.http_util import Request, coerce_response, match_route
+from ray_tpu.util import metrics_catalog as mcat
 
 import ray_tpu
 
 logger = rtlog.get("serve.proxy")
+
+
+def _observe_request(dep_key: str, status: int, t0: float) -> None:
+    """Per-deployment data-plane series (reference: Serve's
+    ``serve_deployment_request_counter`` / ``_processing_latency_ms``):
+    recorded at the proxy so every HTTP outcome — success, timeout,
+    no-replica 503, user 500 — lands in the same histogram."""
+    if not GLOBAL_CONFIG.metrics_enabled:
+        return
+    mcat.get("rtpu_serve_request_latency_seconds").observe(
+        time.monotonic() - t0, tags={"deployment": dep_key})
+    mcat.get("rtpu_serve_requests_total").inc(
+        tags={"deployment": dep_key, "code": str(status)})
+    if status >= 500:
+        mcat.get("rtpu_serve_errors_total").inc(tags={"deployment": dep_key})
 
 
 class ProxyActor:
@@ -119,10 +136,10 @@ class ProxyActor:
         # reference header contract: serve_multiplexed_model_id routes to
         # a replica already holding that model (multiplex.py)
         model_id = req.headers.get("serve_multiplexed_model_id", "")
+        start = time.monotonic()
         try:
             # The configured request timeout bounds BOTH phases: waiting
             # for a replica (assign) and waiting for the result.
-            start = time.monotonic()
             resp_f = handle._router().assign(
                 "__call__", (request,), {}, timeout_s=self._timeout,
                 multiplexed_model_id=model_id)
@@ -132,18 +149,24 @@ class ProxyActor:
             result = ray_tpu.get(resp_f._to_object_ref(),
                                  timeout=remaining)
         except ray_tpu.exceptions.GetTimeoutError:
+            _observe_request(dep_key, 408, start)
             self._respond(req, 408, b"request timed out", "text/plain")
             return
         except ray_tpu.exceptions.RayServeError as e:
+            _observe_request(dep_key, 503, start)
             self._respond(req, 503, str(e).encode(), "text/plain")
             return
         except Exception as e:  # noqa: BLE001 - user code raised
+            _observe_request(dep_key, 500, start)
             self._respond(req, 500, str(e).encode(), "text/plain")
             return
         if isinstance(result, dict) and "__serve_stream__" in result:
+            # streaming: the latency series records time-to-first-byte
+            _observe_request(dep_key, result.get("status", 200), start)
             self._respond_stream(req, result, resp_f)
             return
         resp = coerce_response(result)
+        _observe_request(dep_key, resp.status_code, start)
         self._respond(req, resp.status_code, resp.body, resp.content_type)
 
     @staticmethod

@@ -54,9 +54,37 @@ class Replica:
         init_kwargs = _resolve_markers(dict(init_kwargs))
         self._streams: Dict[str, Tuple[Any, float]] = {}
         self._streams_lock = threading.Lock()
+        self._ongoing = 0
+        self._ongoing_lock = threading.Lock()
         self._instance = user_cls(*init_args, **init_kwargs)
 
+    def _track_ongoing(self, delta: int) -> None:
+        """rtpu_serve_ongoing_requests: requests executing inside THIS
+        replica right now (reference: ``serve_replica_processing_queries``).
+        The replica process's background publisher ships it — the
+        controller's autoscaler signal stays handle-reported and
+        unchanged."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu.util import metrics_catalog as mcat
+        with self._ongoing_lock:
+            # gauge set INSIDE the lock: counter update and publication
+            # must be atomic, or a delayed set() from a finished request
+            # can overwrite a newer value and stick the gauge wrong until
+            # the next request
+            self._ongoing += delta
+            if GLOBAL_CONFIG.metrics_enabled:
+                mcat.get("rtpu_serve_ongoing_requests").set(
+                    self._ongoing, tags={"deployment": self._dep_key,
+                                         "replica": self._replica_tag})
+
     def handle_request(self, method: str, args: Tuple, kwargs: Dict):
+        self._track_ongoing(1)
+        try:
+            return self._handle_request(method, args, kwargs)
+        finally:
+            self._track_ongoing(-1)
+
+    def _handle_request(self, method: str, args: Tuple, kwargs: Dict):
         import ray_tpu
         from ray_tpu._private.object_ref import ObjectRef
 
@@ -126,15 +154,33 @@ class Replica:
             return result
         import time as _time
         import uuid
+        self._reap_abandoned_streams()
         sid = uuid.uuid4().hex
         with self._streams_lock:
-            # reap streams abandoned by disconnected clients — pop under
-            # the lock, close OUTSIDE it (a generator finally can block;
-            # it must not stall every concurrent stream on the replica)
+            self._streams[sid] = (it, _time.time(), model_id)
+        # a live stream IS an ongoing request: the generator body runs
+        # during later stream_next pulls, after handle_request's finally
+        # already decremented — re-count it until the stream completes
+        # (stream_next done / cancel / abandoned-reap)
+        self._track_ongoing(1)
+        return {"__serve_stream__": sid, "status": status,
+                "content_type": ctype}
+
+    def _reap_abandoned_streams(self, max_age_s: float = 600.0) -> None:
+        """Drop streams whose client vanished without draining or
+        cancelling — pop under the lock, close OUTSIDE it (a generator
+        finally can block; it must not stall every concurrent stream on
+        the replica).  Runs on every new stream registration AND from the
+        controller's periodic check_health, so an idle replica's
+        ongoing-request gauge cannot stay stuck on a phantom stream."""
+        import time as _time
+        with self._streams_lock:
             now = _time.time()
             reaped = [self._streams.pop(s) for s, entry in
-                      list(self._streams.items()) if now - entry[1] > 600]
-            self._streams[sid] = (it, now, model_id)
+                      list(self._streams.items())
+                      if now - entry[1] > max_age_s]
+        if reaped:
+            self._track_ongoing(-len(reaped))
         for entry in reaped:
             close = getattr(entry[0], "close", None)
             if close is not None:
@@ -142,8 +188,6 @@ class Replica:
                     close()
                 except Exception:  # noqa: BLE001 - user finally raised
                     pass
-        return {"__serve_stream__": sid, "status": status,
-                "content_type": ctype}
 
     def _drive_asyncgen(self, agen, model_id: str = ""):
         from ray_tpu.serve import multiplex as _mux
@@ -196,11 +240,14 @@ class Replica:
                     break
         finally:
             _mux._current_model_id.reset(token)
+        popped = None
         with self._streams_lock:
             if done:
-                self._streams.pop(sid, None)
+                popped = self._streams.pop(sid, None)
             elif sid in self._streams:
                 self._streams[sid] = (it, _time.time(), model_id)
+        if popped is not None:
+            self._track_ongoing(-1)  # stream drained: no longer ongoing
         return chunks, done
 
     def stream_cancel(self, sid: str) -> bool:
@@ -212,6 +259,7 @@ class Replica:
             entry = self._streams.pop(sid, None)
         if entry is None:
             return False
+        self._track_ongoing(-1)  # cancelled stream: no longer ongoing
         it = entry[0]
         close = getattr(it, "close", None)
         if close is not None:
@@ -222,6 +270,7 @@ class Replica:
         return True
 
     def check_health(self) -> bool:
+        self._reap_abandoned_streams()  # periodic gauge/stream hygiene
         chk = getattr(self._instance, "check_health", None)
         if chk is not None:
             chk()

@@ -22,8 +22,10 @@ from typing import Dict, List, Optional
 
 import ray_tpu
 from ray_tpu._private import rtlog
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu.serve._replica import Replica
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.util import metrics_catalog as mcat
 
 logger = rtlog.get("serve.controller")
 
@@ -118,6 +120,12 @@ class ServeController:
         st = self._deployments.pop(key, None)
         if st is None:
             return
+        # drop the deployment's gauge series: _autoscale_tick never runs
+        # for it again, so the last value would otherwise be republished
+        # by this long-lived controller forever (phantom deployment
+        # "wanting" replicas on the dashboard)
+        mcat.get("rtpu_serve_autoscaler_desired_replicas").remove_series(
+            tags={"deployment": key})
         now = time.monotonic()
         for rs in list(st.replicas.values()):
             self._retire(st, rs, now, grace=0.0)
@@ -221,6 +229,17 @@ class ServeController:
                 logger.exception("serve control loop error")
 
     def _autoscale_tick(self, st: _DeploymentState, now: float) -> None:
+        try:
+            self._do_autoscale_tick(st, now)
+        finally:
+            if GLOBAL_CONFIG.metrics_enabled:
+                # the decision gauge makes scaling behavior inspectable:
+                # target-vs-ready divergence on the dashboard IS the
+                # autoscaler acting (or stuck)
+                mcat.get("rtpu_serve_autoscaler_desired_replicas").set(
+                    st.target, tags={"deployment": st.key})
+
+    def _do_autoscale_tick(self, st: _DeploymentState, now: float) -> None:
         ac: Optional[AutoscalingConfig] = st.config.autoscaling_config
         if ac is None:
             st.target = st.config.num_replicas

@@ -121,6 +121,7 @@ class Router:
         self._model_affinity: "OrderedDict[str, List[str]]" = OrderedDict()
         self._pending = 0        # waiting in assign() — autoscale signal too
         self._max_ongoing = 0    # 0 = unknown/unbounded
+        self._deployment_gone = False  # controller no longer knows the key
         self._version = -1
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -237,7 +238,15 @@ class Router:
         info = ray_tpu.get(
             self._controller.get_deployment_targets.remote(self.dep_key))
         if info is None:
+            # deployment deleted: stop republishing its last queue depth
+            # (the flag keeps _report from resurrecting the series on the
+            # very next tick)
+            self._deployment_gone = True
+            from ray_tpu.util import metrics_catalog as mcat
+            mcat.get("rtpu_serve_replica_queue_depth").remove_series(
+                tags={"deployment": self.dep_key})
             return
+        self._deployment_gone = False  # (re)deployed
         with self._lock:
             self._max_ongoing = info.get("max_ongoing") or 0
             if info["version"] == self._version and not force:
@@ -264,12 +273,21 @@ class Router:
                     del self._model_affinity[mid]
 
     def _report(self) -> None:
-        if self._controller is None:
+        if self._controller is None or self._deployment_gone:
             return
         with self._lock:
             # Waiting-to-be-assigned requests count toward load, otherwise
             # scale-from-zero (min_replicas=0) could never trigger.
             total = len(self._outstanding) + self._pending
+            pending = self._pending
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        if GLOBAL_CONFIG.metrics_enabled:
+            # queue depth = requests held in assign() by the
+            # max_ongoing_requests gate; the backpressure signal operators
+            # watch to see a saturated deployment before latency blows up
+            from ray_tpu.util import metrics_catalog as mcat
+            mcat.get("rtpu_serve_replica_queue_depth").set(
+                pending, tags={"deployment": self.dep_key})
         self._controller.report_handle_stats.remote(
             self.router_id, self.dep_key, total)
 

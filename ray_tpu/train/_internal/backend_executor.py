@@ -23,6 +23,7 @@ from ray_tpu.train._internal.session import NAMESPACE
 from ray_tpu.train._internal.worker_group import WorkerGroup
 from ray_tpu.train.backend import BackendConfig
 from ray_tpu.train.result import Result
+from ray_tpu.util import tracing
 
 _POLL = 0.02
 
@@ -103,8 +104,12 @@ class BackendExecutor:
                            i, wg.num_workers, self.storage_dir,
                            self.mesh_config, self.attempt)
             for i, w in enumerate(wg.workers)])
-        self.backend.on_start(wg, self.backend_config)
-        self.backend.on_training_start(wg, self.backend_config)
+        # spans make slow backend bring-up (mesh init, collective
+        # bootstrap, first compiles) visible on `ray_tpu timeline` next
+        # to the train.step spans the session emits per report
+        with tracing.trace("train.backend_setup"):
+            self.backend.on_start(wg, self.backend_config)
+            self.backend.on_training_start(wg, self.backend_config)
 
     def shutdown(self, force: bool = False) -> None:
         if self.worker_group is not None:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ray_tpu.experimental import internal_kv
@@ -96,6 +97,48 @@ class _TrainSession:
         # decisions (ASHA/PBT stops) are deterministic — the reference's
         # function-API report blocks on the trial executor the same way.
         self.sync_report = sync_report
+        # step telemetry: report()-to-report() interval == one step
+        self._last_report_mono = time.monotonic()
+        self._last_report_wall = time.time()
+        self._reported_once = False
+
+    def _observe_step(self) -> None:
+        """Per-worker step telemetry: ``rtpu_train_step_seconds`` +
+        instantaneous throughput gauge, plus a ``train.step`` span on the
+        cluster timeline so a slow step shows WHERE it went next to the
+        device trace rows (tracing.profile_device).
+
+        The FIRST interval of a session covers user setup — data loading,
+        model init, the first-step XLA compile — not a steady-state step;
+        it is kept out of the histogram (one 90s sample would dominate a
+        0.5s/step run's sum) and emitted as its own honestly-named span."""
+        now_mono, now_wall = time.monotonic(), time.time()
+        step_s = now_mono - self._last_report_mono
+        wall_t0 = self._last_report_wall
+        self._last_report_mono = now_mono
+        self._last_report_wall = now_wall
+        first = not self._reported_once
+        self._reported_once = True
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu.util import metrics_catalog as mcat
+        from ray_tpu.util import tracing
+        if GLOBAL_CONFIG.metrics_enabled and not first:
+            rank = str(self.rank)
+            mcat.get("rtpu_train_step_seconds").observe(
+                step_s, tags={"rank": rank})
+            if step_s > 0:
+                mcat.get("rtpu_train_throughput_steps_per_s").set(
+                    1.0 / step_s, tags={"rank": rank})
+        span = tracing.current_span()
+        name = ("train.setup_to_first_report" if first
+                else f"train.step[{self.iteration}]")
+        tracing._emit([{
+            "name": name, "cat": "span",
+            "ph": "X", "pid": tracing._host_pid(),
+            "tid": threading.get_ident() % 100000,
+            "ts": wall_t0 * 1e6, "dur": step_s * 1e6,
+            "args": {**(span.to_dict() if span else {}),
+                     "rank": self.rank, "iteration": self.iteration}}])
 
     # ------------------------------------------------------------ transport
     def _kv_put(self, key: str, value: bytes) -> None:
@@ -104,6 +147,7 @@ class _TrainSession:
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         self.iteration += 1
+        self._observe_step()
         ckpt_path = None
         if checkpoint is not None:
             # attempt in the name: a restarted attempt must never collide

@@ -17,9 +17,34 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _REGISTRY_LOCK = threading.Lock()
 _REGISTRY: Dict[str, "Metric"] = {}
+# bumped on registry reset so caches of resolved instances (the catalog's
+# warm path) know to drop stale references
+_REGISTRY_GEN = [0]
+
+# The GCS KV prefix under which every process's publisher writes its
+# snapshot.  One spelling, shared by the publisher, the collector, and
+# the GCS's persistence/sweep exemptions (gcs.py).
+METRICS_KV_PREFIX = "__metrics__/"
+
+
+def is_metrics_key(key) -> bool:
+    """Is this KV key an ephemeral metrics snapshot?  (keys may be str
+    or bytes depending on the caller)"""
+    if isinstance(key, bytes):
+        return key.startswith(METRICS_KV_PREFIX.encode())
+    return isinstance(key, str) and key.startswith(METRICS_KV_PREFIX)
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                    50.0, 100.0)
+
+# Per-metric series-cardinality cap.  Tag values can be user-controlled
+# (task names via .options(name=...), deployment keys): without a bound,
+# a driver submitting uniquely-named tasks grows the registry — and the
+# publisher's per-cycle kv_put payload — forever.  The tagset that would
+# exceed the cap folds into one {"overflow": "true"} series so totals
+# stay correct even when labels saturate.
+MAX_SERIES_PER_METRIC = 1000
+_OVERFLOW_KEY = (("overflow", "true"),)
 
 
 def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
@@ -27,26 +52,49 @@ def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
 
 
 class Metric:
-    """Base: named metric with default tags and per-tagset series."""
+    """Base: named metric with default tags and per-tagset series.
+
+    Same-name same-kind construction returns THE registered instance
+    (series merge) instead of silently replacing the registry entry —
+    two modules declaring the same counter share one series, and the
+    catalog accessor (``metrics_catalog.get``) is a cheap registry hit
+    on the warm path.  Same name with a different kind still raises."""
 
     kind = "untyped"
+    # class-level fallbacks: a registered-but-not-yet-__init__'d instance
+    # (another thread won the __new__ race a moment ago) must already be
+    # safe to snapshot/update
+    description = ""
+    tag_keys: Tuple[str, ...] = ()
+
+    def __new__(cls, name: str, *args: Any, **kwargs: Any) -> "Metric":
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        with _REGISTRY_LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None:
+                if existing.kind != cls.kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}")
+                return existing
+            inst = super().__new__(cls)
+            # essential state under the registry lock: the instance is
+            # visible to other threads the moment it lands in _REGISTRY
+            inst.name = name
+            inst._default_tags = {}
+            inst._lock = threading.Lock()
+            inst._series = {}
+            _REGISTRY[name] = inst
+        return inst
 
     def __init__(self, name: str, description: str = "",
                  tag_keys: Sequence[str] = ()):
-        if not name or not name.replace("_", "a").isalnum():
-            raise ValueError(f"invalid metric name {name!r}")
-        self.name = name
+        if getattr(self, "_initialized", False):
+            return  # merged into the already-registered instance
         self.description = description
         self.tag_keys = tuple(tag_keys)
-        self._default_tags: Dict[str, str] = {}
-        self._lock = threading.Lock()
-        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
-        with _REGISTRY_LOCK:
-            existing = _REGISTRY.get(name)
-            if existing is not None and existing.kind != self.kind:
-                raise ValueError(
-                    f"metric {name!r} already registered as {existing.kind}")
-            _REGISTRY[name] = self
+        self._initialized = True
 
     def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
         self._default_tags = dict(tags)
@@ -57,6 +105,21 @@ class Metric:
         if tags:
             merged.update(tags)
         return _tag_key(merged)
+
+    def _admit_key(self, k):
+        """Lock held.  Cardinality gate: an unseen tagset beyond the cap
+        folds into the shared overflow series instead of growing the
+        registry (and every publish payload) without bound."""
+        if k in self._series or len(self._series) < MAX_SERIES_PER_METRIC:
+            return k
+        return _OVERFLOW_KEY
+
+    def remove_series(self, tags: Optional[Dict[str, str]] = None) -> bool:
+        """Drop one tagset's series — called when the tagged entity (a
+        deployment, a replica) is deleted, so a long-lived process stops
+        republishing its last value forever.  Returns True if present."""
+        with self._lock:
+            return self._series.pop(self._resolve_tags(tags), None) is not None
 
     # -- snapshot / exposition ----------------------------------------------
     def snapshot(self) -> List[dict]:
@@ -77,6 +140,7 @@ class Counter(Metric):
             raise ValueError("counters only go up")
         k = self._resolve_tags(tags)
         with self._lock:
+            k = self._admit_key(k)
             self._series[k] = self._series.get(k, 0.0) + value
 
 
@@ -84,16 +148,20 @@ class Gauge(Metric):
     kind = "gauge"
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._resolve_tags(tags)
         with self._lock:
-            self._series[self._resolve_tags(tags)] = float(value)
+            self._series[self._admit_key(k)] = float(value)
 
 
 class Histogram(Metric):
     kind = "histogram"
+    boundaries = tuple(DEFAULT_BUCKETS)  # pre-__init__ visibility (see base)
 
     def __init__(self, name: str, description: str = "",
                  boundaries: Sequence[float] = DEFAULT_BUCKETS,
                  tag_keys: Sequence[str] = ()):
+        if getattr(self, "_initialized", False):
+            return  # merged: the first registration's boundaries stand
         self.boundaries = tuple(sorted(boundaries))
         super().__init__(name, description, tag_keys)
 
@@ -101,6 +169,7 @@ class Histogram(Metric):
                 tags: Optional[Dict[str, str]] = None) -> None:
         k = self._resolve_tags(tags)
         with self._lock:
+            k = self._admit_key(k)
             series = self._series.get(k)
             if series is None:
                 series = {"counts": [0] * (len(self.boundaries) + 1),
@@ -125,8 +194,21 @@ def registry_snapshot() -> Dict[str, dict]:
                      "series": m.snapshot()} for m in metrics}
 
 
+def _esc_label(v: Any) -> str:
+    """Escape a label value per the Prometheus text-format spec:
+    backslash, double quote, and line feed would otherwise emit invalid
+    exposition text (unparseable by any strict scraper)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _esc_help(v: str) -> str:
+    """HELP text escaping: backslash and line feed (spec)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_tags(tags: Dict[str, str], extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in sorted(tags.items())]
+    parts = [f'{k}="{_esc_label(v)}"' for k, v in sorted(tags.items())]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -138,7 +220,7 @@ def prometheus_text(snapshot: Optional[Dict[str, dict]] = None) -> str:
     out: List[str] = []
     for name, m in sorted(snap.items()):
         if m["description"]:
-            out.append(f"# HELP {name} {m['description']}")
+            out.append(f"# HELP {name} {_esc_help(m['description'])}")
         out.append(f"# TYPE {name} {m['kind']}")
         for s in m["series"]:
             tags, v = s["tags"], s["value"]
@@ -146,8 +228,8 @@ def prometheus_text(snapshot: Optional[Dict[str, dict]] = None) -> str:
                 acc = 0
                 for b, c in v["buckets"].items():
                     acc += c
-                    out.append(f"{name}_bucket"
-                               f"{_fmt_tags(tags, f'le=\"{b}\"')} {acc}")
+                    le = 'le="%s"' % b
+                    out.append(f"{name}_bucket{_fmt_tags(tags, le)} {acc}")
                 out.append(f"{name}_sum{_fmt_tags(tags)} {v['sum']}")
                 out.append(f"{name}_count{_fmt_tags(tags)} {v['count']}")
             else:
@@ -164,9 +246,21 @@ def publish(worker=None) -> None:
     w = worker or worker_mod.try_global_worker()
     if w is None:
         return
-    w.rpc("kv_put", key=f"__metrics__/{w.worker_id}",
+    # _reconnect=False: publishing is periodic best-effort — during a head
+    # restart it must fail fast and let the owning threads heal the pool,
+    # not fight them for it (the next cycle publishes to the healed head)
+    w.rpc("kv_put", _reconnect=False,
+          key=METRICS_KV_PREFIX + w.worker_id,
           value=json.dumps({"ts": time.time(),
                             "snapshot": registry_snapshot()}).encode())
+
+
+# How long a DEAD publisher's final snapshot stays visible before the
+# collector reaps it.  Short-lived processes (a train worker that ran a
+# quick loop, a task worker that exited) flush once on clean shutdown —
+# without a grace window their series would vanish the instant the worker
+# died, i.e. exactly when an operator wants to read them.
+DEAD_SNAPSHOT_GRACE_S = 120.0
 
 
 def collect_cluster() -> Dict[str, dict]:
@@ -174,7 +268,15 @@ def collect_cluster() -> Dict[str, dict]:
 
     Each series gains a ``worker`` tag so identical name+tags from two
     processes stay distinct samples (duplicate labels are invalid
-    Prometheus); snapshots from dead workers are skipped.
+    Prometheus); dead workers' snapshots stay visible for
+    ``DEAD_SNAPSHOT_GRACE_S`` after their last publish (the shutdown
+    flush), then are reaped.  (Reader-side aging uses the payload's
+    publisher wall clock — adequate for the common single-host driver;
+    the GCS's own sweep ages by head receipt time and is the
+    authoritative skew-proof bound.)
+
+    One ``kv_mget`` round trip fetches every publisher's snapshot —
+    scrape cost does not grow a head RPC per worker.
     """
     import json
 
@@ -182,17 +284,28 @@ def collect_cluster() -> Dict[str, dict]:
     w = worker_mod.global_worker()
     live = {wk["worker_id"] for wk in w.rpc("list_workers")["workers"]
             if wk["state"] != "dead"}
-    keys = w.rpc("kv_keys", prefix="__metrics__/")["keys"]
+    entries = w.rpc("kv_mget", prefix=METRICS_KV_PREFIX)["entries"]
     merged: Dict[str, dict] = {}
-    for key in keys:
+    now = time.time()
+    for key, raw in sorted(entries.items()):
         wid = key.split("/", 1)[1]
-        if wid not in live:
-            w.rpc("kv_del", key=key)  # reap dead publishers' snapshots
-            continue
-        raw = w.rpc("kv_get", key=key).get("value")
         if not raw:
+            if wid not in live:
+                w.rpc("kv_del", key=key)  # dead publisher, empty payload
             continue
-        snap = json.loads(raw)["snapshot"]
+        try:
+            payload = json.loads(raw)
+            payload["snapshot"]
+        except Exception:  # noqa: BLE001 - one corrupt payload must not
+            # take down the whole cluster scrape; reap it (a live
+            # publisher rewrites its key next cycle anyway)
+            w.rpc("kv_del", key=key)
+            continue
+        if wid not in live and \
+                now - payload.get("ts", 0) > DEAD_SNAPSHOT_GRACE_S:
+            w.rpc("kv_del", key=key)  # reap dead publishers' stale snapshots
+            continue
+        snap = payload["snapshot"]
         for name, m in snap.items():
             dst = merged.setdefault(name, {"kind": m["kind"],
                                            "description": m["description"],
@@ -284,3 +397,4 @@ def device_memory_gauges() -> Dict[str, dict]:
 def _reset_for_tests() -> None:
     with _REGISTRY_LOCK:
         _REGISTRY.clear()
+        _REGISTRY_GEN[0] += 1

@@ -1,0 +1,170 @@
+"""Catalog of every built-in ``rtpu_*`` metric.
+
+One declaration per built-in series (name, kind, tags, buckets, emitting
+process) so the worker, GCS, Serve, and Train layers share definitions
+instead of re-declaring strings — the same role ``ray_config_def.h``
+plays for flags.  Layers obtain instances through :func:`get`, which is a
+registry hit on the warm path (thanks to ``Metric`` merge-on-reregister)
+and re-creates the instance after a test registry reset.
+
+``tools/check_metrics_catalog.py`` (wired into ``make lint``) statically
+verifies that every ``Counter(``/``Gauge(``/``Histogram(`` instantiation
+of an ``rtpu_*`` name in the tree — and every ``mcat.get(...)`` call —
+names an entry declared here, so the catalog stays honest as layers grow.
+
+One documented exception: the ``rtpu_native_store_*`` gauge family is
+synthesized at collect time from whatever stats the C++ slab store's
+shared header exposes (``SlabStore.stats()`` keys — hits/misses/allocs/
+fails/used/...), so its exact member names live in native code, not
+here, and the static check cannot cover them.
+
+README.md § Observability renders this catalog for operators.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ray_tpu.util import metrics as _metrics
+
+# Latency buckets biased toward the sub-second range where task dispatch
+# and serve requests live, with a long tail for slow train steps.
+LATENCY_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# name -> {kind, description, tag_keys, buckets?, emitted_by}
+# ``emitted_by`` is documentation: which process's registry carries the
+# series (collect_cluster adds the disambiguating ``worker`` tag).
+CATALOG: Dict[str, dict] = {
+    # --- core task lifecycle ------------------------------------------------
+    "rtpu_task_queue_seconds": dict(
+        kind="histogram", tag_keys=("name",), buckets=LATENCY_BUCKETS,
+        description="Time a task spec waited in the scheduler queue "
+                    "(submit/retry enqueue -> dispatch to a worker)",
+        emitted_by="head (GCS)"),
+    "rtpu_task_exec_seconds": dict(
+        kind="histogram", tag_keys=("name",), buckets=LATENCY_BUCKETS,
+        description="Task / actor-method body execution time on the worker "
+                    "(arg unpack through result store)",
+        emitted_by="worker"),
+    "rtpu_tasks_total": dict(
+        kind="counter", tag_keys=("state",),
+        description="Tasks reaching a terminal state "
+                    "(ok | app_error | sys_error | dep_error | cancelled)",
+        emitted_by="head (GCS)"),
+    "rtpu_object_store_put_bytes": dict(
+        kind="counter", tag_keys=(),
+        description="Serialized bytes written to the object store by "
+                    "ray_tpu.put() in this process",
+        emitted_by="every worker/driver"),
+    "rtpu_object_store_get_bytes": dict(
+        kind="counter", tag_keys=(),
+        description="Serialized bytes materialized from the object store "
+                    "by ray_tpu.get() in this process",
+        emitted_by="every worker/driver"),
+    "rtpu_actor_restarts_total": dict(
+        kind="counter", tag_keys=("class",),
+        description="Actor restarts triggered by worker death "
+                    "(max_restarts budget consumed)",
+        emitted_by="head (GCS)"),
+    # --- serve data plane ---------------------------------------------------
+    "rtpu_serve_requests_total": dict(
+        kind="counter", tag_keys=("deployment", "code"),
+        description="HTTP requests completed by the Serve proxy, by "
+                    "deployment key and status code",
+        emitted_by="serve proxy"),
+    "rtpu_serve_errors_total": dict(
+        kind="counter", tag_keys=("deployment",),
+        description="Serve requests that ended in a 5xx response",
+        emitted_by="serve proxy"),
+    "rtpu_serve_request_latency_seconds": dict(
+        kind="histogram", tag_keys=("deployment",),
+        buckets=LATENCY_BUCKETS,
+        description="End-to-end Serve request latency at the proxy "
+                    "(replica assignment + execution; time-to-first-byte "
+                    "for streaming responses)",
+        emitted_by="serve proxy"),
+    "rtpu_serve_replica_queue_depth": dict(
+        kind="gauge", tag_keys=("deployment",),
+        description="Requests held in a router's assign() waiting for a "
+                    "free replica (max_ongoing_requests backpressure)",
+        emitted_by="every process with a router (proxy/driver)"),
+    "rtpu_serve_ongoing_requests": dict(
+        kind="gauge", tag_keys=("deployment", "replica"),
+        description="Requests currently executing inside a replica",
+        emitted_by="serve replica"),
+    "rtpu_serve_autoscaler_desired_replicas": dict(
+        kind="gauge", tag_keys=("deployment",),
+        description="Autoscaler target replica count after the current "
+                    "decision tick (equals num_replicas when autoscaling "
+                    "is off)",
+        emitted_by="serve controller"),
+    # --- train --------------------------------------------------------------
+    "rtpu_train_step_seconds": dict(
+        kind="histogram", tag_keys=("rank",), buckets=LATENCY_BUCKETS,
+        description="Wall time between consecutive train.report() calls "
+                    "on a training worker (one reported step)",
+        emitted_by="train worker"),
+    "rtpu_train_throughput_steps_per_s": dict(
+        kind="gauge", tag_keys=("rank",),
+        description="Instantaneous training throughput (1 / last step "
+                    "duration) per worker rank",
+        emitted_by="train worker"),
+    # --- synthesized at collect time (documented here; no instantiation) ----
+    "rtpu_device_hbm_bytes_in_use": dict(
+        kind="gauge", tag_keys=("device", "kind"),
+        description="HBM bytes currently allocated (PJRT memory_stats)",
+        emitted_by="driver collect (device_memory_gauges)"),
+    "rtpu_device_hbm_peak_bytes": dict(
+        kind="gauge", tag_keys=("device", "kind"),
+        description="Peak HBM bytes allocated (PJRT memory_stats)",
+        emitted_by="driver collect (device_memory_gauges)"),
+    "rtpu_device_hbm_bytes_limit": dict(
+        kind="gauge", tag_keys=("device", "kind"),
+        description="HBM allocator capacity (PJRT memory_stats)",
+        emitted_by="driver collect (device_memory_gauges)"),
+}
+
+
+# resolved-instance cache: get() runs on hot paths (inside the GCS
+# scheduler lock, per Serve request) — the warm path must be two dict
+# lookups, not a _REGISTRY_LOCK acquisition.  Invalidated by registry
+# generation (bumped in metrics._reset_for_tests); races are benign
+# (worst case one redundant rebuild that merges into the same instance).
+_CACHE: Dict[str, "_metrics.Metric"] = {}
+_CACHE_GEN = [-1]
+
+
+def get(name: str) -> "_metrics.Metric":
+    """The shared instance of a cataloged built-in metric.
+
+    Warm path = a local cache hit (no shared lock); after
+    ``_reset_for_tests()`` the generation bump drops the cache and the
+    next call re-registers a fresh instance from the catalog spec."""
+    gen = _metrics._REGISTRY_GEN[0]
+    if gen != _CACHE_GEN[0]:
+        _CACHE.clear()
+        _CACHE_GEN[0] = gen
+    inst = _CACHE.get(name)
+    if inst is not None:
+        return inst
+    try:
+        spec = CATALOG[name]
+    except KeyError:
+        raise KeyError(
+            f"{name!r} is not a cataloged built-in metric — declare it in "
+            f"ray_tpu/util/metrics_catalog.py") from None
+    kind = spec["kind"]
+    if kind == "counter":
+        inst = _metrics.Counter(name, spec["description"],
+                                spec.get("tag_keys", ()))
+    elif kind == "gauge":
+        inst = _metrics.Gauge(name, spec["description"],
+                              spec.get("tag_keys", ()))
+    else:
+        inst = _metrics.Histogram(
+            name, spec["description"],
+            spec.get("buckets", _metrics.DEFAULT_BUCKETS),
+            spec.get("tag_keys", ()))
+    _CACHE[name] = inst
+    return inst

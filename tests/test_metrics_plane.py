@@ -1,0 +1,417 @@
+"""Always-on metrics plane (SURVEY.md §5.5 rebuilt end-to-end).
+
+Covers the wiring ABOVE the registry: the background publisher loop
+(live series with zero user-side metric code, dead-snapshot reaping),
+built-in core/serve/train instrumentation, exposition-format strictness
+(label escaping round-trip through a spec-strict parser), metric
+re-registration merge semantics, and rtlog handler idempotency.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from conftest import time_scale
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.util import metrics as metrics_lib
+from ray_tpu.util import metrics_catalog as mcat
+
+
+# ----------------------------------------------------- strict exposition parser
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_VALUE = r"(?:[-+]?(?:[0-9]*\.)?[0-9]+(?:[eE][-+]?[0-9]+)?|[-+]?Inf|NaN)"
+
+
+def _parse_labels(s: str) -> dict:
+    """Parse the inside of a label block, enforcing the spec's escaping
+    rules (only \\\\, \\", and \\n are legal; raw newlines are not)."""
+    labels = {}
+    i = 0
+    while i < len(s):
+        m = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', s[i:])
+        assert m, f"bad label at {s[i:]!r}"
+        k = m.group(1)
+        i += m.end()
+        val = []
+        while True:
+            assert i < len(s), "unterminated label value"
+            c = s[i]
+            if c == "\\":
+                nxt = s[i + 1]
+                assert nxt in ("\\", '"', "n"), f"illegal escape \\{nxt}"
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            else:
+                assert c != "\n", "raw newline inside label value"
+                val.append(c)
+                i += 1
+        labels[k] = "".join(val)
+        if i < len(s):
+            assert s[i] == ",", f"expected ',' at {s[i:]!r}"
+            i += 1
+    return labels
+
+
+def parse_exposition(text: str):
+    """Strict parser for the Prometheus text format; asserts on any line
+    that a real scraper would reject.  Returns [(name, labels, value)]."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert re.match(rf"^# (HELP|TYPE) {_NAME} .*$", line), line
+            continue
+        m = re.match(rf"^({_NAME})(?:\{{(.*)\}})? ({_VALUE})$", line)
+        assert m, f"unparseable sample line: {line!r}"
+        labels = _parse_labels(m.group(2)) if m.group(2) else {}
+        samples.append((m.group(1), labels, float(m.group(3))))
+    return samples
+
+
+# ------------------------------------------------------------------- fixtures
+
+@pytest.fixture
+def metrics_cluster():
+    """Cluster with a fast publish period so tests don't wait 5s/cycle."""
+    ray_tpu.init(num_cpus=4,
+                 _system_config={"metrics_export_period_s": 1.0})
+    yield
+    ray_tpu.shutdown()
+    with GLOBAL_CONFIG._lock:
+        GLOBAL_CONFIG._overrides.pop("metrics_export_period_s", None)
+
+
+def _series(merged, name):
+    return merged.get(name, {}).get("series", [])
+
+
+def _poll_cluster_metrics(check, deadline_s):
+    """collect_cluster() until ``check(merged)`` is satisfied.
+
+    A content predicate, not name presence: the driver's in-process
+    registry persists across test clusters, so a metric NAME can appear
+    (with empty or stale series) before any worker published real data.
+    """
+    deadline = time.monotonic() + deadline_s
+    merged = {}
+    while time.monotonic() < deadline:
+        merged = metrics_lib.collect_cluster()
+        if check(merged):
+            return merged
+        time.sleep(0.3)
+    return merged
+
+
+# ------------------------------------------------- registry / exposition fixes
+
+def test_label_escaping_round_trip():
+    metrics_lib._reset_for_tests()
+    nasty = 'a"b\\c\nd'
+    c = metrics_lib.Counter("esc_total", "desc with \\ and\nnewline", ("k",))
+    c.inc(3, tags={"k": nasty})
+    h = metrics_lib.Histogram("esc_seconds", "h", boundaries=(0.1, 1.0),
+                              tag_keys=("k",))
+    h.observe(0.5, tags={"k": nasty})
+    samples = parse_exposition(metrics_lib.prometheus_text())
+    got = {(n, lbl.get("k")) for n, lbl, _ in samples}
+    assert ("esc_total", nasty) in got
+    # histogram series render per bucket + sum + count, all escaped
+    assert ("esc_seconds_bucket", nasty) in got
+    assert ("esc_seconds_count", nasty) in got
+    counter = [v for n, lbl, v in samples
+               if n == "esc_total" and lbl.get("k") == nasty]
+    assert counter == [3.0]
+
+
+def test_metric_reregistration_merges_series():
+    metrics_lib._reset_for_tests()
+    a = metrics_lib.Counter("dup_total", "first declaration")
+    b = metrics_lib.Counter("dup_total")  # second module, same counter
+    assert a is b
+    a.inc()
+    b.inc(2)
+    snap = metrics_lib.registry_snapshot()
+    assert snap["dup_total"]["series"][0]["value"] == 3.0
+    # the first registration's description survives the merge
+    assert snap["dup_total"]["description"] == "first declaration"
+    with pytest.raises(ValueError):
+        metrics_lib.Gauge("dup_total")  # kind clash still raises
+
+
+def test_histogram_merge_keeps_boundaries():
+    metrics_lib._reset_for_tests()
+    h1 = metrics_lib.Histogram("dup_seconds", boundaries=(0.1, 1.0))
+    h1.observe(0.5)
+    h2 = metrics_lib.Histogram("dup_seconds", boundaries=(7.0, 8.0, 9.0))
+    assert h2 is h1 and h2.boundaries == (0.1, 1.0)
+    h2.observe(0.05)
+    snap = metrics_lib.registry_snapshot()
+    assert snap["dup_seconds"]["series"][0]["value"]["count"] == 2
+
+
+def test_series_cardinality_cap_and_removal():
+    metrics_lib._reset_for_tests()
+    c = metrics_lib.Counter("cap_total", "", ("k",))
+    for i in range(metrics_lib.MAX_SERIES_PER_METRIC + 50):
+        c.inc(tags={"k": f"v{i}"})
+    snap = metrics_lib.registry_snapshot()["cap_total"]["series"]
+    # bounded: the cap plus one shared overflow series
+    assert len(snap) == metrics_lib.MAX_SERIES_PER_METRIC + 1
+    overflow = [s for s in snap if s["tags"] == {"overflow": "true"}]
+    assert overflow and overflow[0]["value"] == 50.0  # totals preserved
+    # an EXISTING tagset keeps updating in place past the cap
+    c.inc(tags={"k": "v0"})
+    snap = metrics_lib.registry_snapshot()["cap_total"]["series"]
+    assert [s["value"] for s in snap if s["tags"] == {"k": "v0"}] == [2.0]
+    # removal hook: deleted entities stop being republished
+    g = metrics_lib.Gauge("rm_gauge", "", ("deployment",))
+    g.set(5, tags={"deployment": "a"})
+    g.set(1, tags={"deployment": "b"})
+    assert g.remove_series(tags={"deployment": "a"})
+    assert not g.remove_series(tags={"deployment": "a"})  # already gone
+    snap = metrics_lib.registry_snapshot()["rm_gauge"]["series"]
+    assert [s["tags"] for s in snap] == [{"deployment": "b"}]
+
+
+def test_catalog_accessor_and_unknown_name():
+    metrics_lib._reset_for_tests()
+    h = mcat.get("rtpu_task_exec_seconds")
+    assert h is mcat.get("rtpu_task_exec_seconds")
+    assert h.kind == "histogram"
+    with pytest.raises(KeyError):
+        mcat.get("rtpu_not_a_real_metric")
+    # after a registry reset the accessor re-registers a fresh instance
+    metrics_lib._reset_for_tests()
+    h2 = mcat.get("rtpu_task_exec_seconds")
+    assert h2 is not h
+
+
+def test_check_metrics_catalog_tool():
+    r = subprocess.run([sys.executable, "tools/check_metrics_catalog.py"],
+                       capture_output=True, text=True, cwd="/root/repo",
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------- rtlog
+
+def test_rtlog_setup_idempotent_per_handler(tmp_path):
+    import logging
+
+    from ray_tpu._private import rtlog
+
+    logger = rtlog.setup("first")           # stream-only (client-style)
+    n_before = len(logger.handlers)
+    # second call WITH a log_dir must attach the file handler (the old
+    # first-caller-wins flag silently dropped it)
+    logger = rtlog.setup("second", tmp_path)
+    files = [h for h in logger.handlers
+             if isinstance(h, logging.FileHandler)
+             and str(tmp_path) in h.baseFilename]
+    assert len(files) == 1
+    assert "second-" in files[0].baseFilename
+    # and it is idempotent: same (component, dir) never duplicates
+    logger = rtlog.setup("second", tmp_path)
+    files2 = [h for h in logger.handlers
+              if isinstance(h, logging.FileHandler)
+              and str(tmp_path) in h.baseFilename]
+    assert len(files2) == 1
+    assert len(logger.handlers) == n_before + 1
+    # a NEW session dir for the same component REPLACES the handler
+    # (init→shutdown→init must not fan records out to old session files)
+    newdir = tmp_path / "s2"
+    newdir.mkdir()
+    logger = rtlog.setup("second", newdir)
+    files3 = [h for h in logger.handlers if isinstance(h, logging.FileHandler)
+              and str(tmp_path) in h.baseFilename]
+    assert len(files3) == 1 and str(newdir) in files3[0].baseFilename
+    assert len(logger.handlers) == n_before + 1
+    logger.removeHandler(files3[0])  # don't leak into later tests
+    files3[0].close()
+    rtlog._file_handlers.pop("second", None)
+
+
+# ------------------------------------------------------------- publisher loop
+
+def test_publisher_loop_zero_config(metrics_cluster):
+    """Built-in task series appear in the cluster merge with ZERO
+    user-side metric code — the worker/driver publisher threads push them
+    to the GCS KV on their own."""
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get([work.remote(i) for i in range(6)]) == list(range(1, 7))
+
+    def ready(m):
+        return (any(s["tags"].get("name") == "work"
+                    for s in _series(m, "rtpu_task_exec_seconds"))
+                and any(s["tags"].get("name") == "work"
+                        for s in _series(m, "rtpu_task_queue_seconds"))
+                and sum(s["value"] for s in _series(m, "rtpu_tasks_total")
+                        if s["tags"].get("state") == "ok") >= 6)
+
+    merged = _poll_cluster_metrics(ready, 30 * time_scale())
+    assert ready(merged), sorted(merged)
+    # snapshots really are in the GCS KV (the publisher's transport)
+    w = ray_tpu._private.worker.global_worker()
+    keys = w.rpc("kv_keys", prefix="__metrics__/")["keys"]
+    assert keys, "publisher never wrote a snapshot to the KV"
+    # and the whole merge renders as STRICT exposition text
+    samples = parse_exposition(metrics_lib.prometheus_text(merged))
+    assert any(n == "rtpu_task_exec_seconds_bucket" for n, _, _ in samples)
+
+
+def test_publisher_reaps_dead_worker_snapshots(metrics_cluster):
+    import time as _time
+
+    w = ray_tpu._private.worker.global_worker()
+    head = ray_tpu._head
+
+    def snap(ts):
+        return json.dumps({"ts": ts, "snapshot": {
+            "ghost_metric": {"kind": "gauge", "description": "",
+                             "series": [{"tags": {}, "value": 1.0}]}}}).encode()
+
+    def inject(key, value):
+        # simulate a dead publisher's leftover key (user kv_put into the
+        # reserved prefix is rejected — see below)
+        with head.lock:
+            head.kv["default"][key] = value
+            head._metrics_key_seen[key] = _time.monotonic()
+
+    # a dead publisher's FRESH final snapshot (shutdown flush) stays
+    # visible through the grace window — a short-lived train worker's
+    # series must not vanish the moment it exits...
+    inject("__metrics__/deadfresh", snap(time.time()))
+    # ...but a STALE dead snapshot is reaped, key and all
+    stale_ts = time.time() - metrics_lib.DEAD_SNAPSHOT_GRACE_S - 60
+    inject("__metrics__/deadstale", snap(stale_ts))
+    merged = metrics_lib.collect_cluster()
+    ghosts = {s["tags"]["worker"]
+              for s in merged.get("ghost_metric", {}).get("series", [])}
+    assert ghosts == {"deadfresh"}
+    keys = w.rpc("kv_keys", prefix="__metrics__/")["keys"]
+    assert "__metrics__/deadstale" not in keys  # reaped, not just skipped
+    assert "__metrics__/deadfresh" in keys
+    # server-side hygiene: the head's periodic sweep bounds the KV plane
+    # even when nothing ever scrapes (no collect_cluster reader).  The
+    # sweep ages by HEAD receipt time (clock-skew-proof), so backdate it.
+    inject("__metrics__/deadstale2", snap(time.time()))
+    head._metrics_key_seen["__metrics__/deadstale2"] = \
+        _time.monotonic() - metrics_lib.DEAD_SNAPSHOT_GRACE_S - 60
+    head._sweep_dead_metrics()
+    keys = w.rpc("kv_keys", prefix="__metrics__/")["keys"]
+    assert "__metrics__/deadstale2" not in keys
+    assert "__metrics__/deadfresh" in keys  # grace window honored
+    w.rpc("kv_del", key="__metrics__/deadfresh")
+    # the prefix is reserved: a user key here would be silently vacuumed
+    # later, so the write must fail loudly instead
+    with pytest.raises(Exception, match="reserved"):
+        w.rpc("kv_put", key="__metrics__/mydata", value=b"x")
+
+
+def test_dashboard_metrics_endpoint_strict(metrics_cluster):
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def ping():
+        return 1
+
+    ray_tpu.get([ping.remote() for _ in range(3)])
+    _poll_cluster_metrics(
+        lambda m: any(s["tags"].get("name") == "ping"
+                      for s in _series(m, "rtpu_task_exec_seconds")),
+        30 * time_scale())
+    srv = start_dashboard(port=0)
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        samples = parse_exposition(text)  # strict: any bad line asserts
+        assert any(n.startswith("rtpu_task_exec_seconds") for n, _, _ in samples)
+    finally:
+        stop_dashboard()
+
+
+# ----------------------------------------------------------------- serve plane
+
+def test_serve_builtin_metrics(metrics_cluster):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            return {"ok": True}
+
+    try:
+        serve.run(Echo.bind(), route_prefix="/echo")
+        host, port = serve.get_http_address()
+        for _ in range(5):
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/echo", timeout=30) as r:
+                assert r.status == 200
+        def ready(m):
+            lat_n = sum(
+                s["value"]["count"]
+                for s in _series(m, "rtpu_serve_request_latency_seconds")
+                if s["tags"].get("deployment") == "default#Echo")
+            ok_n = sum(
+                s["value"] for s in _series(m, "rtpu_serve_requests_total")
+                if s["tags"].get("deployment") == "default#Echo"
+                and s["tags"].get("code") == "200")
+            target = any(
+                s["tags"].get("deployment") == "default#Echo"
+                and s["value"] >= 1
+                for s in _series(m, "rtpu_serve_autoscaler_desired_replicas"))
+            return lat_n >= 5 and ok_n >= 5 and target
+
+        merged = _poll_cluster_metrics(ready, 45 * time_scale())
+        assert ready(merged), sorted(merged)
+        # per-deployment series render as valid exposition text
+        parse_exposition(metrics_lib.prometheus_text(merged))
+    finally:
+        serve.shutdown()
+
+
+# ----------------------------------------------------------------- train plane
+
+def test_train_step_metrics(metrics_cluster, tmp_path):
+    from ray_tpu.train._internal import session as sess
+
+    metrics_lib._reset_for_tests()
+    sess.init_session(run_id="mrun", run_name="mrun", rank=0, world_size=1,
+                      storage_dir=str(tmp_path), restore_checkpoint=None)
+    try:
+        # first report = setup interval, kept OUT of the step histogram
+        sess.get_session().report({"loss": 1.0})
+        time.sleep(0.02)
+        sess.get_session().report({"loss": 0.5})
+        time.sleep(0.02)
+        sess.get_session().report({"loss": 0.25})
+    finally:
+        sess.shutdown_session()
+    snap = metrics_lib.registry_snapshot()
+    assert "rtpu_train_step_seconds" in snap
+    series = snap["rtpu_train_step_seconds"]["series"]
+    assert series[0]["tags"]["rank"] == "0"
+    assert series[0]["value"]["count"] == 2  # 3 reports - setup interval
+    assert "rtpu_train_throughput_steps_per_s" in snap
+    thr = snap["rtpu_train_throughput_steps_per_s"]["series"][0]["value"]
+    assert thr > 0
