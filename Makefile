@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test sanitize fuzz bench lint check-metrics
+.PHONY: test sanitize fuzz bench lint check-metrics microbench-quick
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -32,3 +32,11 @@ fuzz:
 
 bench:
 	$(PY) bench.py
+
+# Control-plane microbenchmark smoke (CI): --quick scale, asserts
+# completion + sane serial-RT latency bounds, and leaves a JSON artifact
+# (benchmarks/results/microbench_ci.json) for the uploader.
+microbench-quick:
+	JAX_PLATFORMS=cpu $(PY) -m ray_tpu.scripts.cli microbenchmark --quick \
+		--assert-sane --json benchmarks/results/microbench_ci.json \
+		--label ci

@@ -20,8 +20,16 @@ Reference: ``src/ray/gcs/gcs_server/`` + the raylet's ``ClusterTaskManager``
   ``TaskManager``; centralized here).
 
 Threading model: listener accept loop + one handler thread per connection +
-a worker-process monitor thread; all state under one lock with a single
-condition variable (every state change notifies; waiters recheck predicates).
+a worker-process monitor thread.  Locking (see DESIGN.md §4c for the full
+discipline): scheduler/node/worker/actor/PG state AND object-table
+*mutation* live under ``self.lock`` (+``self.cv``); hot-kind *reads* run on
+fast paths that never take it — ``_sealed`` is a lock-free read table of
+terminal object metas, object waiters live under ``_waiter_lock``, the KV
+plane under ``_kv_lock``, timeline events under ``_events_lock``, and
+refcount oneways are coalesced per connection and applied in batches under
+one global-lock acquisition (``_drain_ref_ops``).  Lock order is strictly
+``lock → {_waiter_lock | _kv_lock | _events_lock}``; the leaf locks never
+nest inside each other and never acquire the global lock.
 """
 
 from __future__ import annotations
@@ -98,6 +106,14 @@ class WorkerState:
         self.tpu_capable = False # spawned with device access (JAX sees TPU)
         self.task_conn = None    # Connection for pushes
         self.task_conn_lock = threading.Lock()
+        # Out-of-band control channel (cancel / drop_queued / dump_stack /
+        # stop_worker): with the worker executing tasks directly on its
+        # task-conn reader thread (one fewer handoff per task), OOB
+        # control must ride a second connection the worker's ctl thread
+        # drains even mid-task.  Best-effort: absent (attach race,
+        # reattach window) → fall back to the task conn.
+        self.ctl_conn = None
+        self.ctl_conn_lock = threading.Lock()
         self.blocked = False     # task currently parked in get() (CPU released)
         self.current_task: Optional[dict] = None
         # Lease pipelining (reference: lease reuse / worker lease caching):
@@ -118,6 +134,19 @@ class WorkerState:
                 return True
             except (OSError, ValueError):
                 return False
+
+    def push_ctl(self, msg: dict) -> bool:
+        """Push an out-of-band control message (preferring the ctl conn so
+        it is seen even while the worker's main thread executes a task)."""
+        with self.ctl_conn_lock:
+            conn = self.ctl_conn
+            if conn is not None:
+                try:
+                    conn.send(msg)
+                    return True
+                except (OSError, ValueError):
+                    self.ctl_conn = None
+        return self.push(msg)
 
 
 class ObjMeta:
@@ -187,6 +216,26 @@ class GcsServer:
                 GLOBAL_CONFIG.slab_memory_mb * 1024 * 1024)
         self.lock = threading.RLock()
         self.cv = threading.Condition(self.lock)
+        # Fast-path tables (GCS locking discipline, DESIGN.md §4c):
+        # ``_sealed`` maps oid -> a reply-ready meta dict for objects in a
+        # terminal state.  Written ONLY under self.lock (at seal / delete /
+        # loss transitions), read LOCK-FREE (CPython dict reads are atomic
+        # under the GIL) by get_meta/peek_meta/wait — the sealed-object
+        # read path never touches the global lock.  Remote-spooled objects
+        # appear only as markers (terminal-state visibility for the
+        # waiter handshake and peek/wait); their replies need a live
+        # node-table address lookup, so reads fall to the slow path.
+        self._sealed: Dict[str, dict] = {}
+        # Object waiters under their own lock: seals (global lock held)
+        # take it briefly to wake the exact blocked get/wait RPCs;
+        # waiter registration/unregistration never touches the global
+        # lock.  Lock order: self.lock -> _waiter_lock, never reversed.
+        self._waiter_lock = threading.Lock()
+        # KV plane (incl. the metrics receipt index) off the global lock:
+        # per-process metrics publishers and config readers must not
+        # contend with the scheduler.  Lock order: self.lock -> _kv_lock.
+        self._kv_lock = threading.Lock()
+        self._events_lock = threading.Lock()  # timeline event buffer
 
         self.nodes: Dict[str, NodeState] = {}
         self.workers: Dict[str, WorkerState] = {}
@@ -228,6 +277,12 @@ class GcsServer:
         self._dedup_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         self._dedup_pending: Dict[tuple, threading.Event] = {}
         self._dedup_lock = threading.Lock()
+        # Ledgers already torn down by release_all (lock held): a pin for
+        # a closed call ledger arriving LATE (cross-channel race — the
+        # caller's add_refs coalescing in flight while the actor's
+        # release_all lands) must be dropped, not applied; an orphaned
+        # ledger entry would pin its objects forever.
+        self._closed_ledgers: "OrderedDict[str, None]" = OrderedDict()
         # remote-spool deletions, batched per holder node (see _decref);
         # the drain thread starts below, after _shutdown exists
         self._peer_delete_q: Dict[str, List[str]] = defaultdict(list)
@@ -319,7 +374,7 @@ class GcsServer:
         never clobber a newer snapshot with stale state (reference: the
         GCS tables Redis persists — actors, PGs, KV, function exports)."""
         with self._persist_lock:
-            with self.lock:
+            with self.lock, self._kv_lock:
                 state = {
                     # __metrics__/ snapshots are ephemeral telemetry: a
                     # restored head must not resurrect dead workers'
@@ -401,8 +456,9 @@ class GcsServer:
                     len(restored_actors), len(restored_pgs),
                     len(shm_objects))
         with self.cv:
-            for ns, table in kv_tables.items():
-                self.kv[ns].update(table)
+            with self._kv_lock:
+                for ns, table in kv_tables.items():
+                    self.kv[ns].update(table)
             self.functions.update(functions)
             self.named_actors.update(named)
             for aid, a in restored_actors:
@@ -429,6 +485,7 @@ class GcsServer:
                 meta.state = READY
                 meta.loc = "shm"
                 meta.size = size
+                self._publish_sealed_locked(oid, READY, "shm", None, size)
 
     def _restore_grace_check(self) -> None:
         """After the reattach grace window, push restored actors whose
@@ -512,6 +569,19 @@ class GcsServer:
             self.objects[oid] = meta
         return meta
 
+    def _publish_sealed_locked(self, oid: str, state: str, loc: str,
+                               data: Optional[bytes], size: int) -> None:
+        """Lock held.  Publish a terminal meta to the lock-free read
+        table — the ONE place the reply-entry shape is built, so the
+        fast path can never drift from the slow-path reply.  Remote-
+        spooled objects get a MARKER entry: it makes the seal visible to
+        the register-then-recheck waiter handshake and to peek/wait
+        (terminal-state checks), but _read_sealed_fast refuses to serve
+        it (the reply needs a live node-table address lookup, so those
+        reads stay on the slow path)."""
+        self._sealed[oid] = {"state": state, "loc": loc, "data": data,
+                             "size": size}
+
     def _seal_object(self, oid: str, loc: str, data: Optional[bytes], size: int,
                      node_id: Optional[str], contained: List[str],
                      lineage_task: Optional[str] = None) -> None:
@@ -523,6 +593,9 @@ class GcsServer:
         meta.size = size
         meta.node_id = node_id
         meta.contained = contained
+        # publish to the lock-free read table BEFORE waking waiters: a
+        # reader that observes the wake must find the entry
+        self._publish_sealed_locked(oid, READY, loc, data, size)
         self._promote_dep_waiters(oid)
         self._notify_object_waiters(oid)
         if lineage_task:
@@ -543,7 +616,6 @@ class GcsServer:
             # needs a moment to read/mmap (unlink under a live mmap is
             # safe by store design, so late frees cannot corrupt reads).
             self._graceful_free[oid] = time.monotonic()
-        self.cv.notify_all()
 
     def _seal_error(self, oid: str, err_bytes: bytes) -> None:
         meta = self._get_or_create_meta(oid)
@@ -551,11 +623,12 @@ class GcsServer:
         meta.has_producer = False
         meta.loc = "inline"
         meta.data = err_bytes
+        self._publish_sealed_locked(oid, ERROR, "inline", err_bytes, 0)
         self._promote_dep_waiters(oid, errored=True)
         self._notify_object_waiters(oid)
-        self.cv.notify_all()
 
     def _mark_object_lost(self, oid: str, meta: ObjMeta) -> None:
+        self._sealed.pop(oid, None)  # no longer readable without the lock
         if meta.lineage_task and meta.lineage_task in self.lineage:
             meta.state = PENDING
             meta.has_producer = True  # the reconstruction below is the
@@ -573,6 +646,7 @@ class GcsServer:
             meta.state = ERROR
             meta.loc = "inline"
             meta.data = serialize_to_bytes(e)[0]
+            self._publish_sealed_locked(oid, ERROR, "inline", meta.data, 0)
             # terminal transition outside _seal_error: wake dep-parked
             # specs and object waiters here too
             self._promote_dep_waiters(oid, errored=True)
@@ -590,6 +664,7 @@ class GcsServer:
             del self.objects[oid]
             return
         if meta.refcount <= 0 and meta.state != PENDING:
+            self._sealed.pop(oid, None)  # unpublish BEFORE freeing data
             for c in meta.contained:
                 self._decref(c)
             if meta.loc in ("shm", "spilled"):
@@ -1214,7 +1289,9 @@ class GcsServer:
         node = self.nodes.get(w.node_id)
         if node is not None:
             node.workers.discard(w.worker_id)
-        # release refs held by this client
+        # release refs held by this client; close its ledger so a late
+        # coalesced add_ref can't resurrect it as a forever-pinned orphan
+        self._close_ledger_locked(w.worker_id)
         for oid, n in self.client_refs.pop(w.worker_id, {}).items():
             self._decref(oid, n)
         spec = w.current_task
@@ -1306,11 +1383,12 @@ class GcsServer:
         worker's final flush instantly."""
         from ray_tpu.util.metrics import DEAD_SNAPSHOT_GRACE_S
         with self.lock:
+            live = {w.worker_id for w in self.workers.values()
+                    if w.state != "dead"}
+        with self._kv_lock:
             ns = self.kv.get("default")
             if not ns:
                 return
-            live = {w.worker_id for w in self.workers.values()
-                    if w.state != "dead"}
             now = time.monotonic()
             # iterate the receipt index, not the namespace: the sweep
             # must cost O(#publishers), not an O(|kv|) scan under the
@@ -1412,9 +1490,20 @@ class GcsServer:
         # must come back rtmsg even for hot kinds.  Pickle-speaking peers
         # keep the C-speed pickle reply on hot kinds.
         peer_rtmsg = False
+        # Per-connection refcount coalescing queue: consecutive
+        # refcount-plane oneways (add_ref/add_refs/release/release_batch/
+        # release_all) buffer here and apply as ONE batch under ONE
+        # global-lock acquisition the moment the connection goes quiet or
+        # a non-refcount frame arrives (stream order preserved) — instead
+        # of one lock acquisition per oneway.
+        ref_buf: List[Tuple[str, dict]] = []
         try:
             while not self._shutdown:
                 try:
+                    if ref_buf and not conn.poll(0.0):
+                        # connection went quiet mid-burst: apply now (a
+                        # lone release must not wait for a next frame)
+                        self._drain_ref_ops(ref_buf)
                     msg, seen_ver, seen_codec = wire.conn_recv_ex(conn)
                     peer_rtmsg = seen_codec == wire._CODEC_RTMSG
                 except (EOFError, OSError):
@@ -1424,6 +1513,19 @@ class GcsServer:
                     break
                 kind = msg.get("kind")
                 rid = msg.get("rid")
+                if rid is None and kind in wire.REF_KINDS and \
+                        (ver > 0 or GLOBAL_CONFIG.proto_min_version == 0):
+                    # (legacy peers on a version-fenced server fall
+                    # through so the fence below still rejects them)
+                    ref_buf.append((kind, msg))
+                    if len(ref_buf) < 256:
+                        continue  # poll-gated drain at loop top
+                    self._drain_ref_ops(ref_buf)
+                    continue
+                if ref_buf:
+                    # a non-refcount frame follows buffered refcount ops:
+                    # apply them first (per-connection FIFO)
+                    self._drain_ref_ops(ref_buf)
                 if kind == "__proto_hello__":
                     # version negotiation (wire.py): reply at the agreed
                     # version; every later frame on this conn rides it
@@ -1444,6 +1546,9 @@ class GcsServer:
                     self._attach_task_conn(msg["worker_id"], conn,
                                            msg.get("reattach"))
                     return  # this thread becomes the push-channel reader
+                if kind == "attach_worker_ctl":
+                    self._attach_worker_ctl(msg["worker_id"], conn)
+                    return  # thread parks until the worker disconnects
                 if kind == "agent_attach":
                     self._attach_agent_conn(msg["node_id"], conn)
                     return  # thread parks until the agent disconnects
@@ -1504,6 +1609,11 @@ class GcsServer:
                     except (OSError, ValueError):
                         break
         finally:
+            # a client that flushed releases and closed must not lose them
+            try:
+                self._drain_ref_ops(ref_buf)
+            except Exception:  # noqa: BLE001 - shutdown path
+                logger.exception("final ref-op drain failed")
             try:
                 conn.close()
             except OSError:
@@ -1556,6 +1666,38 @@ class GcsServer:
                 self.remove_node_internal(node_id)
             except Exception:  # noqa: BLE001
                 logger.exception("agent node removal failed")
+
+    def _attach_worker_ctl(self, worker_id: str, conn) -> None:
+        """Register a worker's out-of-band control connection (cancel /
+        drop_queued / dump_stack / stop_worker reach the worker even while
+        its main thread executes a task).  Best-effort: EOF here is NOT a
+        death signal (the task conn is the liveness channel) — just clear
+        the registration so push_ctl falls back to the task conn."""
+        with self.cv:
+            w = self.workers.get(worker_id)
+            if w is None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            with w.ctl_conn_lock:
+                w.ctl_conn = conn
+        while not self._shutdown:
+            try:
+                conn.recv()
+            except (EOFError, OSError):
+                break
+        with self.lock:
+            w = self.workers.get(worker_id)
+        if w is not None:
+            with w.ctl_conn_lock:
+                if w.ctl_conn is conn:
+                    w.ctl_conn = None
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _attach_task_conn(self, worker_id: str, conn,
                           reattach: Optional[dict] = None) -> None:
@@ -1636,6 +1778,7 @@ class GcsServer:
             self._on_actor_ready(worker_id, msg)
         elif kind == "actor_result":
             # actor method results sealed by the actor's worker
+            t0 = time.monotonic()
             with self.cv:
                 w = self.workers.get(worker_id)
                 for oid, res in zip(msg["return_ids"], msg["results"]):
@@ -1661,7 +1804,11 @@ class GcsServer:
                             # never reclaim it — mark lost NOW
                             self._mark_object_lost(
                                 oid, self.objects[oid])
-            self._pump()  # tasks may be waiting on these objects as deps
+            if GLOBAL_CONFIG.metrics_enabled:
+                mcat.get("rtpu_gcs_hot_handler_seconds").observe(
+                    time.monotonic() - t0, tags={"kind": "actor_result"})
+            if self.pending_tasks:
+                self._pump()  # tasks may be dep-waiting on these objects
         elif kind == "task_blocked":
             # reference: raylet releases the CPU while a task blocks in get().
             # Credit whichever pool the CPU was claimed from: the PG bundle
@@ -1680,7 +1827,7 @@ class GcsServer:
                     while w.pipeline:
                         self._push_pending_left(w.pipeline.pop())
                     if dropped:
-                        w.push({"kind": "drop_queued", "pairs": dropped})
+                        w.push_ctl({"kind": "drop_queued", "pairs": dropped})
                     spec = w.current_task
                     cpu = (spec.get("_req") or {}).get("CPU", 0)
                     if cpu and not spec.get("_cpu_released"):
@@ -1730,7 +1877,7 @@ class GcsServer:
         elif kind == "log" and self.log_sink is not None:
             self.log_sink(msg["line"])
         elif kind == "profile_events":
-            with self.lock:
+            with self._events_lock:
                 self.events.extend(msg["events"])
 
     def _parallel_capacity(self) -> bool:
@@ -1774,7 +1921,15 @@ class GcsServer:
         return found
 
     def _on_task_done(self, worker_id: str, msg: dict) -> None:
+        evs = msg.get("events")
+        if evs:
+            # timeline events ride the task_done frame (one message per
+            # task, not two); buffered under their own lock
+            with self._events_lock:
+                self.events.extend(evs)
+        t0 = time.monotonic()
         with self.cv:
+            lock_waited = time.monotonic() - t0
             w = self.workers.get(worker_id)
             spec = w.current_task if w else None
             if spec is None or spec["task_id"] != msg["task_id"]:
@@ -1879,7 +2034,15 @@ class GcsServer:
                 if node is not None and node.alive:
                     node.idle_workers.append(worker_id)
             self.cv.notify_all()
-        self._pump()
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_gcs_lock_wait_seconds").set(
+                lock_waited, tags={"lock": "global"})
+            mcat.get("rtpu_gcs_hot_handler_seconds").observe(
+                time.monotonic() - t0, tags={"kind": "task_done"})
+        if self.pending_tasks:
+            # nothing queued → nothing the freed capacity could dispatch;
+            # skip the scan (len() is GIL-atomic, no lock needed)
+            self._pump()
 
     def _on_actor_ready(self, worker_id: str, msg: dict) -> None:
         with self.cv:
@@ -1970,6 +2133,10 @@ class GcsServer:
     def _h_register_client(self, msg: dict) -> dict:
         with self.cv:
             wid = msg["client_id"]
+            # a re-registering client (transient conn break, reattach) is
+            # alive again: its ledger must accept pins (worker death
+            # closed it against late stragglers)
+            self._closed_ledgers.pop(wid, None)
             node_id = msg.get("node_id") or self.head_node_id
             if node_id not in self.nodes:
                 # stale node id from before a head restart: adopt onto
@@ -2017,47 +2184,101 @@ class GcsServer:
 
     # --- objects
     def _h_put_object(self, msg: dict) -> dict:
+        t0 = time.monotonic()
         with self.cv:
             self._apply_put_locked(msg["client_id"], msg)
-        self._pump()  # a pending task may have been waiting on this object
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_gcs_hot_handler_seconds").observe(
+                time.monotonic() - t0, tags={"kind": "put_object"})
+        if self.pending_tasks:
+            self._pump()  # a dep-parked task may have been promoted
         return {}
 
     def _h_peek_meta(self, msg: dict) -> dict:
         """Non-blocking state snapshot (actor-channel reconnect dedup:
-        'did this call's returns already seal?')."""
-        with self.lock:
-            out = {}
-            for oid in msg["object_ids"]:
-                m = self.objects.get(oid)
-                out[oid] = None if m is None else {"state": m.state}
-            return {"metas": out}
+        'did this call's returns already seal?').  Sealed objects answer
+        lock-free; only unsealed ones fall back to the global lock."""
+        out = {}
+        misses = []
+        sealed = self._sealed
+        for oid in msg["object_ids"]:
+            e = sealed.get(oid)
+            if e is not None:
+                out[oid] = {"state": e["state"]}
+            else:
+                misses.append(oid)
+        if misses:
+            with self.lock:
+                for oid in misses:
+                    m = self.objects.get(oid)
+                    out[oid] = None if m is None else {"state": m.state}
+        return {"metas": out}
 
     def _notify_object_waiters(self, oid: str) -> None:
-        """Lock held: an object reached a terminal state — wake the exact
-        get/wait RPCs blocked on it."""
-        lst = self._object_waiters.pop(oid, None)
-        if not lst:
+        """An object reached a terminal state — wake the exact get/wait
+        RPCs blocked on it.  Takes only ``_waiter_lock`` (callers hold the
+        global lock; readers never do)."""
+        with self._waiter_lock:
+            lst = self._object_waiters.pop(oid, None)
+            if not lst:
+                return
+            for waiter in lst:
+                if oid in waiter["left"]:
+                    waiter["left"].discard(oid)
+                    waiter["done"] = waiter.get("done", 0) + 1
+                    need = waiter.get("need")
+                    if (need is None and not waiter["left"]) or \
+                            (need is not None and waiter["done"] >= need):
+                        waiter["ev"].set()
+
+    def _register_waiter(self, waiter: dict, oids) -> None:
+        """Park ``waiter`` on each oid, then self-service any that sealed
+        in the registration gap: seals publish to ``_sealed`` BEFORE
+        notifying, so an entry present after registration means the
+        notify may already have run without us."""
+        with self._waiter_lock:
+            for oid in oids:
+                waiter["left"].add(oid)
+                self._object_waiters.setdefault(oid, []).append(waiter)
+        sealed = self._sealed
+        hit = [oid for oid in oids if oid in sealed]
+        if hit:
+            with self._waiter_lock:
+                for oid in hit:
+                    self._waiter_discard_locked(waiter, oid)
+
+    def _waiter_discard_locked(self, waiter: dict, oid: str) -> None:
+        """_waiter_lock held: one oid went terminal and this thread saw it
+        directly (no notify) — mirror _notify_object_waiters for it."""
+        if oid not in waiter["left"]:
             return
-        for waiter in lst:
-            if oid in waiter["left"]:
-                waiter["left"].discard(oid)
-                waiter["done"] = waiter.get("done", 0) + 1
-                need = waiter.get("need")
-                if (need is None and not waiter["left"]) or \
-                        (need is not None and waiter["done"] >= need):
-                    waiter["ev"].set()
+        waiter["left"].discard(oid)
+        waiter["done"] = waiter.get("done", 0) + 1
+        need = waiter.get("need")
+        if (need is None and not waiter["left"]) or \
+                (need is not None and waiter["done"] >= need):
+            waiter["ev"].set()
+        lst = self._object_waiters.get(oid)
+        if lst is not None:
+            try:
+                lst.remove(waiter)
+            except ValueError:
+                pass
+            if not lst:
+                del self._object_waiters[oid]
 
     def _unregister_waiter(self, waiter: dict) -> None:
-        """Lock held: drop a waiter's remaining registry entries."""
-        for oid in list(waiter["left"]):
-            lst = self._object_waiters.get(oid)
-            if lst is not None:
-                try:
-                    lst.remove(waiter)
-                except ValueError:
-                    pass
-                if not lst:
-                    del self._object_waiters[oid]
+        """Drop a waiter's remaining registry entries (takes _waiter_lock)."""
+        with self._waiter_lock:
+            for oid in list(waiter["left"]):
+                lst = self._object_waiters.get(oid)
+                if lst is not None:
+                    try:
+                        lst.remove(waiter)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        del self._object_waiters[oid]
 
     def _scan_pending(self, oids, verify_fs: bool) -> List[str]:
         """Lock held: returns the oids still PENDING.  With ``verify_fs``,
@@ -2098,52 +2319,100 @@ class GcsServer:
         return [oid for oid in pending
                 if (m := self.objects.get(oid)) is None or m.state == PENDING]
 
+    def _read_sealed_fast(self, oids) -> Optional[dict]:
+        """Lock-free read of terminal object metas from ``_sealed``.
+        Returns the reply dict, or None when any oid is missing from the
+        table (pending / remote / deleted) or fails the data-plane
+        presence check (lost segment → the slow path routes it to
+        reconstruction).  Never touches the global lock; the store and
+        slab are their own lock domains."""
+        sealed = self._sealed
+        out = {}
+        for oid in oids:
+            e = sealed.get(oid)
+            if e is None or e["loc"] == "remote":
+                # remote marker: terminal for peek/wait/waiter purposes,
+                # but the reply needs an addr lookup — slow path
+                return None
+            out[oid] = e
+        for oid, e in out.items():
+            loc = e["loc"]
+            if loc in ("shm", "spilled"):
+                self.store.restore(oid)
+                if not ShmObjectStore.exists_in_shm(oid):
+                    return None
+                self.store.touch(oid)
+            elif loc == "slab":
+                if self.slab is None or not self.slab.exists(oid):
+                    return None
+        return out
+
     def _h_get_meta(self, msg: dict) -> dict:
+        oids = msg["object_ids"]
+        t0 = time.monotonic()
+        # Hot path: every oid already sealed — reply without the global
+        # lock (the common case for task args and post-completion gets).
+        fast = self._read_sealed_fast(oids)
+        if fast is not None:
+            if GLOBAL_CONFIG.metrics_enabled:
+                mcat.get("rtpu_gcs_hot_handler_seconds").observe(
+                    time.monotonic() - t0, tags={"kind": "get_meta_fast"})
+            return {"metas": fast}
         deadline = None if msg.get("timeout") is None \
             else time.monotonic() + msg["timeout"]
-        oids = msg["object_ids"]
         ev = threading.Event()
         waiter = {"left": set(), "ev": ev, "need": None}
         with self.cv:
             pending = self._scan_pending(oids, verify_fs=True)
-            if pending and msg.get("nonblock"):
-                # fast-path probe (see Worker._blocking_get_meta): the
-                # caller avoids the task_blocked CPU-release dance when
-                # everything is already sealed
-                return {"pending": sorted(pending)}
-            if pending:
-                waiter["left"].update(pending)
-                for oid in waiter["left"]:
-                    self._object_waiters.setdefault(oid, []).append(waiter)
+        if GLOBAL_CONFIG.metrics_enabled:
+            # outside the lock: metric updates must not lengthen the
+            # global critical section (same rule as the other handlers)
+            mcat.get("rtpu_gcs_hot_handler_seconds").observe(
+                time.monotonic() - t0, tags={"kind": "get_meta_scan"})
+        if pending and msg.get("nonblock"):
+            # fast-path probe (see Worker._blocking_get_meta): the
+            # caller avoids the task_blocked CPU-release dance when
+            # everything is already sealed
+            return {"pending": sorted(pending)}
+        if pending:
+            # registration is OUTSIDE the global lock; _register_waiter's
+            # sealed-table re-check closes the scan→register gap
+            self._register_waiter(waiter, pending)
         try:
-            while waiter["left"]:
+            while True:
+                with self._waiter_lock:
+                    if not waiter["left"]:
+                        break
                 remaining = None if deadline is None \
                     else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
-                    with self.cv:  # seals mutate the set concurrently
+                    with self._waiter_lock:
                         left = sorted(waiter["left"])[:3]
                     raise exc.GetTimeoutError(
                         f"get() timed out waiting for {left}...")
                 ev.wait(timeout=min(1.0, remaining)
                         if remaining is not None else 1.0)
                 ev.clear()
-                if not waiter["left"]:
+                with self._waiter_lock:
+                    left_now = list(waiter["left"])
+                if not left_now:
                     break
+                # periodic sweep for state changes with no seal event
+                # (owner death, lost segments under reconstruction)
                 with self.cv:
-                    # periodic sweep for state changes with no seal event
-                    # (owner death, lost segments under reconstruction)
-                    self._scan_pending(list(waiter["left"]),
-                                       verify_fs=False)
-                    for oid in list(waiter["left"]):
-                        m = self.objects.get(oid)
-                        if m is not None and m.state != PENDING:
-                            waiter["left"].discard(oid)
-                            lst = self._object_waiters.get(oid)
-                            if lst is not None and waiter in lst:
-                                lst.remove(waiter)
+                    self._scan_pending(left_now, verify_fs=False)
+                    terminal = [o for o in left_now
+                                if (m := self.objects.get(o)) is not None
+                                and m.state != PENDING]
+                if terminal:
+                    with self._waiter_lock:
+                        for oid in terminal:
+                            self._waiter_discard_locked(waiter, oid)
         finally:
-            with self.cv:
-                self._unregister_waiter(waiter)
+            self._unregister_waiter(waiter)
+        fast = self._read_sealed_fast(oids)
+        if fast is not None:
+            return {"metas": fast}
         with self.cv:
             out = {}
             for oid in oids:
@@ -2161,6 +2430,13 @@ class GcsServer:
     def _h_wait(self, msg: dict) -> dict:
         oids = msg["object_ids"]
         num_returns = msg["num_returns"]
+        # lock-free fast path: enough terminal objects in the sealed table
+        sealed = self._sealed
+        ready = [o for o in oids if o in sealed]
+        if len(ready) >= num_returns:
+            ready_set = set(ready[:num_returns])
+            return {"ready": [o for o in oids if o in ready_set],
+                    "not_ready": [o for o in oids if o not in ready_set]}
         deadline = None if msg.get("timeout") is None \
             else time.monotonic() + msg["timeout"]
         ev = threading.Event()
@@ -2171,14 +2447,14 @@ class GcsServer:
                     if (m := self.objects.get(o)) is not None
                     and m.state != PENDING]
 
-        with self.cv:
+        with self.lock:
             ready = ready_now()
-            if len(ready) < num_returns:
-                pend = [o for o in oids if o not in set(ready)]
-                waiter = {"left": set(pend), "ev": ev,
-                          "need": num_returns - len(ready), "done": 0}
-                for oid in waiter["left"]:
-                    self._object_waiters.setdefault(oid, []).append(waiter)
+        if len(ready) < num_returns:
+            pend = [o for o in oids if o not in set(ready)]
+            waiter = {"left": set(), "ev": ev,
+                      "need": num_returns - len(ready), "done": 0}
+            # sealed-table re-check inside closes the check→register gap
+            self._register_waiter(waiter, pend)
         try:
             while len(ready) < num_returns:
                 remaining = None if deadline is None \
@@ -2188,52 +2464,107 @@ class GcsServer:
                 ev.wait(timeout=min(0.5, remaining)
                         if remaining is not None else 0.5)
                 ev.clear()
-                with self.cv:
+                with self.lock:
                     ready = ready_now()
         finally:
             if waiter is not None:
-                with self.cv:
-                    self._unregister_waiter(waiter)
+                self._unregister_waiter(waiter)
         ready_set = set(ready[:num_returns])
         return {"ready": [o for o in oids if o in ready_set],
                 "not_ready": [o for o in oids if o not in ready_set]}
 
-    def _h_add_ref(self, msg: dict) -> dict:
-        with self.cv:
-            meta = self._get_or_create_meta(msg["object_id"])
-            meta.refcount += 1
-            refs = self.client_refs[msg["client_id"]]
-            refs[msg["object_id"]] = refs.get(msg["object_id"], 0) + 1
-        return {}
-
     def _add_refs_locked(self, ledger: str, object_ids) -> None:
         """Lock held — the ONE copy of ref-pinning (used by the add_refs
-        RPC and the submit-stream 'ref' op; the two must not drift)."""
+        RPC and the submit-stream 'ref' op; the two must not drift).
+        Pins for a ledger release_all already tore down are dropped (the
+        late-pin race; see _closed_ledgers)."""
+        if ledger in self._closed_ledgers:
+            return
         refs = self.client_refs[ledger]
         for oid in object_ids:
             self._get_or_create_meta(oid).refcount += 1
             refs[oid] = refs.get(oid, 0) + 1
 
-    def _h_add_refs(self, msg: dict) -> dict:
-        with self.cv:
+    def _close_ledger_locked(self, ledger: str) -> None:
+        self._closed_ledgers[ledger] = None
+        while len(self._closed_ledgers) > 4096:
+            self._closed_ledgers.popitem(last=False)
+
+    def _apply_ref_op_locked(self, kind: str, msg: dict) -> None:
+        """Lock held — apply one refcount-plane op.  The single dispatch
+        point for the coalesced drain, the per-kind handlers, and the
+        in-process short circuit, so semantics cannot drift."""
+        if kind == "add_ref":
+            self._add_refs_locked(msg.get("ledger") or msg["client_id"],
+                                  (msg["object_id"],))
+        elif kind == "add_refs":
             self._add_refs_locked(msg.get("ledger") or msg["client_id"],
                                   msg["object_ids"])
+        elif kind == "release":
+            self._apply_release_locked(msg["client_id"], msg["object_id"])
+        elif kind == "release_batch":
+            for oid in msg["object_ids"]:
+                self._apply_release_locked(msg["client_id"], oid)
+        elif kind == "release_all":
+            ledger = msg["ledger"]
+            self._close_ledger_locked(ledger)
+            for oid, n in self.client_refs.pop(ledger, {}).items():
+                self._decref(oid, n)
+
+    def _drain_ref_ops(self, batch: List[Tuple[str, dict]]) -> None:
+        """Apply a connection's coalesced refcount oneways under ONE
+        global-lock acquisition, preserving their arrival order (the
+        per-connection FIFO is the ordering contract pins/releases rely
+        on; coalescing only ever delays application, never reorders)."""
+        if not batch:
+            return
+        t0 = time.monotonic()
+        with self.cv:
+            waited = time.monotonic() - t0
+            for kind, msg in batch:
+                self._apply_ref_op_locked(kind, msg)
+            self.cv.notify_all()
+        if GLOBAL_CONFIG.metrics_enabled:
+            # metric updates AFTER releasing: they take the metric's own
+            # lock and must not lengthen the global critical section
+            mcat.get("rtpu_gcs_lock_wait_seconds").set(
+                waited, tags={"lock": "global"})
+            mcat.get("rtpu_gcs_hot_handler_seconds").observe(
+                time.monotonic() - t0, tags={"kind": "ref_drain"})
+            mcat.get("rtpu_gcs_ref_ops_total").inc(
+                len(batch), tags={"path": "coalesced"})
+        batch.clear()
+
+    def _count_inline_ref_op(self) -> None:
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_gcs_ref_ops_total").inc(tags={"path": "inline"})
+
+    def _h_add_ref(self, msg: dict) -> dict:
+        with self.cv:
+            self._apply_ref_op_locked("add_ref", msg)
+        self._count_inline_ref_op()
+        return {}
+
+    def _h_add_refs(self, msg: dict) -> dict:
+        with self.cv:
+            self._apply_ref_op_locked("add_refs", msg)
+        self._count_inline_ref_op()
         return {}
 
     def _h_release_batch(self, msg: dict) -> dict:
         """Batched ObjectRef drops (one lock acquisition + one message for
         up to 64 decrefs — the submit hot loop's GC traffic)."""
         with self.cv:
-            for oid in msg["object_ids"]:
-                self._apply_release_locked(msg["client_id"], oid)
+            self._apply_ref_op_locked("release_batch", msg)
+        self._count_inline_ref_op()
         return {}
 
     def _h_release_all(self, msg: dict) -> dict:
         """Release every ref under a transient ledger (in-flight actor args)."""
         with self.cv:
-            for oid, n in self.client_refs.pop(msg["ledger"], {}).items():
-                self._decref(oid, n)
+            self._apply_ref_op_locked("release_all", msg)
             self.cv.notify_all()
+        self._count_inline_ref_op()
         return {}
 
     def _h_seal_errors(self, msg: dict) -> dict:
@@ -2242,7 +2573,8 @@ class GcsServer:
                 meta = self._get_or_create_meta(oid)
                 if meta.state == PENDING:
                     self._seal_error(oid, msg["error"])
-        self._pump()
+        if self.pending_tasks:
+            self._pump()
         return {}
 
     def _h_release(self, msg: dict) -> dict:
@@ -2253,6 +2585,7 @@ class GcsServer:
     def _h_free_objects(self, msg: dict) -> dict:
         with self.cv:
             for oid in msg["object_ids"]:
+                self._sealed.pop(oid, None)
                 meta = self.objects.pop(oid, None)
                 if meta is not None and meta.loc in ("shm", "spilled"):
                     self.store.delete_object(oid)
@@ -2319,7 +2652,8 @@ class GcsServer:
             raise
         # _pump_locked's capacity pre-check makes a no-capacity pump O(1);
         # no submit-site heuristic needed.
-        self._pump()
+        if self.pending_tasks:
+            self._pump()
         return {}
 
     def _h_submit_batch(self, msg: dict) -> dict:
@@ -2330,7 +2664,9 @@ class GcsServer:
         that deps on it; a transient release lands after the spec whose
         dep pin replaces it."""
         client_id = msg.get("client_id")
+        t0 = time.monotonic()
         with self.cv:
+            lock_waited = time.monotonic() - t0
             for kind, payload in msg["ops"]:
                 if kind == "spec":
                     try:
@@ -2371,7 +2707,13 @@ class GcsServer:
                             payload["object_ids"])
                     except Exception:  # noqa: BLE001
                         logger.exception("submit_batch: ref op failed")
-        self._pump()
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_gcs_lock_wait_seconds").set(
+                lock_waited, tags={"lock": "global"})
+            mcat.get("rtpu_gcs_hot_handler_seconds").observe(
+                time.monotonic() - t0, tags={"kind": "submit_batch"})
+        if self.pending_tasks:
+            self._pump()
         return {}
 
     def _iter_queued_specs(self):
@@ -2417,7 +2759,7 @@ class GcsServer:
                         # later legitimate re-dispatch
                         for w in self.workers.values():
                             if spec in w.pipeline:
-                                w.push({"kind": "drop_queued",
+                                w.push_ctl({"kind": "drop_queued",
                                         "pairs": [(tid,
                                                    spec.get("_dseq"))]})
                                 break
@@ -2432,7 +2774,7 @@ class GcsServer:
                         w.proc.kill()
                     return {"cancelled": "killed"}
                 if w is not None:
-                    w.push({"kind": "cancel", "task_id": tid})
+                    w.push_ctl({"kind": "cancel", "task_id": tid})
                 return {"cancelled": "signalled"}
         return {"cancelled": "not_found"}
 
@@ -2505,7 +2847,7 @@ class GcsServer:
             except OSError:
                 pass
         elif w is not None:
-            w.push({"kind": "stop_worker"})
+            w.push_ctl({"kind": "stop_worker"})
         with self.cv:
             if a.state in (A_PENDING, A_RESTARTING) and msg.get("no_restart", True):
                 # not yet running anywhere: cancel the pending creation
@@ -2551,7 +2893,7 @@ class GcsServer:
                 "the '__metrics__/' KV prefix is reserved for metric "
                 "snapshot publishing (ephemeral, auto-reaped); store "
                 "application data under a different key")
-        with self.lock:
+        with self._kv_lock:
             ns = self.kv[msg.get("namespace", "default")]
             existed = msg["key"] in ns
             if not (msg.get("overwrite", True) is False and existed):
@@ -2567,11 +2909,11 @@ class GcsServer:
         return {"existed": existed}
 
     def _h_kv_get(self, msg: dict) -> dict:
-        with self.lock:
+        with self._kv_lock:
             return {"value": self.kv[msg.get("namespace", "default")].get(msg["key"])}
 
     def _h_kv_del(self, msg: dict) -> dict:
-        with self.lock:
+        with self._kv_lock:
             existed = self.kv[msg.get("namespace", "default")].pop(msg["key"], None)
         if existed is not None:
             if is_metrics_key(msg["key"]):
@@ -2589,14 +2931,14 @@ class GcsServer:
         snapshots per /metrics hit — N serial kv_get RPCs would make
         scrape latency and head load linear in fleet size."""
         pref = msg["prefix"]
-        with self.lock:
+        with self._kv_lock:
             ns = self.kv[msg.get("namespace", "default")]
             return {"entries": {k: v for k, v in ns.items()
                                 if isinstance(k, type(pref))
                                 and k.startswith(pref)}}
 
     def _h_kv_keys(self, msg: dict) -> dict:
-        with self.lock:
+        with self._kv_lock:
             ns = self.kv[msg.get("namespace", "default")]
             prefix = msg.get("prefix", b"")
             return {"keys": [k for k in ns if k.startswith(prefix)]}
@@ -2872,6 +3214,9 @@ class GcsServer:
                     meta.loc = "shm"
                     meta.size = len(wire)
                     meta.node_id = self.head_node_id
+                    if meta.state == READY:
+                        self._publish_sealed_locked(oid, READY, "shm", None,
+                                                    len(wire))
             # the head owns the object now — drop the holder's spool copy
             # or relay-fallback traffic accumulates dead files on A
             from ray_tpu._private.data_plane import delete_on_peer
@@ -2968,12 +3313,12 @@ class GcsServer:
     def _h_ingest_events(self, msg: dict) -> dict:
         """Timeline events from processes with no task conn (drivers):
         span traces, merged device traces (util/tracing.py)."""
-        with self.lock:
+        with self._events_lock:
             self.events.extend(msg["events"])
         return {}
 
     def _h_timeline(self, msg: dict) -> dict:
-        with self.lock:
+        with self._events_lock:
             return {"events": list(self.events)}
 
     def _h_stack(self, msg: dict) -> dict:
@@ -2990,7 +3335,7 @@ class GcsServer:
                        and w.task_conn is not None]
         try:
             targets = [w for w in targets
-                       if w.push({"kind": "dump_stack"})]
+                       if w.push_ctl({"kind": "dump_stack"})]
             deadline = time.time() + float(msg.get("timeout", 3.0))
             with self.cv:
                 while len(collected) < len(targets):
@@ -3023,7 +3368,7 @@ class GcsServer:
             for w in self.workers.values():
                 if w.proc is None and w.state not in ("driver", "dead"):
                     try:
-                        w.push({"kind": "stop_worker"})
+                        w.push_ctl({"kind": "stop_worker"})
                     except Exception:  # noqa: BLE001 - already gone
                         pass
             self.cv.notify_all()

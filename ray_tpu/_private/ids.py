@@ -14,8 +14,31 @@ import threading
 import uuid
 
 
+# 16-char ID generation is on the task-submission hot path (one TaskID
+# per `.remote()`), and uuid4/urandom cost 20-30µs per call on small
+# hosts (one getrandom syscall each).  Instead: one 40-bit urandom
+# prefix per process plus a 24-bit counter — unique within a process by
+# the counter, across processes by the prefix (birthday risk over 1k
+# processes ≈ 5e-7), re-seeded on counter rollover and after fork
+# (os.getpid check) so forked children never continue the parent's
+# sequence.  Short ids (worker/job — rare, per-process not per-task)
+# keep full per-call entropy.
+_seed_lock = threading.Lock()
+_seed = ["", 0, 0]  # [prefix_hex10, counter, pid]
+
+
 def _rand_hex(n: int) -> str:
-    return uuid.uuid4().hex[:n]
+    if n < 16:
+        return uuid.uuid4().hex[:n]
+    with _seed_lock:
+        pid = os.getpid()
+        if _seed[2] != pid or _seed[1] >= 0xFFFFFF:
+            _seed[0] = os.urandom(5).hex()
+            _seed[1] = 0
+            _seed[2] = pid
+        _seed[1] += 1
+        h = f"{_seed[0]}{_seed[1]:06x}"
+    return h if n == 16 else h + uuid.uuid4().hex[:n - 16]
 
 
 class _Counter:

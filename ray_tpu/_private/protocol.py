@@ -21,6 +21,7 @@ import itertools
 import os
 import socket
 import threading
+import time
 from multiprocessing.connection import Client, Connection, Listener
 from typing import Any, Dict
 
@@ -40,22 +41,46 @@ def set_authkey(key: bytes) -> None:
 # request kinds are plain strings in msg["kind"]; responses echo msg["rid"].
 
 
+# Accept backlog for cluster listeners.  multiprocessing.Listener's
+# default is 1, and accept() runs the HMAC handshake inline — under a
+# dial burst (worker churn: every worker opens rpc + task + ctl conns)
+# the queue overflows and fresh connects die with EAGAIN.
+_BACKLOG = 64
+
+
 def make_listener(path: str) -> Listener:
     try:
         os.unlink(path)
     except FileNotFoundError:
         pass
-    return Listener(address=path, family="AF_UNIX", authkey=_AUTHKEY)
+    return Listener(address=path, family="AF_UNIX", authkey=_AUTHKEY,
+                    backlog=_BACKLOG)
 
 
 def connect(path: str) -> Connection:
-    return Client(address=path, family="AF_UNIX", authkey=_AUTHKEY)
+    """Unix-socket dial with a bounded retry on transient accept-queue
+    overflow (EAGAIN on a unix connect = the listener's backlog is full,
+    e.g. a worker-churn dial burst — not a dead head)."""
+    deadline = None
+    delay = 0.02
+    while True:
+        try:
+            return Client(address=path, family="AF_UNIX", authkey=_AUTHKEY)
+        except BlockingIOError:
+            now = time.monotonic()
+            if deadline is None:
+                deadline = now + 5.0
+            elif now > deadline:
+                raise
+            time.sleep(delay)
+            delay = min(0.2, delay * 2)
 
 
 def make_tcp_listener(host: str, port: int) -> Listener:
     """TCP listener for the client proxy (reference: Ray Client's gRPC
     endpoint ray://host:10001)."""
-    return Listener(address=(host, port), family="AF_INET", authkey=_AUTHKEY)
+    return Listener(address=(host, port), family="AF_INET", authkey=_AUTHKEY,
+                    backlog=_BACKLOG)
 
 
 def connect_tcp(host: str, port: int,
@@ -103,7 +128,7 @@ def make_tcp_actor_listener() -> Listener:
     """Ephemeral-port TCP listener for an actor on a remote-agent host
     (its unix sockets are unreachable from other hosts)."""
     return Listener(address=("0.0.0.0", 0), family="AF_INET",
-                    authkey=_AUTHKEY)
+                    authkey=_AUTHKEY, backlog=_BACKLOG)
 
 
 def connect_addr(addr: str, timeout: float | None = None) -> Connection:

@@ -81,8 +81,15 @@ class _Pickler(cloudpickle.Pickler):
         return super().reducer_override(obj)
 
 
+_NONE_PICKLE: bytes = pickle.dumps(None, protocol=5)
+
+
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer], List[Any]]:
     """Returns (pickle_bytes, oob_buffers, contained_object_refs)."""
+    if value is None:
+        # the single most common task result (side-effect tasks): its
+        # pickle is a constant — skip the pickler machinery entirely
+        return _NONE_PICKLE, [], []
     buffers: List[pickle.PickleBuffer] = []
     collector = _RefCollector()
     f = io.BytesIO()

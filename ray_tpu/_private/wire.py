@@ -192,8 +192,16 @@ def rtmsg_loads(raw: bytes) -> Any:
 # way (BASELINE #7 latency contract unchanged).
 _HOT_KINDS = frozenset({
     "submit_batch", "submit_task", "get_meta", "peek_meta", "wait",
-    "add_refs", "release", "release_batch", "task_done", "call",
-    "put_object", "put_chunk", "fetch_chunk"})
+    "add_ref", "add_refs", "release", "release_batch", "release_all",
+    "task_done", "call", "put_object", "put_chunk", "fetch_chunk"})
+
+# Refcount-plane oneway kinds: the GCS coalesces consecutive frames of
+# these per connection and applies them in one batched lock acquisition
+# (stream order preserved).  Declared here, next to the frame schema,
+# because it is a wire-level contract: anything added must stay a pure
+# refcount mutation with no reply and no cross-table side effects.
+REF_KINDS = frozenset({
+    "add_ref", "add_refs", "release", "release_batch", "release_all"})
 
 _c_codec = None
 _c_codec_tried = False

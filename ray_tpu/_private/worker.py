@@ -158,6 +158,7 @@ class Worker:
         self._ret_seq = _counter()
         self._task_seq = _counter()
         self._call_seq = _counter()
+        self._dedup_seq = _counter()
         self._fn_cache: Dict[str, Any] = {}
         self._exported: set = set()
         import weakref
@@ -189,6 +190,16 @@ class Worker:
         self._release_tls = threading.local()
         self._release_bufs: Dict[int, List[str]] = {}
         self._release_lock = threading.RLock()
+        # Client-side pin/release netting (actor-call return refs ONLY —
+        # refs whose seal is concurrent with the pin, so the GCS's 10s
+        # rc-0-at-seal grace covers the parked window): a pin buffers
+        # here and a release of the same oid CANCELS it before either
+        # becomes a message — the get-and-drop hot loop then sends no
+        # refcount traffic at all.  Still-held pins are drained onto the
+        # ordered submit stream by the flusher's idle tick within ~1s.
+        # Guarded by _release_lock (same __del__ reentrancy rules as the
+        # release buffers).
+        self._pending_pins: Dict[str, int] = {}
         # return-oid → (actor_id, call_id) for in-flight actor calls: a
         # result observed through ANY path (inline reply, GCS get) marks
         # the call complete, so a racing disconnect can't resubmit an
@@ -210,6 +221,11 @@ class Worker:
         self._submit_send_lock = threading.Lock()
         self._submit_first: float = 0.0
         self._submit_flusher_on = False
+        # event-driven flusher wakeup: set when something buffers, cleared
+        # when the buffer drains — an idle process must not pay 500
+        # scheduler wakeups/s for an empty-buffer poll loop (measured
+        # contention on 1-2 core hosts)
+        self._submit_pending = threading.Event()
         # revoked (task_id, dseq) pairs, insertion-ordered so overflow
         # evicts the OLDEST revocation (an arbitrary set.pop could evict
         # the pair a drop_queued just added, un-revoking it)
@@ -229,6 +245,8 @@ class Worker:
         self._pull_sem = threading.Semaphore(
             max(1, GLOBAL_CONFIG.transfer_max_inflight))
         self.ctx = _TaskContext()
+        self._pid = os.getpid()  # cached: getpid is a real syscall per call
+        self._ctl_down = True    # flipped by the ctl thread on attach
         self._task_conn = None
         self._task_conn_lock = threading.Lock()
         self._actor_announce: Optional[dict] = None  # set in _become_actor
@@ -359,9 +377,11 @@ class Worker:
                 kind, {"kind": kind, "client_id": self.worker_id, **fields})
         # Across a true GCS restart the dedup cache is empty and the retry
         # re-applies — the documented at-least-once contract for head
-        # fault tolerance (fresh object table).
+        # fault tolerance (fresh object table).  A counter suffices: the
+        # server's dedup key is (client_id, id) and client ids are unique
+        # per process (uuid4 here cost ~30µs per put on small hosts).
         if kind in self._DEDUP_KINDS:
-            fields["_dedup"] = uuid.uuid4().hex
+            fields["_dedup"] = self._dedup_seq()
         try:
             return self.pool.call(kind, client_id=self.worker_id, **fields)
         except (EOFError, OSError, ConnectionError):
@@ -825,6 +845,26 @@ class Worker:
             self._untrack_owned_ret(oid)  # owner dropped the return ref
         buf = self._release_buf()
         with self._release_lock:  # RLock: cyclic-GC re-entry safe
+            n = self._pending_pins.get(oid)
+            # net only for inline-cached (small) results: the pair then
+            # costs zero messages and the object's 10s graceful-free
+            # retention holds only bytes the control plane already
+            # carried.  A BIG (non-inline) result must free promptly —
+            # ship its pin onto the stream NOW (so this release can
+            # never overtake it) and send the release normally.
+            # _local_values membership is a GIL-atomic dict read; taking
+            # _local_lock here could invert against a __del__ fired
+            # inside cache_local.
+            if n and oid in self._local_values:
+                # cancels a not-yet-flushed pin: the pair nets to zero
+                # messages (the actor-call get-and-drop hot loop)
+                if n == 1:
+                    del self._pending_pins[oid]
+                else:
+                    self._pending_pins[oid] = n - 1
+                return
+            if n:
+                self._drain_pending_pins()  # re-entrant under _release_lock
             buf.append(oid)
             if len(buf) < 64:
                 return
@@ -841,8 +881,11 @@ class Worker:
         ``all_threads`` (shutdown only) drains every thread's buffer on
         the calling thread — cross-channel ordering no longer matters
         once nothing new can be submitted."""
-        if self._submit_buf:
-            self._flush_submits()  # submits pin deps; they must land first
+        if self._submit_buf or (all_threads and self._pending_pins):
+            # submits pin deps; they must land first.  Pins alone don't
+            # gate a block (they only add protection; flusher tick covers
+            # them) — except at shutdown, when this is the last chance.
+            self._flush_submits()
         batches: List[List[str]] = []
         with self._release_lock:  # copy+clear must be atomic vs shutdown
             buf = getattr(self._release_tls, "buf", None)
@@ -863,8 +906,18 @@ class Worker:
                 return
 
     def notify_borrow(self, oid: str) -> None:
+        """Pin a borrowed (deserialized nested) ref for this client.  Rides
+        the ordered submit stream (one buffered op, flushed within ~2ms —
+        not a oneway message per borrow); its later release flushes the
+        stream first, so the pin always applies before the unpin.  NOT
+        routed through the netted-pin buffer: a borrowed object is
+        usually long-sealed, so the rc-0-at-seal grace does not protect
+        it — another holder's release during a parked pin's window would
+        free the data (the netting path is only safe for refs whose seal
+        is concurrent with the pin, i.e. actor-call returns)."""
         if not self._stop.is_set():
-            self.rpc_oneway("add_ref", object_id=oid)
+            self._buffer_stream_op(("ref", {"object_ids": [oid],
+                                            "ledger": None}))
 
     def cache_local(self, oid: str, wire: bytes) -> None:
         with self._local_lock:
@@ -941,6 +994,15 @@ class Worker:
             else:
                 klayout[k] = ("val", len(values))
                 values.append(v)
+        if not values:
+            # no by-value args: skip the serializer round trip entirely
+            # (the no-arg task/actor-call hot path); _unpack_args yields
+            # [] when values_blob is absent
+            fields = {"arg_layout": layout, "kwarg_layout": klayout}
+            deps = [oid for tag, oid in
+                    [e for e in layout if e[0] == "ref"] +
+                    [e for e in klayout.values() if e[0] == "ref"]]
+            return fields, deps, [], [], []
         wire, refs = serialize_to_bytes(values)
         borrows = [str(r.id) for r in refs]
         deps = [oid for tag, oid in
@@ -1132,6 +1194,7 @@ class Worker:
             self._submit_buf.extend(entries)
             if not self._submit_first:
                 self._submit_first = time.monotonic()
+            self._submit_pending.set()
             full = len(self._submit_buf) >= 64
             if not full:
                 self._ensure_flusher_locked()
@@ -1139,6 +1202,7 @@ class Worker:
             self._drain_submits()
 
     def _flush_submits(self) -> None:
+        self._drain_pending_pins()
         self._drain_submits()
 
     def _buffer_stream_op(self, op: tuple) -> None:
@@ -1156,6 +1220,7 @@ class Worker:
             self._submit_buf.append(op)
             if not self._submit_first:
                 self._submit_first = time.monotonic()
+            self._submit_pending.set()
             full = len(self._submit_buf) >= 64
             if not full:
                 self._ensure_flusher_locked()
@@ -1164,14 +1229,51 @@ class Worker:
 
     def _buffer_ref_add(self, object_ids: List[str],
                         ledger: Optional[str] = None) -> None:
-        """add_refs on the ordered submit stream: one buffered op instead
-        of a per-call oneway message (the direct-call hot path issues one
-        or two of these per actor call).  The seal-with-zero-refs race
-        (actor seals before the batched ref lands) is covered by the
-        GCS's graceful-free grace, same as the old cross-channel oneway
-        was."""
-        self._buffer_stream_op(("ref", {"object_ids": object_ids,
-                                        "ledger": ledger}))
+        """Pin refs for this client.  Explicit-ledger pins (in-flight
+        actor args) ride the ordered submit stream unchanged.  Client-
+        ledger pins (actor-call returns) are NETTED: they sit in
+        _pending_pins where a release of the same oid cancels them
+        outright; survivors are flushed onto the stream by the flusher.
+        Only safe for refs whose SEAL is concurrent with the pin: the
+        seal-with-zero-refs window (actor seals before the pin — or the
+        netted pair never arrives at all) is covered by the GCS's 10s
+        graceful-free grace.  Long-sealed objects (borrows) must use the
+        prompt stream path instead — see notify_borrow."""
+        if ledger is not None or self.is_client:
+            # clients have no flusher thread: ship immediately
+            self._buffer_stream_op(("ref", {"object_ids": object_ids,
+                                            "ledger": ledger}))
+            return
+        with self._release_lock:
+            for oid in object_ids:
+                self._pending_pins[oid] = self._pending_pins.get(oid, 0) + 1
+        # deliberately NO flusher wakeup: a pin only ADDS protection, and
+        # the GCS rc-0-at-seal grace is 10s — the flusher's idle 1s tick
+        # drains survivors.  Waking it per call would put a drain (and a
+        # GCS lock acquisition) back on the hot loop netting removed.
+        with self._submit_lock:
+            self._ensure_flusher_locked()
+
+    def _drain_pending_pins(self) -> None:
+        """Move surviving netted pins onto the ordered submit stream
+        (direct buffer append — must not recurse into a drain).  The
+        pop-and-append is atomic under _release_lock: a concurrent
+        release() of the same oid either nets against the pin (runs
+        before the pop) or finds the pin already in _submit_buf and
+        flushes it first (runs after) — it can never slip between and
+        ship ahead of the pin.  Lock order release_lock → submit_lock;
+        nothing takes them in the reverse order."""
+        with self._release_lock:
+            if not self._pending_pins:
+                return
+            pins, self._pending_pins = self._pending_pins, {}
+            oids = [oid for oid, n in pins.items() for _ in range(n)]
+            with self._submit_lock:
+                self._submit_buf.append(("ref", {"object_ids": oids,
+                                                 "ledger": None}))
+                if not self._submit_first:
+                    self._submit_first = time.monotonic()
+                self._submit_pending.set()
 
     def _ensure_flusher_locked(self) -> None:
         # _submit_lock held
@@ -1205,6 +1307,7 @@ class Worker:
                     self._submit_buf[:0] = flush
                     if not self._submit_first:
                         self._submit_first = time.monotonic()
+                    self._submit_pending.set()
                     # ensure someone retries even if the flusher was
                     # never started (all-exact-64-batch history)
                     self._ensure_flusher_locked()
@@ -1218,14 +1321,27 @@ class Worker:
 
     def _submit_flusher(self) -> None:
         """Ships a lone buffered submit within ~2ms: fire-and-forget tasks
-        must not wait for a 64-deep batch that may never fill."""
+        must not wait for a 64-deep batch that may never fill.  Parks on
+        an event while the buffer is empty (zero wakeups when idle)."""
         while not self._stop.is_set():
-            time.sleep(0.002)
+            if not self._submit_pending.wait(timeout=1.0):
+                # idle tick: drain netted-pin survivors (refs the caller
+                # kept) — their only deadline is the GCS's 10s grace
+                with self._release_lock:
+                    pins = bool(self._pending_pins)
+                if pins:
+                    self._flush_submits()
+                continue
+            time.sleep(0.0015)  # let a burst coalesce into one batch
             with self._submit_lock:
-                due = bool(self._submit_buf) and \
-                    time.monotonic() - self._submit_first >= 0.0015
+                due = bool(self._submit_buf)
+                if not due:
+                    # nothing left: park until the next buffered item.  A
+                    # concurrent buffer-er re-sets the event AFTER
+                    # inserting, so this clear can never strand work.
+                    self._submit_pending.clear()
             if due:
-                self._drain_submits()
+                self._flush_submits()
 
     # ---------------------------------------------------------- actor client
     def create_actor(self, cls: Any, args: tuple, kwargs: dict, *,
@@ -1383,6 +1499,8 @@ class Worker:
                     self._send_event({"kind": "actor_ready",
                                       "reattach": True,
                                       **self._actor_announce})
+                self._open_ctl_conn()  # idempotent: the ctl thread
+                # re-dials on its own; this only covers a never-started one
                 logger.info("reattached task conn after GCS restart")
                 return c
             except (EOFError, OSError, ConnectionError):
@@ -1390,64 +1508,42 @@ class Worker:
         return None
 
     def run_worker_loop(self) -> None:
-        """Main loop of a spawned worker process."""
+        """Main loop of a spawned worker process.
+
+        Tasks execute directly on THIS thread, straight off the task-conn
+        recv — no reader→executor queue handoff (two scheduler wakeups per
+        task on small hosts, ~100-200µs measured).  Out-of-band control
+        (cancel / drop_queued / dump_stack / stop_worker) rides a second
+        ``ctl`` connection whose dedicated reader thread stays responsive
+        while a task runs; the same kinds are still honored here when they
+        arrive on the task conn (ctl-attach race fallback)."""
         conn = self.open_conn(self.gcs_path)
         conn.send({"kind": "attach_task_conn", "worker_id": self.worker_id})
         with self._task_conn_lock:
             self._task_conn = conn
-        import queue as _q
-        tasks: "_q.Queue" = _q.Queue()
-
-        def reader():
-            nonlocal conn
-            while not self._stop.is_set():
+        self._open_ctl_conn()
+        self._exec_thread_id = threading.get_ident()
+        from collections import deque as _deque
+        lookahead: "_deque" = _deque()  # frames pre-read by the OOB drain
+        while not self._stop.is_set():
+            if lookahead:
+                msg = lookahead.popleft()
+            else:
                 try:
                     msg = conn.recv()
                 except (EOFError, OSError):
+                    if self._stop.is_set():
+                        break
                     # head gone: outlive it and reattach (GCS fault
                     # tolerance) — actors keep serving direct calls the
                     # whole time; only the control-plane link heals.
                     conn = self._reattach_task_conn()
                     if conn is None:
                         self._stop.set()
-                        tasks.put(None)
-                        return
+                        break
                     continue
-                kind = msg.get("kind")
-                if kind == "cancel":
-                    self._cancel_current(msg["task_id"])
-                elif kind == "drop_queued":
-                    # the GCS revoked prepushed specs this worker holds
-                    # but hasn't started (pipeline reclaim, or cancel of
-                    # a queued spec).  Revocations are scoped by the
-                    # DISPATCH sequence the copy arrived under: a stale
-                    # drop (the copy already ran before the revocation
-                    # landed) can then never poison a later legitimate
-                    # re-dispatch of the same task id to this worker.
-                    for t, d in msg["pairs"]:
-                        self._dropped_ids[(t, d)] = None
-                    while len(self._dropped_ids) > 1024:
-                        self._dropped_ids.popitem(last=False)
-                elif kind == "dump_stack":
-                    # `ray_tpu stack` (reference: py-spy attach): dump all
-                    # threads from the reader thread — works mid-task and
-                    # inside actors
-                    self._send_event({"kind": "stack_dump",
-                                      "text": _dump_all_stacks()})
-                elif kind == "stop_worker":
-                    self._stop.set()
-                    tasks.put(None)
-                    return
-                else:
-                    tasks.put(msg)
-
-        threading.Thread(target=reader, name="task-conn-reader", daemon=True).start()
-        self._exec_thread_id = threading.get_ident()
-        while not self._stop.is_set():
-            msg = tasks.get()
-            if msg is None:
-                break
-            if msg["kind"] == "execute_task":
+            kind = msg.get("kind")
+            if kind == "execute_task":
                 dseq = msg.get("dseq")
                 self._execute_task(msg["spec"])
                 # prepushed lease-inheriting batch (one dispatch message
@@ -1455,14 +1551,145 @@ class Worker:
                 for spec in msg.get("queued", ()):
                     if self._stop.is_set():
                         break
+                    if self._ctl_down:
+                        # ctl channel unavailable: OOB frames (e.g. a
+                        # drop_queued revoking THESE prepushed specs after
+                        # a blocked-worker reclaim) fell back to this conn
+                        # — service them before running the next spec, or
+                        # a reclaimed spec also re-dispatched elsewhere
+                        # would double-execute
+                        self._drain_task_conn_oob(conn, lookahead)
                     if (spec["task_id"], dseq) in self._dropped_ids:
                         self._dropped_ids.pop((spec["task_id"], dseq), None)
                         continue
                     self._execute_task(spec)
-            elif msg["kind"] == "create_actor":
-                self._become_actor(msg["spec"], tasks)
+            elif kind == "create_actor":
+                if self._become_actor(msg["spec"]):
+                    break  # serve_forever returned: the actor exited
+                # creation failed: the GCS returns this worker to the
+                # idle pool — keep serving plain tasks on this conn
+            else:
+                self._handle_oob(msg)
         self._final_metrics_flush()
         sys.exit(0)
+
+    def _actor_conn_monitor(self) -> None:
+        """Task-conn reader for ACTOR workers: the main thread parks in
+        serve_forever, so this thread owns the control-plane link —
+        notices head death (EOF → reattach + re-announce) and handles
+        OOB kinds arriving on the task conn."""
+        with self._task_conn_lock:
+            conn = self._task_conn
+        while not self._stop.is_set() and conn is not None:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                if self._stop.is_set():
+                    return
+                conn = self._reattach_task_conn()
+                if conn is None:
+                    self._stop.set()
+                    return
+                continue
+            try:
+                self._handle_oob(msg)
+            except Exception:  # noqa: BLE001 - monitor must keep serving
+                logger.exception("actor conn message failed")
+
+    def _drain_task_conn_oob(self, conn, lookahead) -> None:
+        """Read any frames already queued on the task conn, handling OOB
+        kinds inline and deferring work frames to ``lookahead`` (only
+        used while the ctl channel is down — its fallback frames land
+        here and must not wait behind a prepush batch)."""
+        try:
+            while conn.poll(0):
+                m = conn.recv()
+                if m.get("kind") in ("execute_task", "create_actor"):
+                    lookahead.append(m)
+                else:
+                    self._handle_oob(m)
+        except (OSError, EOFError):
+            pass  # conn death is the main loop's recv to notice
+
+    def _handle_oob(self, msg: dict) -> None:
+        """Out-of-band control kinds (normally via the ctl conn; also
+        honored on the task conn while idle)."""
+        kind = msg.get("kind")
+        if kind == "cancel":
+            self._cancel_current(msg["task_id"])
+        elif kind == "drop_queued":
+            # the GCS revoked prepushed specs this worker holds but
+            # hasn't started (pipeline reclaim, or cancel of a queued
+            # spec).  Revocations are scoped by the DISPATCH sequence the
+            # copy arrived under: a stale drop (the copy already ran
+            # before the revocation landed) can then never poison a later
+            # legitimate re-dispatch of the same task id to this worker.
+            for t, d in msg["pairs"]:
+                self._dropped_ids[(t, d)] = None
+            while len(self._dropped_ids) > 1024:
+                self._dropped_ids.popitem(last=False)
+        elif kind == "dump_stack":
+            # `ray_tpu stack` (reference: py-spy attach): dump all
+            # threads — works mid-task and inside actors (ctl thread)
+            self._send_event({"kind": "stack_dump",
+                              "text": _dump_all_stacks()})
+        elif kind == "stop_worker":
+            self._stop.set()
+
+    def _open_ctl_conn(self) -> None:
+        """Start the out-of-band control channel thread (idempotent).
+        The thread owns dialing AND re-dialing: a ctl-only connection
+        failure must not permanently degrade mid-task cancel/stop to
+        between-task delivery (the task conn stays the liveness signal;
+        ctl is best-effort but self-healing)."""
+        if getattr(self, "_ctl_thread_on", False):
+            return
+        self._ctl_thread_on = True
+        threading.Thread(target=self._ctl_loop,
+                         name="worker-ctl", daemon=True).start()
+
+    def _ctl_loop(self) -> None:
+        conn = None
+        backoff = 0.5
+        while not self._stop.is_set():
+            if conn is None:
+                try:
+                    conn = self.open_conn(self.gcs_path)
+                    conn.send({"kind": "attach_worker_ctl",
+                               "worker_id": self.worker_id})
+                    self._ctl_down = False
+                    backoff = 0.5
+                except (OSError, EOFError, ConnectionError):
+                    conn = None
+                    self._ctl_down = True
+                    if self._stop.wait(backoff):
+                        return
+                    backoff = min(10.0, backoff * 2)
+                    continue
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = None  # head restarting / conn broke: re-dial
+                self._ctl_down = True
+                if self._stop.wait(0.5):
+                    return
+                continue
+            try:
+                self._handle_oob(msg)
+                if msg.get("kind") == "stop_worker":
+                    # the main thread is parked in task-conn recv (or an
+                    # actor's serve_forever): shut the task conn down so
+                    # its recv raises and the loop observes _stop
+                    with self._task_conn_lock:
+                        if self._task_conn is not None:
+                            protocol.shutdown_conn(self._task_conn)
+                    return
+            except Exception:  # noqa: BLE001 - control must keep serving
+                logger.exception("ctl message failed: %s", msg.get("kind"))
 
     def _cancel_current(self, task_id: str) -> None:
         spec = self._current_spec
@@ -1599,15 +1826,18 @@ class Worker:
                 value = fn(*args, **kwargs)
             results = self._store_results(spec["return_ids"], value,
                                           spec["num_returns"])
-            self._send_event({"kind": "task_done", "task_id": spec["task_id"],
-                              "status": "ok", "results": results})
+            done = {"kind": "task_done", "task_id": spec["task_id"],
+                    "status": "ok", "results": results}
+            self._attach_timeline_event(done, spec, t0, task_span)
+            self._send_event(done)
         except Exception as e:  # noqa: BLE001
             err = e if isinstance(e, exc.RayTaskError) else \
                 exc.RayTaskError.from_exception(spec.get("name", "task"), e)
-            self._send_event({
-                "kind": "task_done", "task_id": spec["task_id"],
-                "status": "app_error",
-                "error": serialize_to_bytes(err)[0]})
+            done = {"kind": "task_done", "task_id": spec["task_id"],
+                    "status": "app_error",
+                    "error": serialize_to_bytes(err)[0]}
+            self._attach_timeline_event(done, spec, t0, task_span)
+            self._send_event(done)
         finally:
             self._restore_runtime_env(saved_env)
             self._current_spec = None
@@ -1619,16 +1849,27 @@ class Worker:
                 mcat.get("rtpu_task_exec_seconds").observe(
                     time.monotonic() - t0m,
                     tags={"name": spec.get("name", "task")})
-            if GLOBAL_CONFIG.timeline_enabled:
-                ev = {"name": spec.get("name", "task"), "cat": "task",
-                      "ph": "X", "pid": self.node_id, "tid": os.getpid(),
-                      "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6}
-                if task_span is not None:
-                    ev["args"] = task_span.to_dict()
-                self._send_event({"kind": "profile_events", "events": [ev]})
+
+    def _attach_timeline_event(self, done_msg: dict, spec: dict, t0: float,
+                               task_span) -> None:
+        """Timeline profile event riding the task_done frame: one message
+        per task instead of two (the separate profile_events oneway was a
+        measured per-task head wakeup + handler on the serial hot path)."""
+        if not GLOBAL_CONFIG.timeline_enabled:
+            return
+        ev = {"name": spec.get("name", "task"), "cat": "task",
+              "ph": "X", "pid": self.node_id, "tid": self._pid,
+              "ts": t0 * 1e6, "dur": (time.time() - t0) * 1e6}
+        if task_span is not None:
+            ev["args"] = task_span.to_dict()
+        done_msg["events"] = [ev]
 
     # ------------------------------------------------------------ actor side
-    def _become_actor(self, spec: dict, task_queue) -> None:
+    def _become_actor(self, spec: dict) -> bool:
+        """Instantiate the actor and serve its method calls.  Returns True
+        when the actor served and exited (worker process is done), False
+        when CREATION failed — the GCS puts this worker back in the idle
+        pool, so the caller must return to the plain task loop."""
         from ray_tpu._private.actor_server import ActorServer
         self._current_spec = spec
         try:
@@ -1648,7 +1889,7 @@ class Worker:
                               "status": "error",
                               "error": serialize_to_bytes(err)[0]})
             self._current_spec = None
-            return
+            return False
         self._current_spec = None
         server = ActorServer(self, spec, instance)
         # kept for GCS-restart reattach: the actor re-announces itself to
@@ -1657,9 +1898,13 @@ class Worker:
                                 "status": "ok", "addr": server.addr}
         self._send_event({"kind": "actor_ready", "actor_id": spec["actor_id"],
                           "status": "ok", "addr": server.addr})
+        # the main thread parks in serve_forever below: hand the task conn
+        # to a monitor thread (head-death reattach, OOB fallback)
+        threading.Thread(target=self._actor_conn_monitor,
+                         name="actor-conn-monitor", daemon=True).start()
         server.serve_forever()  # returns on exit_actor / stop
         self._stop.set()
-        task_queue.put(None)
+        return True
 
 
 class _ActorChannel:

@@ -179,7 +179,10 @@ def cmd_timeline(args) -> int:
 
 def cmd_microbenchmark(args) -> int:
     from ray_tpu._private import ray_perf
-    ray_perf.main(quick=args.quick)
+    results = ray_perf.main(quick=args.quick, json_path=args.json,
+                            label=args.label)
+    if args.assert_sane:
+        ray_perf.assert_sane(results)
     return 0
 
 
@@ -256,6 +259,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("microbenchmark", help="run the core perf suite")
     sp.add_argument("--quick", action="store_true")
+    sp.add_argument("--json", default=None, metavar="PATH",
+                    help="merge results into a JSON artifact at PATH")
+    sp.add_argument("--label", default=None,
+                    help="run label inside the JSON artifact (e.g. pre/post)")
+    sp.add_argument("--assert-sane", action="store_true",
+                    help="CI smoke: fail on hangs / implausible latency")
     sp.set_defaults(fn=cmd_microbenchmark)
 
     sp = sub.add_parser("operator", add_help=False,

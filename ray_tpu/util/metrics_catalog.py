@@ -32,6 +32,12 @@ from ray_tpu.util import metrics as _metrics
 LATENCY_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# Microsecond-scale buckets for control-plane handler CPU (a hot-kind
+# handler at its floor runs in tens of µs; the ms range is the
+# contention tail we watch for).
+HOT_HANDLER_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                       0.005, 0.01, 0.05, 0.25, 1.0)
+
 # name -> {kind, description, tag_keys, buckets?, emitted_by}
 # ``emitted_by`` is documentation: which process's registry carries the
 # series (collect_cluster adds the disambiguating ``worker`` tag).
@@ -66,6 +72,28 @@ CATALOG: Dict[str, dict] = {
         kind="counter", tag_keys=("class",),
         description="Actor restarts triggered by worker death "
                     "(max_restarts budget consumed)",
+        emitted_by="head (GCS)"),
+    # --- control-plane fast path (GCS hot kinds) ----------------------------
+    "rtpu_gcs_hot_handler_seconds": dict(
+        kind="histogram", tag_keys=("kind",), buckets=HOT_HANDLER_BUCKETS,
+        description="GCS hot-kind handler time (get_meta_fast = lock-free "
+                    "sealed read; get_meta_scan = slow-path scan; "
+                    "submit_batch / task_done / actor_result / put_object "
+                    "= apply under the global lock; ref_drain = one "
+                    "coalesced refcount batch)",
+        emitted_by="head (GCS)"),
+    "rtpu_gcs_lock_wait_seconds": dict(
+        kind="gauge", tag_keys=("lock",),
+        description="Last observed wait to acquire a GCS lock on an "
+                    "instrumented hot path (contention probe, not a "
+                    "cumulative meter)",
+        emitted_by="head (GCS)"),
+    "rtpu_gcs_ref_ops_total": dict(
+        kind="counter", tag_keys=("path",),
+        description="Refcount-plane ops applied, by path: 'coalesced' = "
+                    "batched per-connection drain (one lock acquisition "
+                    "per batch), 'inline' = per-call handler (in-process "
+                    "short circuit / direct RPC)",
         emitted_by="head (GCS)"),
     # --- serve data plane ---------------------------------------------------
     "rtpu_serve_requests_total": dict(

@@ -1,0 +1,273 @@
+"""Threaded hammer for the GCS fast-path locking (PR: control-plane fast
+path).  The lock split (lock-free sealed-object reads, waiters under their
+own lock, per-connection refcount coalescing) creates a race surface the
+single-global-lock design never had; these tests drive it from many
+threads on OVERLAPPING object ids and assert the refcount invariants the
+protocol-sim fuzz checks single-threaded:
+
+- concurrent seal / add_ref / release / get_meta / client-death cleanup:
+  the server's ledgers match a model oracle exactly; no entry leaks, no
+  double-free (an object dies exactly when its count reaches zero).
+- the sealed-object read path really is independent of the global lock:
+  get_meta / peek_meta / wait on sealed objects complete while another
+  thread HOLDS the global lock.
+- coalesced refcount oneways over a real socket apply in stream order
+  (a release can never overtake the pin it retires), and a non-refcount
+  frame flushes the buffered batch first.
+- a pin landing after release_all tore its ledger down is dropped (the
+  late-pin race coalescing widens), not leaked.
+"""
+
+import threading
+import time
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import gcs as gcs_mod
+from ray_tpu._private import protocol
+
+
+def _put_inline(head, client, oid, data=b"x"):
+    head._h_put_object({"client_id": client, "object_id": oid,
+                        "loc": "inline", "data": data, "size": len(data),
+                        "contained": []})
+
+
+def test_concurrent_refcount_hammer(ray_start_regular):
+    """8 threads × shared oid pool × {seal, add_refs, release_batch,
+    get_meta, peek} + client-death cleanup, checked against a model."""
+    head = ray_tpu._head
+    n_threads = 8
+    n_oids = 48
+    steps = 400
+    clients = [f"hammer{i:02d}" for i in range(n_threads)]
+    oids = [f"hammerobj{i:04d}" for i in range(n_oids)]
+    # every oid sealed up front under a holder client that keeps it alive
+    holder = "hammerholder"
+    for oid in oids:
+        _put_inline(head, holder, oid)
+
+    model_lock = threading.Lock()
+    model = {c: {} for c in clients}  # client -> oid -> count
+    errors = []
+
+    def worker(idx):
+        rng = random.Random(1000 + idx)
+        me = clients[idx]
+        try:
+            for _ in range(steps):
+                op = rng.random()
+                oid = rng.choice(oids)
+                if op < 0.35:
+                    with model_lock:
+                        model[me][oid] = model[me].get(oid, 0) + 1
+                    head._h_add_refs({"client_id": me,
+                                      "object_ids": [oid]})
+                elif op < 0.70:
+                    with model_lock:
+                        if model[me].get(oid, 0) > 0:
+                            model[me][oid] -= 1
+                            if not model[me][oid]:
+                                del model[me][oid]
+                            do = True
+                        else:
+                            do = False
+                    if do:
+                        head._h_release_batch({"client_id": me,
+                                               "object_ids": [oid]})
+                elif op < 0.85:
+                    metas = head._h_get_meta(
+                        {"object_ids": [oid]})["metas"]
+                    if metas[oid]["state"] != "ready":
+                        errors.append(f"{oid} not ready: {metas[oid]}")
+                else:
+                    head._h_peek_meta({"object_ids": [oid]})
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors[:5]
+
+    # oracle: server ledgers match the model exactly
+    with head.lock:
+        for c in clients:
+            srv = head.client_refs.get(c, {})
+            with model_lock:
+                want = {o: n for o, n in model[c].items() if n > 0}
+            got = {o: n for o, n in srv.items() if o.startswith("hammerobj")}
+            assert got == want, (c, got, want)
+        # holder pin kept everything alive: every oid still sealed and
+        # published on the lock-free read table
+        for oid in oids:
+            assert head.objects[oid].state == "ready"
+            assert oid in head._sealed
+
+    # client-death cleanup: kill half the hammer clients' ledgers the way
+    # a task-conn EOF does, then verify the refcounts dropped exactly
+    for c in clients[:4]:
+        w = gcs_mod.WorkerState(c, head.head_node_id, pid=0)
+        with head.cv:
+            head._handle_worker_death(w)
+    with head.lock:
+        for c in clients[:4]:
+            assert not head.client_refs.get(c), c
+        for oid in oids:  # holder + surviving clients keep them alive
+            assert head.objects[oid].refcount >= 1
+
+    # full teardown: drop every surviving ref; objects must die exactly
+    # then (no leak), and the sealed read table must unpublish
+    for c in clients[4:]:
+        with model_lock:
+            for oid, n in list(model[c].items()):
+                if n > 0:
+                    head._h_release_batch({"client_id": c,
+                                           "object_ids": [oid] * n})
+    head._h_release_batch({"client_id": holder, "object_ids": oids})
+    with head.lock:
+        for oid in oids:
+            assert oid not in head.objects, "leaked meta"
+            assert oid not in head._sealed, "leaked sealed entry"
+
+
+def test_sealed_reads_do_not_take_global_lock(ray_start_regular):
+    """get_meta / peek_meta / wait on sealed objects answer while another
+    thread HOLDS the global lock — the acceptance criterion of the fast
+    path (a blocked scheduler must not block sealed-object reads)."""
+    head = ray_tpu._head
+    oids = [f"lockfree{i:02d}" for i in range(4)]
+    for oid in oids:
+        _put_inline(head, "lf-client", oid)
+    out = {}
+
+    def reader():
+        out["get"] = head._h_get_meta({"object_ids": oids})["metas"]
+        out["peek"] = head._h_peek_meta({"object_ids": oids})["metas"]
+        out["wait"] = head._h_wait({"object_ids": oids,
+                                    "num_returns": len(oids),
+                                    "timeout": 0})
+
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def lock_holder():
+        with head.lock:
+            acquired.set()
+            release.wait(timeout=30)
+
+    t_hold = threading.Thread(target=lock_holder)
+    t_hold.start()
+    assert acquired.wait(10)
+    t_read = threading.Thread(target=reader)
+    t_read.start()
+    t_read.join(timeout=5)  # must NOT need the (held) global lock
+    still_blocked = t_read.is_alive()
+    release.set()
+    t_hold.join(10)
+    t_read.join(10)
+    assert not still_blocked, "sealed-object read blocked on the global lock"
+    assert all(m["state"] == "ready" for m in out["get"].values())
+    assert all(m["state"] == "ready" for m in out["peek"].values())
+    assert set(out["wait"]["ready"]) == set(oids)
+    head._h_release_batch({"client_id": "lf-client", "object_ids": oids})
+
+
+def test_coalesced_ref_stream_order_over_socket(ray_start_regular):
+    """Refcount oneways ride the per-connection coalescing queue: bursts
+    apply in stream order under one lock acquisition, and a two-way frame
+    drains the buffer before it is served (per-connection FIFO)."""
+    head = ray_tpu._head
+    oid = "coalesce0001"
+    _put_inline(head, "co-holder", oid)
+    ch = protocol.RpcChannel(protocol.connect(head.rpc_path),
+                             negotiate=True)
+    try:
+        # pin/unpin burst, net +3: ordering matters — if any release
+        # overtook its pin, the guarded release would no-op and the
+        # final count would exceed 3
+        for _ in range(32):
+            ch.send_oneway("add_refs", client_id="co-client",
+                           object_ids=[oid])
+            ch.send_oneway("release", client_id="co-client",
+                           object_id=oid)
+        for _ in range(3):
+            ch.send_oneway("add_refs", client_id="co-client",
+                           object_ids=[oid])
+        # two-way frame on the same conn: observes every prior oneway
+        ch.call("ping")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with head.lock:
+                got = head.client_refs.get("co-client", {}).get(oid, 0)
+            if got == 3:
+                break
+            time.sleep(0.01)
+        assert got == 3, got
+    finally:
+        ch.close()
+    head._h_release_batch({"client_id": "co-client", "object_ids": [oid] * 3})
+    head._h_release_batch({"client_id": "co-holder", "object_ids": [oid]})
+
+
+def test_late_pin_after_release_all_is_dropped(ray_start_regular):
+    """release_all closes its ledger: an add_refs for that ledger landing
+    late (the cross-channel race) must be dropped, not leak a pin."""
+    head = ray_tpu._head
+    oid = "latepin0001"
+    _put_inline(head, "lp-holder", oid)
+    ledger = "call:latepin-test"
+    head._h_add_refs({"client_id": "lp-caller", "ledger": ledger,
+                      "object_ids": [oid]})
+    with head.lock:
+        rc_pinned = head.objects[oid].refcount
+    head._h_release_all({"client_id": "actor", "ledger": ledger})
+    # the late (replayed) pin: must NOT resurrect the closed ledger
+    head._h_add_refs({"client_id": "lp-caller", "ledger": ledger,
+                      "object_ids": [oid]})
+    with head.lock:
+        assert ledger not in head.client_refs or \
+            not head.client_refs[ledger]
+        assert head.objects[oid].refcount == rc_pinned - 1
+    head._h_release_batch({"client_id": "lp-holder", "object_ids": [oid]})
+    with head.lock:
+        assert oid not in head.objects
+
+
+def test_waiter_wake_on_concurrent_seal(ray_start_regular):
+    """Blocking get_meta parked under the waiter lock is woken by a seal
+    that runs entirely under the global lock (the registration-gap
+    handshake through the sealed table)."""
+    head = ray_tpu._head
+    results = {}
+    n = 24
+
+    def getter(i):
+        oid = f"race{i:04d}"
+        try:
+            results[i] = head._h_get_meta(
+                {"object_ids": [oid], "timeout": 30})["metas"][oid]
+        except Exception as e:  # noqa: BLE001
+            results[i] = e
+
+    threads = [threading.Thread(target=getter, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    # seal while getters are registering (some before, some after)
+    for i in range(n):
+        if i % 3 == 0:
+            time.sleep(0.002)
+        _put_inline(head, "race-client", f"race{i:04d}")
+    for t in threads:
+        t.join(60)
+    assert all(not t.is_alive() for t in threads)
+    for i in range(n):
+        assert not isinstance(results[i], Exception), results[i]
+        assert results[i]["state"] == "ready"
+    head._h_release_batch({"client_id": "race-client",
+                           "object_ids": [f"race{i:04d}" for i in range(n)]})
