@@ -2,21 +2,30 @@
 
 PY ?= python
 
-.PHONY: test sanitize fuzz bench lint check-metrics microbench-quick
+.PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick
 
 test:
 	$(PY) -m pytest tests/ -x -q
 
 # Lint gate (SURVEY.md §4 CI row): dependency-free flake8/clang-format
-# stand-in — ast checks for Python, g++ -fsyntax-only -Wall for C++.
+# stand-in — ast checks for Python, g++ -fsyntax-only -Wall for C++ —
+# plus rtlint, the repo-specific concurrency/protocol analyzer.
 lint:
 	$(PY) tools/lint.py
-	$(PY) tools/check_metrics_catalog.py
+	$(PY) -m tools.rtlint
+
+# rtlint (DESIGN.md §4d): machine-enforces the GCS locking discipline
+# (lock-order DAG, no blocking under leaf locks), guarded-field
+# annotations, wire-protocol exhaustiveness, spawned-thread hygiene,
+# and metrics-catalog honesty.  Fixture corpus: tests/rtlint_fixtures/.
+rtlint:
+	$(PY) -m tools.rtlint
 
 # Every built-in rtpu_* metric used in the tree must be declared in
-# ray_tpu/util/metrics_catalog.py (also runs as part of `make lint`).
+# ray_tpu/util/metrics_catalog.py — and every declared one must be live
+# (rtlint's metrics pass; also runs as part of `make lint`/`rtlint`).
 check-metrics:
-	$(PY) tools/check_metrics_catalog.py
+	$(PY) -m tools.rtlint --pass metrics
 
 # ASAN + TSAN over the native slab store (SURVEY.md §5.2): longer runs
 # than the in-suite smoke (tests/test_native_sanitizers.py).

@@ -75,22 +75,10 @@ class ActorServer:
 
     # ------------------------------------------------------------- transport
     def _accept_loop(self) -> None:
-        from multiprocessing import AuthenticationError
-        while not self._stopped.is_set():
-            try:
-                conn = self._listener.accept()
-            except (OSError, EOFError, AuthenticationError):
-                # accept() runs the HMAC handshake, so a half-open probe,
-                # port scan, or bad key surfaces HERE — that is a
-                # per-connection failure, not listener shutdown (TCP
-                # listeners are internet-facing on remote-agent hosts).
-                # Only _shutdown() closing the listener ends the loop.
-                if self._stopped.is_set():
-                    return
-                time.sleep(0.01)  # a dead listener fd must not spin-loop
-                continue
-            threading.Thread(target=self._conn_reader, args=(conn,),
-                             daemon=True).start()
+        # TCP listeners are internet-facing on remote-agent hosts, so
+        # half-open probes and port scans hit this accept path routinely.
+        protocol.serve_accept_loop(self._listener, self._stopped.is_set,
+                                   self._conn_reader, "actor-conn-reader")
 
     def _conn_reader(self, conn) -> None:
         while not self._stopped.is_set():
@@ -112,8 +100,9 @@ class ActorServer:
 
     def serve_forever(self) -> None:
         if self.max_concurrency > 1:
-            threads = [threading.Thread(target=self._exec_loop, daemon=True)
-                       for _ in range(self.max_concurrency - 1)]
+            threads = [threading.Thread(target=self._exec_loop, daemon=True,
+                                        name=f"actor-exec-{i}")
+                       for i in range(self.max_concurrency - 1)]
             for t in threads:
                 t.start()
         self._exec_loop()

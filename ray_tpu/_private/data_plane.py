@@ -132,13 +132,8 @@ class DataPlaneServer:
                          daemon=True).start()
 
     def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn = self._listener.accept()
-            except (OSError, EOFError):
-                break
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+        protocol.serve_accept_loop(self._listener, self._stop.is_set,
+                                   self._serve, "data-plane-serve")
 
     def _serve(self, conn) -> None:
         try:

@@ -214,18 +214,12 @@ class GcsServer:
             self.slab = SlabStore.create(
                 session.slab_path(),
                 GLOBAL_CONFIG.slab_memory_mb * 1024 * 1024)
+        # --- lock domains (DESIGN.md §4c; DAG in lock_watchdog.py) ---
+        # All six domain locks are created together, BEFORE any server
+        # thread starts, so RAY_TPU_LOCK_WATCHDOG=1 can wrap the complete
+        # set and assert the acquisition DAG at runtime (§4d).
         self.lock = threading.RLock()
         self.cv = threading.Condition(self.lock)
-        # Fast-path tables (GCS locking discipline, DESIGN.md §4c):
-        # ``_sealed`` maps oid -> a reply-ready meta dict for objects in a
-        # terminal state.  Written ONLY under self.lock (at seal / delete /
-        # loss transitions), read LOCK-FREE (CPython dict reads are atomic
-        # under the GIL) by get_meta/peek_meta/wait — the sealed-object
-        # read path never touches the global lock.  Remote-spooled objects
-        # appear only as markers (terminal-state visibility for the
-        # waiter handshake and peek/wait); their replies need a live
-        # node-table address lookup, so reads fall to the slow path.
-        self._sealed: Dict[str, dict] = {}
         # Object waiters under their own lock: seals (global lock held)
         # take it briefly to wake the exact blocked get/wait RPCs;
         # waiter registration/unregistration never touches the global
@@ -236,59 +230,94 @@ class GcsServer:
         # contend with the scheduler.  Lock order: self.lock -> _kv_lock.
         self._kv_lock = threading.Lock()
         self._events_lock = threading.Lock()  # timeline event buffer
+        self._dedup_lock = threading.Lock()   # reply-replay cache
+        # remote-spool delete queue (leaf under the global lock: _decref
+        # enqueues while holding it)
+        self._peer_delete_lock = threading.Lock()
+        # snapshot writer ordering lock — ABOVE the global lock in the
+        # DAG (capture under lock, write file under persist only)
+        self._persist_lock = threading.Lock()
+        from ray_tpu._private.lock_watchdog import watchdog_enabled, \
+            wrap_gcs_locks
+        if watchdog_enabled():
+            wrap_gcs_locks(self)
 
-        self.nodes: Dict[str, NodeState] = {}
-        self.workers: Dict[str, WorkerState] = {}
-        self.objects: Dict[str, ObjMeta] = {}
+        # Fast-path tables (GCS locking discipline, DESIGN.md §4c):
+        # ``_sealed`` maps oid -> a reply-ready meta dict for objects in a
+        # terminal state.  Written ONLY under self.lock (at seal / delete /
+        # loss transitions), read LOCK-FREE (CPython dict reads are atomic
+        # under the GIL) by get_meta/peek_meta/wait — the sealed-object
+        # read path never touches the global lock.  Remote-spooled objects
+        # appear only as markers (terminal-state visibility for the
+        # waiter handshake and peek/wait); their replies need a live
+        # node-table address lookup, so reads fall to the slow path.
+        self._sealed: Dict[str, dict] = {}   # guarded by: lock (writes)
+
+        self.nodes: Dict[str, NodeState] = {}          # guarded by: lock
+        self.workers: Dict[str, WorkerState] = {}      # guarded by: lock
+        self.objects: Dict[str, ObjMeta] = {}          # guarded by: lock
+        # guarded by: lock
         self.client_refs: Dict[str, Dict[str, int]] = defaultdict(dict)
-        self.pending_tasks: deque = deque()
+        self.pending_tasks: deque = deque()            # guarded by: lock
         # backlog composition by resource class (see _push_pending)
+        # guarded by: lock
         self._pending_counts: Dict[str, int] = {
             "cpu": 0, "tpu": 0, "zero": 0, "special": 0}
-        self.dep_waiting: Dict[str, List[dict]] = {}
+        self.dep_waiting: Dict[str, List[dict]] = {}   # guarded by: lock
         # oid → waiter records for blocked get/wait RPCs: seals wake the
         # exact waiters instead of notify_all-storming every blocked call
         # into an O(oids) rescan (that was quadratic in batch gets)
+        # guarded by: _waiter_lock
         self._object_waiters: Dict[str, List[dict]] = {}
-        self._stack_reqs: List[Dict[str, str]] = []  # `ray_tpu stack` calls
-        self.infeasible_tasks: List[dict] = []
-        self.running: Dict[str, Tuple[str, dict]] = {}   # task_id -> (worker, spec)
-        self.actors: Dict[str, ActorState] = {}
-        self.named_actors: Dict[Tuple[str, str], str] = {}
-        self.functions: Dict[str, bytes] = {}
+        # `ray_tpu stack` calls                          guarded by: lock
+        self._stack_reqs: List[Dict[str, str]] = []
+        self.infeasible_tasks: List[dict] = []         # guarded by: lock
+        # task_id -> (worker, spec)                      guarded by: lock
+        self.running: Dict[str, Tuple[str, dict]] = {}
+        self.actors: Dict[str, ActorState] = {}        # guarded by: lock
+        self.named_actors: Dict[Tuple[str, str], str] = {}  # guarded by: lock
+        self.functions: Dict[str, bytes] = {}          # guarded by: lock
+        # guarded by: _kv_lock
         self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)
-        self.pgs: Dict[str, PgState] = {}
-        self.lineage: Dict[str, dict] = {}
-        self.lineage_order: deque = deque(maxlen=20000)
-        self.events: List[dict] = []                      # timeline events
-        self.dead_clients: Set[str] = set()
-        self._staging: Dict[str, dict] = {}   # in-flight chunked uploads
-        self._remote_pulls: Dict[str, threading.Event] = {}  # relay dedup
-        self._graceful_free: Dict[str, float] = {}  # rc-0-at-seal grace
+        self.pgs: Dict[str, PgState] = {}              # guarded by: lock
+        self.lineage: Dict[str, dict] = {}             # guarded by: lock
+        self.lineage_order: deque = deque(maxlen=20000)  # guarded by: lock
+        # timeline events                        guarded by: _events_lock
+        self.events: List[dict] = []
+        self.dead_clients: Set[str] = set()            # guarded by: lock
+        # in-flight chunked uploads                      guarded by: lock
+        self._staging: Dict[str, dict] = {}
+        # relay dedup                                    guarded by: lock
+        self._remote_pulls: Dict[str, threading.Event] = {}
+        # rc-0-at-seal grace                             guarded by: lock
+        self._graceful_free: Dict[str, float] = {}
         self._last_metrics_sweep = 0.0        # dead-snapshot KV hygiene
         # head-side receipt time per __metrics__/ key: the sweep's grace
         # window must not trust publisher-host wall clocks (cross-host
         # skew > grace would reap a dying worker's final flush instantly)
+        # guarded by: _kv_lock
         self._metrics_key_seen: Dict[str, float] = {}
         # reply cache for client-supplied request ids: makes the worker's
         # one post-reconnect retry exactly-once against a still-live GCS
         # (non-idempotent mutations must not double-apply when only the
         # channel broke, not the server)
+        # guarded by: _dedup_lock
         self._dedup_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        # guarded by: _dedup_lock
         self._dedup_pending: Dict[tuple, threading.Event] = {}
-        self._dedup_lock = threading.Lock()
         # Ledgers already torn down by release_all (lock held): a pin for
         # a closed call ledger arriving LATE (cross-channel race — the
         # caller's add_refs coalescing in flight while the actor's
         # release_all lands) must be dropped, not applied; an orphaned
         # ledger entry would pin its objects forever.
+        # guarded by: lock
         self._closed_ledgers: "OrderedDict[str, None]" = OrderedDict()
         # remote-spool deletions, batched per holder node (see _decref);
         # the drain thread starts below, after _shutdown exists
+        # guarded by: _peer_delete_lock
         self._peer_delete_q: Dict[str, List[str]] = defaultdict(list)
-        self._peer_delete_lock = threading.Lock()
         self._peer_delete_event = threading.Event()
-        self.driver_ids: Set[str] = set()
+        self.driver_ids: Set[str] = set()              # guarded by: lock
         self.log_sink = None                              # callable(line)
         self._shutdown = False
         self._spawn_counter = 0
@@ -308,16 +337,20 @@ class GcsServer:
         # worker-pool prestart): fork N plain workers NOW so the first
         # tasks — and Serve replica scale-ups (SURVEY.md §7.3 TPU cold
         # starts) — skip the worker-process boot (~10s on 1-core hosts,
-        # measured in serve_bench_r04.json).
-        for _ in range(int(GLOBAL_CONFIG.prestart_workers or 0)):
-            self._spawn_worker(self.head_node_id)
+        # measured in serve_bench_r04.json).  Under the lock: the peer-
+        # delete and persist threads are already running, and
+        # _spawn_worker mutates the worker table (rtlint unguarded).
+        with self.lock:
+            for _ in range(int(GLOBAL_CONFIG.prestart_workers or 0)):
+                self._spawn_worker(self.head_node_id)
 
         # GCS fault tolerance (reference: GCS restart w/ Redis persistence,
         # SURVEY.md §5.3): durable tables snapshot to <session>/gcs_state;
         # a head started over a session dir that has one restores them and
         # gives surviving worker processes a grace window to reattach.
         self._snapshot_path = session.path / "gcs_state" / "snapshot.pkl"
-        self._persist_lock = threading.Lock()
+        # (_persist_lock is created with the other lock domains above so
+        # the watchdog wrap covers it)
         self._persist_event = threading.Event()
         self._restored_at: Optional[float] = None
         if GLOBAL_CONFIG.gcs_snapshot and self._snapshot_path.exists():
@@ -707,7 +740,8 @@ class GcsServer:
                     live = {n.data_addr for n in self.nodes.values()
                             if n.alive and n.data_addr}
                 threads = [threading.Thread(target=delete_batch_on_peer,
-                                            args=(addr, oids), daemon=True)
+                                            args=(addr, oids), daemon=True,
+                                            name="gcs-peer-delete-batch")
                            for addr, oids in batches.items() if addr in live]
                 for t in threads:
                     t.start()
@@ -1472,13 +1506,9 @@ class GcsServer:
 
     # -------------------------------------------------------------- rpc server
     def _accept_loop(self) -> None:
-        while not self._shutdown:
-            try:
-                conn = self._listener.accept()
-            except (OSError, EOFError):
-                break
-            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
-            t.start()
+        protocol.serve_accept_loop(self._listener,
+                                   lambda: self._shutdown,
+                                   self._serve_conn, "gcs-serve-conn")
 
     def _serve_conn(self, conn) -> None:
         from ray_tpu._private import wire
@@ -2893,19 +2923,23 @@ class GcsServer:
                 "the '__metrics__/' KV prefix is reserved for metric "
                 "snapshot publishing (ephemeral, auto-reaped); store "
                 "application data under a different key")
+        metrics_key = is_metrics_key(msg["key"])
         with self._kv_lock:
             ns = self.kv[msg.get("namespace", "default")]
             existed = msg["key"] in ns
             if not (msg.get("overwrite", True) is False and existed):
                 ns[msg["key"]] = msg["value"]
-        if not is_metrics_key(msg["key"]):
+            if metrics_key:
+                # receipt index shares _kv_lock with the sweep (rtlint
+                # unguarded: a bare-dict update raced the sweep's
+                # iterate+pop)
+                self._metrics_key_seen[msg["key"]] = time.monotonic()
+        if not metrics_key:
             # telemetry snapshots are ephemeral by design (re-published
             # every period, reaped when the publisher dies) — every
             # process's publisher dirtying the durable snapshot each
             # cycle would turn steady-state idle into constant disk churn
             self._persist_durable()
-        else:
-            self._metrics_key_seen[msg["key"]] = time.monotonic()
         return {"existed": existed}
 
     def _h_kv_get(self, msg: dict) -> dict:
@@ -2913,16 +2947,16 @@ class GcsServer:
             return {"value": self.kv[msg.get("namespace", "default")].get(msg["key"])}
 
     def _h_kv_del(self, msg: dict) -> dict:
+        metrics_key = is_metrics_key(msg["key"])
         with self._kv_lock:
             existed = self.kv[msg.get("namespace", "default")].pop(msg["key"], None)
-        if existed is not None:
-            if is_metrics_key(msg["key"]):
+            if existed is not None and metrics_key:
                 self._metrics_key_seen.pop(msg["key"], None)
-            else:
-                # same ephemeral-telemetry exemption as _h_kv_put:
-                # metrics keys are excluded from the snapshot, so reaping
-                # one must not rewrite the durable state for nothing
-                self._persist_durable()
+        if existed is not None and not metrics_key:
+            # same ephemeral-telemetry exemption as _h_kv_put: metrics
+            # keys are excluded from the snapshot, so reaping one must
+            # not rewrite the durable state for nothing
+            self._persist_durable()
         return {"deleted": existed is not None}
 
     def _h_kv_mget(self, msg: dict) -> dict:
@@ -3221,7 +3255,7 @@ class GcsServer:
             # or relay-fallback traffic accumulates dead files on A
             from ray_tpu._private.data_plane import delete_on_peer
             threading.Thread(target=delete_on_peer, args=(addr, oid),
-                             daemon=True).start()
+                             daemon=True, name="gcs-peer-delete-one").start()
             return True
         except (OSError, EOFError, FileNotFoundError, ConnectionError):
             return False

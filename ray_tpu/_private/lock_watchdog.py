@@ -1,0 +1,233 @@
+"""Canonical lock-order DAGs + an opt-in runtime lock-order watchdog.
+
+DESIGN.md §4c documents the GCS locking discipline in prose; this module
+is its machine-readable form and the ONE source of truth for lock order:
+
+- ``tools/rtlint`` (the static analyzer, DESIGN.md §4d) imports
+  ``GCS_LOCK_DAG`` / ``WORKER_LOCK_DAG`` and fails the build on any
+  acquisition edge in ``gcs.py`` / ``worker.py`` outside them;
+- ``RAY_TPU_LOCK_WATCHDOG=1`` wraps the live GCS locks in
+  :class:`WatchdogLock`, which records actual acquisition stacks and
+  asserts the SAME DAG at runtime — the chaos suite's dynamic oracle for
+  the static rules (tests/test_gcs_locking.py).
+
+An acquisition of ``inner`` while holding ``outer`` is legal iff
+``inner`` is reachable from ``outer`` in the DAG (or ``outer == inner``:
+RLock reentry cannot deadlock).  Leaf locks have empty successor sets —
+nothing may be acquired under them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Dict, List, Set, Tuple
+
+# GcsServer lock domains (DESIGN.md §4c).  Canonical names are the
+# attribute names, with ``cv`` folded into ``lock`` (the Condition wraps
+# the same RLock).  ``task_conn_lock``/``ctl_conn_lock`` are per-
+# WorkerState but are acquired by GCS threads holding the global lock
+# (worker pushes happen inside the scheduler's critical section).
+GCS_LOCK_DAG: Dict[str, Set[str]] = {
+    "_persist_lock": {"lock"},   # snapshot writer: capture under the
+    #                              global lock, write under persist only
+    "lock": {"_waiter_lock", "_kv_lock", "_events_lock",
+             "_peer_delete_lock", "task_conn_lock", "ctl_conn_lock"},
+    "_waiter_lock": set(),
+    "_kv_lock": set(),
+    "_events_lock": set(),
+    "_dedup_lock": set(),
+    "_peer_delete_lock": set(),
+    "task_conn_lock": set(),
+    "ctl_conn_lock": set(),
+}
+
+# Leaf locks whose critical sections must stay O(dict op): calling a
+# blocking primitive (socket send/recv, condition wait, sleep, file I/O)
+# while holding one is an rtlint error.  ``_persist_lock`` is excluded
+# by design — it IS the snapshot writer's file-I/O ordering lock — and
+# the conn locks are excluded because pushes deliberately ride them
+# (bounded local-pipe sends, documented in §4c).
+GCS_NOBLOCK_LOCKS: Set[str] = {
+    "_waiter_lock", "_kv_lock", "_events_lock", "_dedup_lock",
+    "_peer_delete_lock"}
+
+# Condition → underlying-lock aliases: ``with self.cv`` acquires
+# ``lock``; ``cv.wait()`` releases it (so a wait is only "blocking while
+# holding X" for the OTHER locks held at that point).
+GCS_CV_ALIASES: Dict[str, str] = {"cv": "lock"}
+
+# Worker (client-side) lock domains — see the declaration comments in
+# worker.py for the ordering arguments.
+WORKER_LOCK_DAG: Dict[str, Set[str]] = {
+    "_release_lock": {"_submit_lock"},       # _drain_pending_pins
+    # _drain_submits pop→send, and the send may first-dial the shared
+    # oneway channel (rpc_oneway's lazy init) while serialized
+    "_submit_send_lock": {"_submit_lock", "_oneway_init_lock"},
+    "_submit_lock": set(),
+    "_local_lock": set(),
+    "_actor_chan_lock": set(),
+    "_pull_lock": set(),
+    "_owned_lock": set(),
+    "_oneway_init_lock": set(),
+    "_task_conn_lock": set(),
+}
+
+WORKER_NOBLOCK_LOCKS: Set[str] = {
+    "_release_lock", "_submit_lock", "_local_lock", "_owned_lock",
+    "_pull_lock"}
+
+WORKER_CV_ALIASES: Dict[str, str] = {"_local_cv": "_local_lock"}
+
+
+def reachable(dag: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+    """Transitive closure: lock → every lock legally acquirable under it."""
+    closure: Dict[str, Set[str]] = {}
+    for start in dag:
+        seen: Set[str] = set()
+        stack = list(dag.get(start, ()))
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(dag.get(n, ()))
+        closure[start] = seen
+    return closure
+
+
+class LockOrderViolation(RuntimeError):
+    """A thread acquired locks in an order outside the documented DAG."""
+
+
+class WatchdogState:
+    """Shared per-server watchdog bookkeeping (one per wrapped GcsServer)."""
+
+    def __init__(self, dag: Dict[str, Set[str]]):
+        self.dag = dag
+        self.reach = reachable(dag)
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+        # (outer, inner) acquisition edges actually observed at runtime
+        self.edges: Set[Tuple[str, str]] = set()
+        # lock name → stack of the most recent acquisition (diagnostics)
+        self.last_stacks: Dict[str, List[str]] = {}
+        self.violations: List[str] = []
+
+    def held(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def on_acquire(self, name: str) -> None:
+        """Validate ``name`` against every lock this thread holds; raise
+        on a DAG violation (recording both stacks first)."""
+        held = self.held()
+        if name in held:
+            return  # RLock reentry: cannot deadlock, records no edge
+        bad = [h for h in held if name not in self.reach.get(h, set())]
+        stack = traceback.format_stack()[:-2]
+        with self._mu:
+            for h in held:
+                self.edges.add((h, name))
+            self.last_stacks[name] = stack
+            if bad:
+                prior = self.last_stacks.get(bad[0], [])
+                msg = (f"lock order violation: acquiring {name!r} while "
+                       f"holding {held!r} (edge {bad[0]!r} -> {name!r} is "
+                       f"outside the documented DAG)\n--- acquiring "
+                       f"thread stack ---\n{''.join(stack)}--- last "
+                       f"{bad[0]!r} acquisition ---\n{''.join(prior)}")
+                self.violations.append(msg)
+        if bad:
+            raise LockOrderViolation(msg)
+
+    def push(self, name: str) -> None:
+        self.held().append(name)
+
+    def pop(self, name: str) -> None:
+        held = self.held()
+        # release order may differ from acquire order (with-block nesting
+        # guarantees LIFO, but .release() forms need not) — remove the
+        # innermost matching entry
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def pop_all(self, name: str) -> int:
+        """Remove every entry for ``name`` (Condition._release_save on an
+        RLock releases all recursion levels at once)."""
+        held = self.held()
+        n = len(held)
+        held[:] = [h for h in held if h != name]
+        return n - len(held)
+
+
+class WatchdogLock:
+    """Wrap a Lock/RLock: assert DAG order on acquire, track held state.
+
+    Forwards ``_release_save`` / ``_acquire_restore`` / ``_is_owned`` so
+    ``threading.Condition`` (cv.wait) keeps working on a wrapped RLock —
+    a wait fully releases the lock (held-state popped) and restores it
+    on wake (pushed back).
+    """
+
+    def __init__(self, inner, name: str, state: WatchdogState):
+        self._inner = inner
+        self.name = name
+        self._state = state
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._state.on_acquire(self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._state.push(self.name)
+        return got
+
+    def release(self) -> None:
+        self._state.pop(self.name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # --- threading.Condition integration -------------------------------
+    def _release_save(self):
+        n = self._state.pop_all(self.name)
+        return (self._inner._release_save(), n)
+
+    def _acquire_restore(self, saved) -> None:
+        inner_state, n = saved
+        self._inner._acquire_restore(inner_state)
+        for _ in range(n):
+            self._state.push(self.name)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def watchdog_enabled() -> bool:
+    return os.environ.get("RAY_TPU_LOCK_WATCHDOG") == "1"
+
+
+def wrap_gcs_locks(srv) -> WatchdogState:
+    """Wrap a GcsServer's lock domains in watchdog locks (call right
+    after the locks are created, BEFORE any server thread starts).  The
+    Condition is rebuilt around the wrapped global lock so cv.wait
+    releases/restores through the watchdog."""
+    state = WatchdogState(GCS_LOCK_DAG)
+    srv.lock = WatchdogLock(srv.lock, "lock", state)
+    srv.cv = threading.Condition(srv.lock)
+    for attr in ("_waiter_lock", "_kv_lock", "_events_lock",
+                 "_dedup_lock", "_persist_lock", "_peer_delete_lock"):
+        setattr(srv, attr, WatchdogLock(getattr(srv, attr),
+                                        attr, state))
+    srv._lock_watchdog = state
+    return state

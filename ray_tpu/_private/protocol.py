@@ -57,6 +57,34 @@ def make_listener(path: str) -> Listener:
                     backlog=_BACKLOG)
 
 
+def serve_accept_loop(listener, should_stop, handle,
+                      thread_name: str) -> None:
+    """Accept connections until ``should_stop()``, spawning a named
+    daemon thread running ``handle(conn)`` per connection.
+
+    accept() runs the HMAC handshake INLINE, so a dialer dying
+    mid-handshake (a worker SIGKILLed while booting, a half-open probe,
+    a bad key) surfaces here as EOFError/ConnectionReset/
+    AuthenticationError — a per-connection failure, NOT listener
+    shutdown.  Treating it as shutdown bricks the control plane: with
+    the accept thread dead no replacement peer can ever register (found
+    via the chaos suite — respawned workers stuck in "starting" forever
+    while the scheduler force-pumped into a full-but-dead pool).  Only
+    ``should_stop()`` ends the loop; the sleep keeps a truly dead
+    listener fd from spinning."""
+    from multiprocessing import AuthenticationError
+    while not should_stop():
+        try:
+            conn = listener.accept()
+        except (OSError, EOFError, AuthenticationError):
+            if should_stop():
+                return
+            time.sleep(0.01)
+            continue
+        threading.Thread(target=handle, args=(conn,), daemon=True,
+                         name=thread_name).start()
+
+
 def connect(path: str) -> Connection:
     """Unix-socket dial with a bounded retry on transient accept-queue
     overflow (EAGAIN on a unix connect = the listener's backlog is full,

@@ -200,8 +200,18 @@ _HOT_KINDS = frozenset({
 # (stream order preserved).  Declared here, next to the frame schema,
 # because it is a wire-level contract: anything added must stay a pure
 # refcount mutation with no reply and no cross-table side effects.
+# One kind per line: tools/rtlint's wire pass anchors its findings (and
+# their waivers) to the declaring line.
 REF_KINDS = frozenset({
-    "add_ref", "add_refs", "release", "release_batch", "release_all"})
+    # single-ref alias kept for minimal polyglot peers; the in-tree
+    # Python client batches via add_refs, so no producer exists here
+    # rtlint: wire-no-producer-ok(wire-compat alias of add_refs)
+    "add_ref",
+    "add_refs",
+    "release",
+    "release_batch",
+    "release_all",
+})
 
 _c_codec = None
 _c_codec_tried = False

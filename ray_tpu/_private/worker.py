@@ -164,6 +164,7 @@ class Worker:
         import weakref
         self._fn_id_cache: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()
+        # guarded by: _local_lock
         self._local_values: "OrderedDict[str, bytes]" = OrderedDict()
         self._local_lock = threading.Lock()
         # signaled on every inline-result arrival AND on actor-channel
@@ -172,9 +173,11 @@ class Worker:
         # race on small hosts, turning every serial actor RT into a full
         # control-plane round-trip — measured 2x the direct-path latency)
         self._local_cv = threading.Condition(self._local_lock)
+        # guarded by: _actor_chan_lock
         self._actor_channels: Dict[str, "_ActorChannel"] = {}
         self._actor_chan_lock = threading.Lock()
-        self._pulls: Dict[str, dict] = {}       # in-flight chunked pulls
+        # in-flight chunked pulls                guarded by: _pull_lock
+        self._pulls: Dict[str, dict] = {}
         self._pull_lock = threading.Lock()
         # Batched ObjectRef drops, buffered PER THREAD and flushed on the
         # owning thread's (thread-local) channel.  This preserves the exact
@@ -188,6 +191,7 @@ class Worker:
         # release() runs from __del__ and an in-lock allocation can
         # trigger cyclic GC that re-enters on the same thread.
         self._release_tls = threading.local()
+        # guarded by: _release_lock
         self._release_bufs: Dict[int, List[str]] = {}
         self._release_lock = threading.RLock()
         # Client-side pin/release netting (actor-call return refs ONLY —
@@ -199,6 +203,7 @@ class Worker:
         # ordered submit stream by the flusher's idle tick within ~1s.
         # Guarded by _release_lock (same __del__ reentrancy rules as the
         # release buffers).
+        # guarded by: _release_lock
         self._pending_pins: Dict[str, int] = {}
         # return-oid → (actor_id, call_id) for in-flight actor calls: a
         # result observed through ANY path (inline reply, GCS get) marks
@@ -211,7 +216,8 @@ class Worker:
         # spec's deps must flush AFTER the spec — release paths call
         # _flush_submits() first.  Out-of-order put_object vs submit is
         # safe (the GCS promotes dep-waiters when the object arrives).
-        self._submit_buf: List[Any] = []   # interleaved specs + releases
+        # interleaved specs + releases          guarded by: _submit_lock
+        self._submit_buf: List[Any] = []
         self._submit_lock = threading.Lock()
         # serializes pop→send in _drain_submits: without it two threads
         # (64-full caller vs flusher) could pop successive batches and
@@ -238,8 +244,10 @@ class Worker:
         # all released; on reconnect to a RESTARTED head (epoch change)
         # the owner resubmits the survivors — a head crash must not
         # strand a caller's get() forever.
+        # guarded by: _owned_lock
         self._owned_specs: "OrderedDict[str, dict]" = OrderedDict()
-        self._owned_by_ret: Dict[str, str] = {}   # return oid -> task_id
+        # return oid -> task_id                  guarded by: _owned_lock
+        self._owned_by_ret: Dict[str, str] = {}
         self._owned_lock = threading.Lock()
         self._gcs_epoch: Optional[str] = None
         self._pull_sem = threading.Semaphore(

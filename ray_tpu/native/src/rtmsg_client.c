@@ -7,8 +7,13 @@
  *
  *   - multiprocessing.connection transport framing (4-byte big-endian
  *     length prefix per message, CPython >= 3.3);
- *   - the mutual HMAC-SHA256 authentication handshake (CPython 3.12
- *     modern scheme: "{sha256}" digest prefixes);
+ *   - the mutual HMAC authentication handshake, BOTH schemes: the
+ *     CPython 3.12 modern one ("{sha256}" digest prefixes, HMAC over
+ *     the whole post-#CHALLENGE# message) and the legacy <=3.11 one
+ *     (raw HMAC-MD5 over the challenge bytes).  The scheme is detected
+ *     from the server's own challenge ('{' prefix or not), so the
+ *     digest is always derived over the same canonical bytes the
+ *     CPython peer uses — see auth_handshake;
  *   - `[version u8][codec u8]` frames with the rtmsg tag codec
  *     (wire.py's tag table) — NO pickle anywhere in this file;
  *   - version negotiation via __proto_hello__, then kv_put / kv_get /
@@ -138,6 +143,99 @@ static void hmac_sha256(const uint8_t *key, size_t keylen,
     sha256_update(&s, inner, 32); sha256_final(&s, out);
 }
 
+/* ------------------------------------------------------------- MD5 */
+/* Compact MD5 (RFC 1321) — needed for the legacy (<=3.11) CPython
+ * multiprocessing handshake, which is raw HMAC-MD5. */
+typedef struct { uint32_t h[4]; uint64_t len; uint8_t buf[64]; size_t n; } md5_t;
+
+static const uint32_t K_MD5[64] = {
+    0xd76aa478,0xe8c7b756,0x242070db,0xc1bdceee,0xf57c0faf,0x4787c62a,
+    0xa8304613,0xfd469501,0x698098d8,0x8b44f7af,0xffff5bb1,0x895cd7be,
+    0x6b901122,0xfd987193,0xa679438e,0x49b40821,0xf61e2562,0xc040b340,
+    0x265e5a51,0xe9b6c7aa,0xd62f105d,0x02441453,0xd8a1e681,0xe7d3fbc8,
+    0x21e1cde6,0xc33707d6,0xf4d50d87,0x455a14ed,0xa9e3e905,0xfcefa3f8,
+    0x676f02d9,0x8d2a4c8a,0xfffa3942,0x8771f681,0x6d9d6122,0xfde5380c,
+    0xa4beea44,0x4bdecfa9,0xf6bb4b60,0xbebfbc70,0x289b7ec6,0xeaa127fa,
+    0xd4ef3085,0x04881d05,0xd9d4d039,0xe6db99e5,0x1fa27cf8,0xc4ac5665,
+    0xf4292244,0x432aff97,0xab9423a7,0xfc93a039,0x655b59c3,0x8f0ccc92,
+    0xffeff47d,0x85845dd1,0x6fa87e4f,0xfe2ce6e0,0xa3014314,0x4e0811a1,
+    0xf7537e82,0xbd3af235,0x2ad7d2bb,0xeb86d391};
+static const uint8_t S_MD5[64] = {
+    7,12,17,22,7,12,17,22,7,12,17,22,7,12,17,22,
+    5,9,14,20,5,9,14,20,5,9,14,20,5,9,14,20,
+    4,11,16,23,4,11,16,23,4,11,16,23,4,11,16,23,
+    6,10,15,21,6,10,15,21,6,10,15,21,6,10,15,21};
+
+static void md5_block(md5_t *s, const uint8_t *p) {
+    uint32_t M[16], A = s->h[0], B = s->h[1], C = s->h[2], D = s->h[3];
+    int i;
+    for (i = 0; i < 16; i++)
+        M[i] = (uint32_t)p[i*4] | ((uint32_t)p[i*4+1] << 8) |
+               ((uint32_t)p[i*4+2] << 16) | ((uint32_t)p[i*4+3] << 24);
+    for (i = 0; i < 64; i++) {
+        uint32_t F;
+        int g;
+        if (i < 16)      { F = (B & C) | (~B & D); g = i; }
+        else if (i < 32) { F = (D & B) | (~D & C); g = (5*i + 1) % 16; }
+        else if (i < 48) { F = B ^ C ^ D;          g = (3*i + 5) % 16; }
+        else             { F = C ^ (B | ~D);       g = (7*i) % 16; }
+        F += A + K_MD5[i] + M[g];
+        A = D; D = C; C = B;
+        B += (F << S_MD5[i]) | (F >> (32 - S_MD5[i]));
+    }
+    s->h[0] += A; s->h[1] += B; s->h[2] += C; s->h[3] += D;
+}
+
+static void md5_init(md5_t *s) {
+    s->h[0] = 0x67452301; s->h[1] = 0xefcdab89;
+    s->h[2] = 0x98badcfe; s->h[3] = 0x10325476;
+    s->len = 0; s->n = 0;
+}
+
+static void md5_update(md5_t *s, const void *data, size_t len) {
+    const uint8_t *p = (const uint8_t *)data;
+    s->len += (uint64_t)len * 8;
+    while (len) {
+        size_t t = 64 - s->n;
+        if (t > len) t = len;
+        memcpy(s->buf + s->n, p, t);
+        s->n += t; p += t; len -= t;
+        if (s->n == 64) { md5_block(s, s->buf); s->n = 0; }
+    }
+}
+
+static void md5_final(md5_t *s, uint8_t out[16]) {
+    uint64_t bits = s->len;
+    uint8_t pad = 0x80, zero = 0, lenb[8];
+    int i;
+    /* `bits` was captured above, so the padding updates below may touch
+     * s->len freely. */
+    md5_update(s, &pad, 1);
+    while (s->n != 56) md5_update(s, &zero, 1);
+    for (i = 0; i < 8; i++) lenb[i] = (uint8_t)(bits >> (8 * i));
+    md5_update(s, lenb, 8);
+    for (i = 0; i < 4; i++) {
+        out[i*4]   = (uint8_t)(s->h[i]);
+        out[i*4+1] = (uint8_t)(s->h[i] >> 8);
+        out[i*4+2] = (uint8_t)(s->h[i] >> 16);
+        out[i*4+3] = (uint8_t)(s->h[i] >> 24);
+    }
+}
+
+static void hmac_md5(const uint8_t *key, size_t keylen,
+                     const uint8_t *msg, size_t msglen, uint8_t out[16]) {
+    uint8_t k[64] = {0}, ipad[64], opad[64], inner[16];
+    md5_t s;
+    size_t i;
+    if (keylen > 64) { md5_init(&s); md5_update(&s, key, keylen); md5_final(&s, k); }
+    else memcpy(k, key, keylen);
+    for (i = 0; i < 64; i++) { ipad[i] = k[i] ^ 0x36; opad[i] = k[i] ^ 0x5c; }
+    md5_init(&s); md5_update(&s, ipad, 64);
+    md5_update(&s, msg, msglen); md5_final(&s, inner);
+    md5_init(&s); md5_update(&s, opad, 64);
+    md5_update(&s, inner, 16); md5_final(&s, out);
+}
+
 /* -------------------------------------------- mp.connection transport */
 static int xread(int fd, void *buf, size_t n) {
     uint8_t *p = (uint8_t *)buf;
@@ -197,42 +295,91 @@ static int urandom(uint8_t *out, size_t n) {
 }
 
 /* Mutual auth: answer the server's challenge, then issue ours.
- * (CPython: Client() = answer_challenge + deliver_challenge.) */
+ * (CPython: Client() = answer_challenge + deliver_challenge.)
+ *
+ * Two wire schemes exist and the digest must cover the SAME canonical
+ * bytes on both sides:
+ *
+ *   modern (3.12+): the post-#CHALLENGE# message begins with a
+ *     "{digest}" name prefix and the HMAC covers the WHOLE post-
+ *     #CHALLENGE# message, prefix included; the response carries the
+ *     same "{digest}" prefix.
+ *   legacy (<=3.11): the post-#CHALLENGE# message is raw random bytes,
+ *     the HMAC is MD5 over exactly those bytes, and the response is the
+ *     bare 16-byte digest.
+ *
+ * The server speaks first, so its challenge tells us which scheme this
+ * CPython uses ('{' or not); we answer — and then deliver our own
+ * challenge — in that same scheme. */
 static int auth_handshake(int fd, const uint8_t *key, size_t keylen) {
     static const char CHAL[] = "#CHALLENGE#";
     static const char PFX[] = "{sha256}";
     uint32_t n;
     uint8_t *m = recv_msg(fd, &n);
     uint8_t mac[32], reply[8 + 32], chal[11 + 8 + 32], *resp;
+    int legacy;
     if (!m || n < sizeof(CHAL) - 1 ||
         memcmp(m, CHAL, sizeof(CHAL) - 1) != 0) {
         fprintf(stderr, "auth: bad challenge\n"); free(m); return -1;
     }
-    /* HMAC covers the whole post-prefix message including "{sha256}". */
-    hmac_sha256(key, keylen, m + sizeof(CHAL) - 1, n - (sizeof(CHAL) - 1), mac);
-    free(m);
-    memcpy(reply, PFX, 8);
-    memcpy(reply + 8, mac, 32);
-    if (send_msg(fd, reply, sizeof reply)) return -1;
+    /* Scheme detection must validate the whole "{sha256}" digest-name
+     * prefix, not just the '{' byte: a legacy server's challenge is
+     * os.urandom() and starts with 0x7b once in 256 handshakes.
+     * (CPython's answer_challenge equally requires a closing '}' and a
+     * known digest name before leaving legacy mode.)  A modern server
+     * always sends exactly "{sha256}" (deliver_challenge's default and
+     * the only digest this client implements). */
+    legacy = (n < sizeof(CHAL) - 1 + sizeof(PFX) - 1) ||
+        memcmp(m + sizeof(CHAL) - 1, PFX, sizeof(PFX) - 1) != 0;
+    if (legacy) {
+        /* canonical bytes: the raw challenge payload; digest: HMAC-MD5 */
+        hmac_md5(key, keylen, m + sizeof(CHAL) - 1,
+                 n - (sizeof(CHAL) - 1), mac);
+        free(m);
+        if (send_msg(fd, mac, 16)) return -1;
+    } else {
+        /* canonical bytes: the whole post-#CHALLENGE# message including
+         * the "{sha256}" prefix; digest: HMAC-SHA256, prefixed reply */
+        hmac_sha256(key, keylen, m + sizeof(CHAL) - 1,
+                    n - (sizeof(CHAL) - 1), mac);
+        free(m);
+        memcpy(reply, PFX, 8);
+        memcpy(reply + 8, mac, 32);
+        if (send_msg(fd, reply, sizeof reply)) return -1;
+    }
     m = recv_msg(fd, &n);
     if (!m || n != 9 || memcmp(m, "#WELCOME#", 9) != 0) {
         fprintf(stderr, "auth: digest rejected\n"); free(m); return -1;
     }
     free(m);
-    /* Our challenge back at the server. */
-    memcpy(chal, CHAL, 11);
-    memcpy(chal + 11, PFX, 8);
-    if (urandom(chal + 19, 32)) return -1;
-    if (send_msg(fd, chal, sizeof chal)) return -1;
-    resp = recv_msg(fd, &n);
-    if (!resp) return -1;
-    hmac_sha256(key, keylen, chal + 11, sizeof chal - 11, mac);
-    /* Modern responder replies "{digest}" + mac; accept sha256 only. */
-    if (n != 8 + 32 || memcmp(resp, PFX, 8) != 0 ||
-        memcmp(resp + 8, mac, 32) != 0) {
-        send_msg(fd, (const uint8_t *)"#FAILURE#", 9);
-        fprintf(stderr, "auth: server failed our challenge\n");
-        free(resp); return -1;
+    /* Our challenge back at the server, in the scheme it speaks. */
+    if (legacy) {
+        memcpy(chal, CHAL, 11);
+        if (urandom(chal + 11, 20)) return -1;
+        if (send_msg(fd, chal, 11 + 20)) return -1;
+        resp = recv_msg(fd, &n);
+        if (!resp) return -1;
+        hmac_md5(key, keylen, chal + 11, 20, mac);
+        if (n != 16 || memcmp(resp, mac, 16) != 0) {
+            send_msg(fd, (const uint8_t *)"#FAILURE#", 9);
+            fprintf(stderr, "auth: server failed our challenge\n");
+            free(resp); return -1;
+        }
+    } else {
+        memcpy(chal, CHAL, 11);
+        memcpy(chal + 11, PFX, 8);
+        if (urandom(chal + 19, 32)) return -1;
+        if (send_msg(fd, chal, sizeof chal)) return -1;
+        resp = recv_msg(fd, &n);
+        if (!resp) return -1;
+        hmac_sha256(key, keylen, chal + 11, sizeof chal - 11, mac);
+        /* Modern responder replies "{digest}" + mac; sha256 only. */
+        if (n != 8 + 32 || memcmp(resp, PFX, 8) != 0 ||
+            memcmp(resp + 8, mac, 32) != 0) {
+            send_msg(fd, (const uint8_t *)"#FAILURE#", 9);
+            fprintf(stderr, "auth: server failed our challenge\n");
+            free(resp); return -1;
+        }
     }
     free(resp);
     return send_msg(fd, (const uint8_t *)"#WELCOME#", 9);

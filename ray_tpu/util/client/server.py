@@ -34,13 +34,8 @@ class ClientProxyServer:
         self._thread.start()
 
     def _accept_loop(self) -> None:
-        while not self._stopped.is_set():
-            try:
-                conn = self._listener.accept()
-            except (OSError, EOFError):
-                return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+        protocol.serve_accept_loop(self._listener, self._stopped.is_set,
+                                   self._serve, "client-proxy-serve")
 
     def _resolve_target(self, target: str) -> Optional[str]:
         import os
@@ -113,7 +108,7 @@ class ClientProxyServer:
                         pass
 
         t = threading.Thread(target=pump, args=(client_conn, upstream),
-                             daemon=True)
+                             daemon=True, name="client-proxy-pump")
         t.start()
         pump(upstream, client_conn)
 

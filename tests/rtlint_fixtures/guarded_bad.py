@@ -1,0 +1,31 @@
+"""rtlint fixture: POSITIVE for the guarded-field rule — writes to
+``# guarded by:`` annotated attributes outside their lock."""
+
+import threading
+
+
+class BadGuarded:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self._kv_lock = threading.Lock()
+        self.table = {}         # guarded by: lock
+        self.kv = {}            # guarded by: _kv_lock
+
+    def write_unlocked(self):
+        self.table["k"] = 1
+
+    def mutator_unlocked(self):
+        self.kv.update({"a": 1})
+
+    def del_unlocked(self):
+        del self.table["k"]
+
+    def helper_sometimes_locked(self):
+        self._store()           # one caller without the lock ...
+
+    def locked_caller(self):
+        with self.lock:
+            self._store()       # ... so this one cannot prove safety
+
+    def _store(self):
+        self.table["x"] = 2

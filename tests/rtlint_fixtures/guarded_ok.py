@@ -1,0 +1,37 @@
+"""rtlint fixture: NEGATIVE for the guarded-field rule — writes under
+the annotated lock, a helper provably always called with it held, and
+one explicitly waived write."""
+
+import threading
+
+
+class OkGuarded:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self._kv_lock = threading.Lock()
+        self.table = {}         # guarded by: lock
+        self.kv = {}            # guarded by: _kv_lock
+
+    def write_locked(self):
+        with self.lock:
+            self.table["k"] = 1
+
+    def mutator_locked(self):
+        with self._kv_lock:
+            self.kv.update({"a": 1})
+
+    def caller_one(self):
+        with self.lock:
+            self._store_locked()
+
+    def caller_two(self):
+        with self.lock:
+            self._store_locked()
+
+    def _store_locked(self):
+        # every call site holds the lock — the must-context proves it
+        self.table["x"] = 2
+
+    def boot_path(self):
+        # rtlint: unguarded-ok(single-threaded boot, runs before serve)
+        self.table["boot"] = 1

@@ -1,0 +1,29 @@
+"""rtlint fixture: POSITIVE for the lock-blocking rule — blocking
+primitives invoked while a leaf lock is held."""
+
+import threading
+import time
+
+
+class BadBlocking:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self._waiter_lock = threading.Lock()
+        self._kv_lock = threading.Lock()
+        self._events_lock = threading.Lock()
+
+    def sleep_under_kv(self):
+        with self._kv_lock:
+            time.sleep(0.1)
+
+    def wait_under_leaf(self):
+        ev = threading.Event()
+        with self._waiter_lock:
+            ev.wait(1.0)
+
+    def send_via_helper(self, conn):
+        with self._events_lock:
+            self._emit(conn)
+
+    def _emit(self, conn):
+        conn.send(b"x")

@@ -1,0 +1,35 @@
+"""rtlint fixture: NEGATIVE for the lock-blocking rule — waits on the
+lock's own condition, blocking outside critical sections, and sends
+under the (non-leaf) global lock are all legal."""
+
+import threading
+import time
+
+
+class OkBlocking:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.cv = threading.Condition(self.lock)
+        self._kv_lock = threading.Lock()
+
+    def wait_on_own_cv(self):
+        # cv.wait releases the global lock; nothing else is held
+        with self.cv:
+            self.cv.wait(timeout=0.1)
+
+    def sleep_outside(self):
+        with self._kv_lock:
+            pass
+        time.sleep(0)
+
+    def str_methods_under_leaf(self, parts):
+        # literal str/bytes receivers never block: str.join / str.replace
+        # must not be confused with Thread.join / os.replace
+        with self._kv_lock:
+            return ", ".join(parts)
+
+    def send_under_global(self, conn):
+        # by-design: worker pushes ride the global lock, which is not a
+        # no-block leaf
+        with self.lock:
+            conn.send(b"x")

@@ -1,0 +1,42 @@
+"""rtlint fixture: POSITIVE for the lock-order rule — every method here
+acquires locks in an order outside the documented GCS DAG.  Not a test
+module (no test_ prefix); exercised by tests/test_rtlint.py."""
+
+import threading
+
+
+class BadLockOrder:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.cv = threading.Condition(self.lock)
+        self._waiter_lock = threading.Lock()
+        self._kv_lock = threading.Lock()
+        self._events_lock = threading.Lock()
+
+    def leaf_inside_leaf(self):
+        # leaf locks never nest inside each other
+        with self._waiter_lock:
+            with self._kv_lock:
+                pass
+
+    def global_under_leaf(self):
+        # the classic inversion: global lock acquired under a leaf
+        with self._kv_lock:
+            with self.lock:
+                pass
+
+    def acquire_form(self):
+        # .acquire() forms are recognized too
+        self._kv_lock.acquire()
+        self._events_lock.acquire()
+        self._events_lock.release()
+        self._kv_lock.release()
+
+    def via_helper(self):
+        # the edge is created through a local helper call
+        with self._events_lock:
+            self._helper()
+
+    def _helper(self):
+        with self._waiter_lock:
+            pass

@@ -1,0 +1,46 @@
+"""rtlint fixture: NEGATIVE for the lock-order rule — every acquisition
+here follows the documented GCS DAG; the pass must stay silent."""
+
+import threading
+
+
+class OkLockOrder:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.cv = threading.Condition(self.lock)
+        self._waiter_lock = threading.Lock()
+        self._kv_lock = threading.Lock()
+        self._events_lock = threading.Lock()
+        self._persist_lock = threading.Lock()
+
+    def global_then_leaf(self):
+        with self.cv:
+            with self._waiter_lock:
+                pass
+
+    def persist_then_global_then_leaf(self):
+        # the snapshot writer's shape: persist → lock → kv
+        with self._persist_lock:
+            with self.lock, self._kv_lock:
+                pass
+
+    def helper_under_global(self):
+        with self.lock:
+            self._wake()
+
+    def _wake(self):
+        with self._events_lock:
+            pass
+
+    def sequential_leaves(self):
+        # leaves taken one AFTER the other never nest
+        with self._kv_lock:
+            pass
+        with self._events_lock:
+            pass
+
+    def reentrant_global(self):
+        # RLock reentry cannot deadlock
+        with self.cv:
+            with self.lock:
+                pass

@@ -1,0 +1,12 @@
+"""rtlint fixture: POSITIVE metrics usage — instantiates a series that
+the fixture catalog does not declare (the catalog's dead entry is
+flagged on the catalog stub, not here)."""
+
+
+def Counter(name, *args, **kwargs):
+    return name
+
+
+def emit():
+    Counter("rtpu_fix_rogue")          # not in the fixture catalog
+    return Counter("rtpu_fix_used")    # declared and referenced

@@ -1,0 +1,8 @@
+"""rtlint fixture: stand-in metrics catalog for the metrics pass tests
+(gives metric-dead findings a declaration line to anchor to)."""
+
+CATALOG = {
+    "rtpu_fix_used": dict(kind="counter"),
+    "rtpu_fix_dead": dict(kind="counter"),
+    "rtpu_fix_reserved": dict(kind="gauge"),
+}

@@ -1,0 +1,11 @@
+"""rtlint fixture: NEGATIVE metrics usage — every instantiated name is
+declared, every declared name referenced (or reserved)."""
+
+
+def Counter(name, *args, **kwargs):
+    return name
+
+
+def emit():
+    Counter("rtpu_fix_used")
+    return Counter("rtpu_fix_dead")    # references the otherwise-dead one

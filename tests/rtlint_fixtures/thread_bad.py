@@ -1,0 +1,15 @@
+"""rtlint fixture: POSITIVE for the thread-hygiene rules."""
+
+import threading
+
+
+def spawn_anonymous():
+    threading.Thread(target=print).start()          # no daemon, no name
+
+
+def spawn_unnamed():
+    threading.Thread(target=print, daemon=True).start()   # no name
+
+
+def spawn_implicit_daemon():
+    threading.Thread(target=print, name="x").start()      # no daemon

@@ -1,0 +1,12 @@
+"""rtlint fixture: NEGATIVE for the thread-hygiene rules."""
+
+import threading
+
+
+def spawn_clean():
+    threading.Thread(target=print, daemon=True, name="fixture").start()
+
+
+def spawn_waived():
+    # rtlint: thread-name-ok(framework names it after start)
+    threading.Thread(target=print, daemon=True).start()

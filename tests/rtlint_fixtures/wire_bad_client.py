@@ -1,0 +1,13 @@
+"""rtlint fixture: POSITIVE wire client — awaits a reply on the oneway
+ref kind gamma and declares it dedup-able (a reply kind on the
+coalesced ref path)."""
+
+_DEDUP_KINDS = frozenset({
+    "gamma",
+})
+
+
+class Client:
+    def go(self, ch):
+        ch.rpc("alpha")
+        ch.call("gamma")   # oneway ref kind sent two-way

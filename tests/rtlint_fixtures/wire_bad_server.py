@@ -1,0 +1,12 @@
+"""rtlint fixture: POSITIVE wire server — handles alpha only, and its
+coalesced ref dispatch names a kind outside REF_KINDS."""
+
+
+class Server:
+    def _h_alpha(self, msg):
+        return {}
+
+    def _apply_ref_op_locked(self, kind, msg):
+        if kind == "delta":
+            return {}
+        return None

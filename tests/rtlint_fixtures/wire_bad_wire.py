@@ -1,0 +1,14 @@
+"""rtlint fixture: POSITIVE wire declarations (see wire_bad_server /
+wire_bad_client): beta has no handler and no producer; gamma is a ref
+kind produced two-way, declared dedup-able, and missing its coalesced
+dispatch arm."""
+
+_HOT_KINDS = frozenset({
+    "alpha",
+    "beta",
+    "gamma",
+})
+
+REF_KINDS = frozenset({
+    "gamma",
+})

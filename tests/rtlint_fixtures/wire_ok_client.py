@@ -1,0 +1,16 @@
+"""rtlint fixture: NEGATIVE wire client — two-way kinds via rpc / dict
+literal, the ref kind strictly oneway, dedup set disjoint from
+REF_KINDS."""
+
+_DEDUP_KINDS = frozenset({
+    "alpha",
+})
+
+
+class Client:
+    def go(self, ch):
+        ch.rpc("alpha")
+        ch.send_oneway("gamma")
+
+    def push(self, conn):
+        conn.send({"kind": "beta", "payload": None})

@@ -1,0 +1,18 @@
+"""rtlint fixture: NEGATIVE wire server — an _h_ arm per kind, and the
+coalesced ref dispatch matches REF_KINDS exactly."""
+
+
+class Server:
+    def _h_alpha(self, msg):
+        return {}
+
+    def _h_beta(self, msg):
+        return {}
+
+    def _h_gamma(self, msg):
+        return {}
+
+    def _apply_ref_op_locked(self, kind, msg):
+        if kind == "gamma":
+            return {}
+        return None

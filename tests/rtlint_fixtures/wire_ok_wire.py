@@ -1,0 +1,13 @@
+"""rtlint fixture: NEGATIVE wire declarations — every kind has a
+handler and a producer, ref kinds stay oneway (see wire_ok_server /
+wire_ok_client)."""
+
+_HOT_KINDS = frozenset({
+    "alpha",
+    "beta",
+    "gamma",
+})
+
+REF_KINDS = frozenset({
+    "gamma",
+})

@@ -239,6 +239,76 @@ def test_late_pin_after_release_all_is_dropped(ray_start_regular):
         assert oid not in head.objects
 
 
+def test_lock_watchdog_runtime_oracle(monkeypatch):
+    """RAY_TPU_LOCK_WATCHDOG=1 wraps the GCS lock domains: normal server
+    traffic records only DAG-legal acquisition edges (the dynamic oracle
+    agrees with tools/rtlint's static DAG — they are the same object),
+    and a deliberately reordered leaf-lock acquisition raises at the
+    exact acquire."""
+    import shutil
+    import tempfile
+
+    from ray_tpu._private import lock_watchdog as lw
+    from ray_tpu._private.session import Session
+
+    monkeypatch.setenv("RAY_TPU_LOCK_WATCHDOG", "1")
+    # short root: unix socket paths cap at ~107 bytes (tmp_path is long)
+    root = tempfile.mkdtemp(prefix="rtwd", dir="/tmp")
+    head = gcs_mod.GcsServer(Session(root=root, name="s"), {"CPU": 1})
+    try:
+        state = head._lock_watchdog
+        assert isinstance(head.lock, lw.WatchdogLock)
+        # drive representative traffic across the lock domains: seal +
+        # waiter wake (lock -> _waiter_lock), kv plane (_kv_lock),
+        # coalesced refcount drain (lock), timeline (_events_lock), and
+        # the snapshot writer (_persist_lock -> lock -> _kv_lock)
+        _put_inline(head, "wd-client", "wdobj00001")
+        assert head._h_get_meta(
+            {"object_ids": ["wdobj00001"]})["metas"]["wdobj00001"][
+                "state"] == "ready"
+        head._h_kv_put({"client_id": "wd", "key": b"wdkey",
+                        "value": b"v", "namespace": "wd"})
+        assert head._h_kv_get(
+            {"key": b"wdkey", "namespace": "wd"})["value"] == b"v"
+        head._drain_ref_ops([
+            ("add_refs", {"client_id": "wd", "object_ids": ["wdobj00001"]}),
+            ("release", {"client_id": "wd", "object_id": "wdobj00001"})])
+        head._h_ingest_events({"events": [{"name": "wd"}]})
+        head._write_snapshot()
+
+        edges = set(state.edges)
+        assert edges, "watchdog observed no acquisition edges"
+        # every runtime edge is legal under the static DAG (shared with
+        # tools/rtlint — test_rtlint asserts identity of the objects)
+        reach = lw.reachable(lw.GCS_LOCK_DAG)
+        for outer, inner in edges:
+            assert inner in reach[outer], (outer, inner)
+        assert ("lock", "_waiter_lock") in edges  # seal woke waiters
+        assert ("_persist_lock", "lock") in edges  # snapshot capture
+        assert not state.violations
+
+        # the acceptance-criteria scratch edit, done live: two leaf
+        # locks acquired in the wrong order must raise AT the acquire
+        with pytest.raises(lw.LockOrderViolation):
+            with head._kv_lock:
+                with head._waiter_lock:
+                    pass
+        assert state.violations and "_waiter_lock" in state.violations[-1]
+        # and acquiring the global lock under a leaf is equally illegal
+        with pytest.raises(lw.LockOrderViolation):
+            with head._events_lock:
+                with head.lock:
+                    pass
+        # the failed acquires must not have corrupted held-state: a
+        # legal sequence still works
+        with head.lock:
+            with head._kv_lock:
+                pass
+    finally:
+        head.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def test_waiter_wake_on_concurrent_seal(ray_start_regular):
     """Blocking get_meta parked under the waiter lock is woken by a seal
     that runs entirely under the global lock (the registration-gap

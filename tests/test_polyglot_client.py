@@ -39,8 +39,23 @@ def c_client(tmp_path_factory):
 
 
 def _head_endpoint():
+    """Socket path + shared secret for the C client.
+
+    The secret comes from the session's auth.key file (the source of
+    truth every in-cluster process reads), falling back to RTPU_AUTH_KEY
+    and only then to the in-process protocol._AUTHKEY — so the fixture
+    hands the C client the same canonical key bytes regardless of which
+    env the test process started with."""
+    import os
+    from pathlib import Path
     w = ray_tpu._private.worker.global_worker()
-    return w.gcs_path, protocol._AUTHKEY.hex()
+    sock = Path(w.gcs_path)
+    key_file = sock.parent.parent / "auth.key"
+    if key_file.exists():
+        key = key_file.read_text().strip()
+    else:
+        key = os.environ.get("RTPU_AUTH_KEY") or protocol._AUTHKEY.hex()
+    return str(sock), key
 
 
 def test_c_client_hello_and_kv(ray_start_regular, c_client):

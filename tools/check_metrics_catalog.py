@@ -1,57 +1,16 @@
 #!/usr/bin/env python
-"""Static check: the built-in metric catalog must stay honest.
-
-Greps the tree for ``Counter(``/``Gauge(``/``Histogram(`` instantiations
-and ``mcat.get(...)`` / ``metrics_catalog.get(...)`` accessor calls that
-name a built-in ``rtpu_*`` metric, and fails if any such name is not
-declared in ``ray_tpu/util/metrics_catalog.CATALOG``.  Keeps layers from
-re-declaring drifting strings as the metrics plane grows (run by
-``make lint``).
-"""
+"""Back-compat shim: the metrics-catalog check is now rtlint's fifth
+pass (``python -m tools.rtlint --pass metrics``), which also fails on
+*dead* catalog entries.  Kept so existing invocations keep working."""
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-# direct instantiations of built-in names
-_INST = re.compile(
-    r"\b(?:Counter|Gauge|Histogram)\(\s*[\"'](rtpu_[a-z0-9_]+)[\"']")
-# catalog accessor calls (the standard alias across the tree is `mcat`)
-_GET = re.compile(
-    r"\b(?:mcat|metrics_catalog)\.get\(\s*[\"'](rtpu_[a-z0-9_]+)[\"']")
-
-
-def main() -> int:
-    sys.path.insert(0, str(ROOT))
-    from ray_tpu.util.metrics_catalog import CATALOG
-
-    bad: list = []
-    used: set = set()
-    for path in sorted((ROOT / "ray_tpu").rglob("*.py")):
-        if path.name == "metrics_catalog.py":
-            continue  # the declarations themselves
-        text = path.read_text()
-        for pat in (_INST, _GET):
-            for m in pat.finditer(text):
-                name = m.group(1)
-                used.add(name)
-                if name not in CATALOG:
-                    line = text[: m.start()].count("\n") + 1
-                    bad.append(f"{path.relative_to(ROOT)}:{line}: {name} "
-                               f"not declared in metrics_catalog.CATALOG")
-    if bad:
-        print("\n".join(bad))
-        print(f"\n{len(bad)} undeclared built-in metric use(s); add them "
-              f"to ray_tpu/util/metrics_catalog.py")
-        return 1
-    print(f"metrics catalog OK ({len(CATALOG)} declared, "
-          f"{len(used)} referenced)")
-    return 0
-
+from tools.rtlint.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--pass", "metrics"]))

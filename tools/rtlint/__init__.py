@@ -1,0 +1,91 @@
+"""rtlint: repo-specific static analysis for ray_tpu (DESIGN.md §4d).
+
+Five passes, all stdlib-``ast`` based (no new dependencies), each
+machine-enforcing an invariant that previously lived only in prose:
+
+- ``lock-order`` / ``lock-blocking`` (lockorder.py): the GCS/Worker
+  lock-nesting DAG (DESIGN.md §4c) and the no-blocking-under-leaf-locks
+  rule, propagated through local helper calls.
+- ``unguarded`` (guarded.py): ``# guarded by: <lock>`` annotated shared
+  state must only be written under its lock.
+- ``wire-*`` (wirecheck.py): every wire kind has a server dispatch arm
+  and a client producer; oneway ref kinds are never awaited; reply kinds
+  never ride the coalesced ref path.
+- ``thread-*`` (threads.py): every spawned thread names itself and sets
+  ``daemon=`` explicitly.
+- ``metric-*`` (metricscheck.py): the metrics catalog stays honest in
+  both directions (no undeclared uses, no dead entries).
+
+Waiver syntax (checked on the finding's line, or a pure-comment line
+directly above it): ``# rtlint: <rule>-ok(<reason>)``, e.g.
+``# rtlint: unguarded-ok(init-only, published before threads start)``.
+The reason is mandatory — an empty waiver does not silence the finding.
+
+Driver: ``python -m tools.rtlint`` (wired into ``make rtlint`` /
+``make lint`` / CI).  Fixture corpus: ``tests/rtlint_fixtures/``,
+exercised by ``tests/test_rtlint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, NamedTuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+_WAIVER_RE = re.compile(r"#\s*rtlint:\s*([a-z][a-z0-9-]*)-ok\(([^)]+)\)")
+
+
+class Finding(NamedTuple):
+    path: str      # repo-relative
+    line: int
+    rule: str      # e.g. "lock-order", "unguarded", "wire-no-producer"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+class SourceFile:
+    """One parsed file + its per-line waivers."""
+
+    def __init__(self, path: Path):
+        self.path = path
+        self.rel = str(path.relative_to(REPO_ROOT)) \
+            if path.is_relative_to(REPO_ROOT) else str(path)
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(path))
+        # line number -> set of waived rule ids (a waiver on a pure
+        # comment line also covers the next line, for long statements)
+        self.waivers: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, 1):
+            rules = {m.group(1) for m in _WAIVER_RE.finditer(line)
+                     if m.group(2).strip()}
+            if not rules:
+                continue
+            self.waivers.setdefault(i, set()).update(rules)
+            if line.lstrip().startswith("#"):
+                self.waivers.setdefault(i + 1, set()).update(rules)
+
+    def waived(self, line: int, rule: str) -> bool:
+        return rule in self.waivers.get(line, ())
+
+
+def load(path) -> SourceFile:
+    return SourceFile(Path(path))
+
+
+def dotted_name(node) -> str:
+    """Best-effort dotted rendering of an expression ('self.cv.wait')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("<expr>")
+    return ".".join(reversed(parts))

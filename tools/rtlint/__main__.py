@@ -1,0 +1,101 @@
+"""Driver: ``python -m tools.rtlint [--pass NAME ...] [--show-waived]``.
+
+Runs the five passes over the real tree (see each pass module for what
+it enforces), prints ``file:line rule-id message`` per finding, and
+exits non-zero when any unwaived finding remains.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Dict, List
+
+from tools.rtlint import REPO_ROOT, Finding, SourceFile, load
+
+PASSES = ("locks", "guarded", "wire", "threads", "metrics")
+
+
+def run_pass(name: str) -> List[Finding]:
+    priv = REPO_ROOT / "ray_tpu" / "_private"
+    if name == "locks":
+        from tools.rtlint.lockorder import check_locks, gcs_spec, \
+            worker_spec
+        out = check_locks(load(priv / "gcs.py"), gcs_spec())
+        out += check_locks(load(priv / "worker.py"), worker_spec())
+        return out
+    if name == "guarded":
+        from ray_tpu._private import lock_watchdog as lw
+        from tools.rtlint.guarded import check_guarded
+        out = check_guarded(load(priv / "gcs.py"),
+                            set(lw.GCS_LOCK_DAG), lw.GCS_CV_ALIASES)
+        out += check_guarded(load(priv / "worker.py"),
+                             set(lw.WORKER_LOCK_DAG),
+                             lw.WORKER_CV_ALIASES)
+        return out
+    if name == "wire":
+        from tools.rtlint.wirecheck import check_wire, default_config
+        return check_wire(default_config(REPO_ROOT))
+    if name == "threads":
+        from tools.rtlint.threads import check_threads
+        return check_threads(sorted((REPO_ROOT / "ray_tpu")
+                                    .rglob("*.py")))
+    if name == "metrics":
+        from tools.rtlint.metricscheck import default_check
+        return default_check()
+    raise SystemExit(f"unknown pass {name!r}")
+
+
+def filter_waived(findings: List[Finding]):
+    cache: Dict[str, SourceFile] = {}
+    active: List[Finding] = []
+    waived: List[Finding] = []
+    for f in findings:
+        sf = cache.get(f.path)
+        if sf is None:
+            p = REPO_ROOT / f.path
+            if p.exists():
+                try:
+                    sf = cache[f.path] = load(p)
+                except SyntaxError:
+                    sf = None
+        if sf is not None and sf.waived(f.line, f.rule):
+            waived.append(f)
+        else:
+            active.append(f)
+    return active, waived
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rtlint", description="ray_tpu static analyzer (DESIGN.md §4d)")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=PASSES, help="run only the named pass(es)")
+    ap.add_argument("--show-waived", action="store_true",
+                    help="also print findings silenced by waivers")
+    args = ap.parse_args(argv)
+    if str(REPO_ROOT) not in sys.path:
+        sys.path.insert(0, str(REPO_ROOT))
+    selected = args.passes or list(PASSES)
+    all_findings: List[Finding] = []
+    counts = {}
+    for name in selected:
+        found = run_pass(name)
+        counts[name] = len(found)
+        all_findings.extend(found)
+    active, waived = filter_waived(all_findings)
+    for f in sorted(active):
+        print(f.render())
+    if args.show_waived:
+        for f in sorted(waived):
+            print(f"[waived] {f.render()}")
+    summary = ", ".join(f"{n}:{counts[n]}" for n in selected)
+    print(f"rtlint: {len(active)} finding(s), {len(waived)} waived "
+          f"({summary})")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+    sys.exit(main())

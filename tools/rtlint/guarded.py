@@ -1,0 +1,100 @@
+"""Pass 2: guarded-field discipline.
+
+Shared-state attributes carry a machine-checked annotation on their
+declaration in ``__init__``::
+
+    self.nodes: Dict[str, NodeState] = {}   # guarded by: self.lock
+
+Any write to an annotated attribute (assignment, augmented assignment,
+deletion, subscript store, or mutating method call like ``.append`` /
+``.pop`` / ``.update``) outside a ``with <lock>`` block is an error —
+unless the enclosing helper is provably always called with the lock
+held (interprocedural must-context), or the line carries a
+``# rtlint: unguarded-ok(<reason>)`` waiver.  Writes inside the
+declaring ``__init__`` are exempt (construction happens before the
+object is published to other threads).
+
+Rule: ``unguarded``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, NamedTuple
+
+from tools.rtlint import Finding, SourceFile
+from tools.rtlint.lockmodel import analyze_file
+
+_ANNOT_RE = re.compile(r"#.*?\bguarded by:\s*(?:self\.)?([A-Za-z_][\w]*)")
+
+
+class GuardSpec(NamedTuple):
+    attr: str
+    lock: str
+    line: int
+
+
+def collect_annotations(sf: SourceFile,
+                        cv_aliases: Dict[str, str]) -> List[GuardSpec]:
+    """``self.<attr> = ...  # guarded by: <lock>`` declarations (the
+    marker may sit on the assignment line or on a pure-comment line
+    directly above it)."""
+    import ast
+    specs: List[GuardSpec] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        attr = None
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                attr = t.attr
+        if attr is None:
+            continue
+        for ln in (node.lineno, node.lineno - 1):
+            if not 1 <= ln <= len(sf.lines):
+                continue
+            line = sf.lines[ln - 1]
+            if ln == node.lineno - 1 and not line.lstrip().startswith("#"):
+                continue
+            m = _ANNOT_RE.search(line)
+            if m:
+                lock = m.group(1)
+                specs.append(GuardSpec(attr, cv_aliases.get(lock, lock),
+                                       node.lineno))
+                break
+    return specs
+
+
+def check_guarded(sf: SourceFile, lock_names, cv_aliases) -> List[Finding]:
+    guards = {g.attr: g.lock for g in collect_annotations(sf, cv_aliases)}
+    if not guards:
+        return []
+    fa = analyze_file(sf, set(lock_names), dict(cv_aliases))
+    findings: List[Finding] = []
+    seen = set()
+    for infos in fa.funcs.values():
+        for info in infos:
+            if info.name == "__init__":
+                continue  # construction precedes publication
+            for w in info.writes:
+                lock = guards.get(w.attr)
+                if lock is None:
+                    continue
+                if lock in w.held or lock in info.must:
+                    continue
+                key = (w.line, w.attr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                why = "no lock held" if not w.held else \
+                    f"holding only {', '.join(w.held)}"
+                findings.append(Finding(
+                    sf.rel, w.line, "unguarded",
+                    f"write to self.{w.attr} (guarded by: {lock}) with "
+                    f"{why}, and {info.name}() is not provably always "
+                    f"called with it held"))
+    return findings

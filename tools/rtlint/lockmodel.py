@@ -1,0 +1,406 @@
+"""Shared lock-aware AST analysis for the lock-order and guarded-field
+passes.
+
+For every function in a file this builds, by a held-set walk of its
+body:
+
+- ``acquires``: each lock acquisition (``with self.<lock>:`` items and
+  ``self.<lock>.acquire()`` statements) with the locks already held;
+- ``calls``: each call that resolves to another function in the same
+  file (``self.f()``, ``obj.f()``, bare ``f()``) with the locks held at
+  the call site;
+- ``blocking``: each call to a known-blocking primitive with the locks
+  held (condition ``wait`` on a held paired lock is exempted — a wait
+  releases its own lock);
+- ``writes``: each mutation of a ``self.<attr>`` (assignment, augmented
+  assignment, deletion, subscript store, or mutating method call).
+
+Then two interprocedural contexts are computed to a fixed point over
+the in-file call graph:
+
+- ``may_ctx``: locks a function MAY be entered with (union over call
+  sites) — used to over-approximate acquisition edges, the safe
+  direction for deadlock detection;
+- ``must_ctx``: locks a function is GUARANTEED to be entered with
+  (intersection over call sites) — used to prove guarded-field writes
+  safe, the safe direction for race detection.
+
+Functions with no visible call site — RPC handlers reached via
+``getattr`` dispatch, thread targets, public API — are entry points
+with an empty guaranteed context.  A function passed by reference
+(``target=self._loop``) is likewise forced to entry status even if it
+also has direct call sites.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, NamedTuple, Optional, Set, Tuple
+
+from tools.rtlint import SourceFile, dotted_name
+
+# Method names that mutate their receiver (list/dict/set/deque/OrderedDict)
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "rotate"})
+
+# Attribute names whose call blocks the calling thread.  ``get`` /
+# ``poll`` are deliberately absent (dict.get / zero-timeout poll would
+# swamp the signal), and so is bare ``replace`` (str.replace is
+# everywhere; the blocking form is ``os.replace``, matched by full
+# dotted name below) — waivers cover the rare true positives missed.
+# Calls on a literal str/bytes receiver (``", ".join(parts)``) are
+# exempted at the call site: the receiver type is known and never
+# blocks.
+BLOCKING_ATTRS = frozenset({
+    "sleep", "wait", "wait_for", "recv", "recv_bytes", "send",
+    "send_bytes", "sendall", "accept", "connect", "join", "select",
+    "read", "write", "read_bytes", "write_bytes", "read_text",
+    "write_text", "pread", "pwrite", "ftruncate", "fsync",
+    "communicate", "check_call", "check_output"})
+
+BLOCKING_PREFIXES = ("socket.", "subprocess.", "os.path.")
+BLOCKING_NAMES = frozenset({"open", "os.open", "os.replace",
+                            "subprocess.run"})
+
+
+class Acquire(NamedTuple):
+    lock: str
+    line: int
+    held: Tuple[str, ...]
+
+
+class CallSite(NamedTuple):
+    callee: str
+    line: int
+    held: Tuple[str, ...]
+    mode: str = "bare"   # "self" | "bare" | "cross"
+
+
+class BlockingCall(NamedTuple):
+    what: str
+    line: int
+    held: Tuple[str, ...]
+    exempt: Optional[str]   # paired lock a cv-wait releases, if any
+
+
+class Write(NamedTuple):
+    attr: str
+    line: int
+    held: Tuple[str, ...]
+
+
+class FuncInfo:
+    def __init__(self, name: str, node, cls: Optional[str]):
+        self.name = name
+        self.cls = cls
+        self.node = node
+        self.acquires: List[Acquire] = []
+        self.calls: List[CallSite] = []
+        self.blocking: List[BlockingCall] = []
+        self.writes: List[Write] = []
+        self.is_entry = False
+        self.may_ctx: Set[str] = set()
+        self.must_ctx: Optional[Set[str]] = None  # None = not yet seen
+
+    @property
+    def must(self) -> Set[str]:
+        return self.must_ctx if self.must_ctx is not None else set()
+
+
+class FileLockAnalysis:
+    """Per-file lock analysis: run :func:`analyze_file` to build one."""
+
+    def __init__(self, sf: SourceFile, lock_names: Set[str],
+                 cv_aliases: Dict[str, str],
+                 cross_methods: Set[str] = frozenset()):
+        self.sf = sf
+        self.lock_names = lock_names
+        self.cv_aliases = cv_aliases
+        # methods resolved by name on ANY receiver (e.g. the GCS calling
+        # WorkerState.push on a worker object); everything else resolves
+        # only via ``self.f()`` or a bare ``f()`` — name-matching dict
+        # methods like ``.get`` onto same-named functions would otherwise
+        # pollute the interprocedural contexts
+        self.cross_methods = cross_methods
+        self.funcs: Dict[str, List[FuncInfo]] = {}
+
+    # --------------------------------------------------------- collection
+    def _lock_of(self, expr) -> Optional[str]:
+        """Canonical lock name for ``self.<lock>`` / ``self.<cv>`` (or a
+        bare local named like a known lock, for fixture snippets)."""
+        name = None
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        if name in self.cv_aliases:
+            return self.cv_aliases[name]
+        if name in self.lock_names:
+            return name
+        return None
+
+    def add_func(self, info: FuncInfo) -> None:
+        self.funcs.setdefault(info.name, []).append(info)
+
+    def resolve(self, callee: str) -> List[FuncInfo]:
+        return self.funcs.get(callee, [])
+
+    def resolve_site(self, caller: FuncInfo, site: CallSite) -> List[FuncInfo]:
+        """Resolution respects classes: ``self.f()`` binds to the
+        caller's own class; a bare ``f()`` binds to module-level or
+        same-scope nested functions; only configured cross-methods bind
+        by name on any receiver.  Without this a never-called method
+        could inherit a must-hold context from a same-named method on an
+        unrelated class and silently pass the guarded-field check."""
+        cands = self.funcs.get(site.callee, [])
+        if site.mode == "cross":
+            return cands
+        if site.mode == "self":
+            return [i for i in cands if i.cls == caller.cls]
+        return [i for i in cands
+                if i.cls is None or i.cls == caller.cls]
+
+    # ------------------------------------------------------- fixed points
+    def compute_contexts(self) -> None:
+        all_infos = [i for lst in self.funcs.values() for i in lst]
+        called: Set[int] = set()
+        for info in all_infos:
+            for c in info.calls:
+                for tgt in self.resolve_site(info, c):
+                    called.add(id(tgt))
+        # must-context: optimistic (⊤ = all locks) for called functions,
+        # ∅ for entry points (never called in-file, or referenced by
+        # value — thread targets, dispatch tables).  Iterating
+        # intersections downward to the greatest fixed point keeps cycles
+        # (mutual recursion) from pessimizing to ∅ on the first pass.
+        top = set(self.lock_names)
+        for info in all_infos:
+            if info.is_entry or id(info) not in called:
+                info.must_ctx = set()
+            else:
+                info.must_ctx = set(top)
+        changed = True
+        while changed:
+            changed = False
+            for info in all_infos:
+                for c in info.calls:
+                    site_may = info.may_ctx | set(c.held)
+                    site_must = info.must | set(c.held)
+                    for tgt in self.resolve_site(info, c):
+                        if tgt is info:
+                            continue
+                        if not site_may <= tgt.may_ctx:
+                            tgt.may_ctx |= site_may
+                            changed = True
+                        if tgt.must_ctx is None:
+                            tgt.must_ctx = set(site_must)
+                            changed = True
+                        elif not tgt.must_ctx <= site_must:
+                            tgt.must_ctx &= site_must
+                            changed = True
+
+
+class _FuncWalker:
+    """Held-set walk of one function body."""
+
+    def __init__(self, fa: FileLockAnalysis, info: FuncInfo):
+        self.fa = fa
+        self.info = info
+        self._call_funcs: Set[int] = set()
+
+    def walk(self) -> None:
+        self.block(self.info.node.body, ())
+
+    # --- statements ----------------------------------------------------
+    def block(self, stmts, held: Tuple[str, ...]) -> None:
+        """Walk a statement list; ``.acquire()``/``.release()`` pairs
+        extend the held set linearly within the list."""
+        manual: List[str] = []
+        for st in stmts:
+            cur = held + tuple(manual)
+            lock = self._manual_acquire(st)
+            if lock is not None:
+                self.info.acquires.append(Acquire(lock, st.lineno, cur))
+                manual.append(lock)
+                continue
+            lock = self._manual_release(st)
+            if lock is not None and lock in manual:
+                manual.remove(lock)
+                continue
+            self.stmt(st, cur)
+
+    def _manual_acquire(self, st) -> Optional[str]:
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call) \
+                and isinstance(st.value.func, ast.Attribute) \
+                and st.value.func.attr == "acquire":
+            return self.fa._lock_of(st.value.func.value)
+        return None
+
+    def _manual_release(self, st) -> Optional[str]:
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call) \
+                and isinstance(st.value.func, ast.Attribute) \
+                and st.value.func.attr == "release":
+            return self.fa._lock_of(st.value.func.value)
+        return None
+
+    def stmt(self, st, held: Tuple[str, ...]) -> None:
+        if isinstance(st, ast.With):
+            new = held
+            for item in st.items:
+                self.expr(item.context_expr, new)
+                lock = self.fa._lock_of(item.context_expr)
+                if lock is not None:
+                    self.info.acquires.append(
+                        Acquire(lock, item.context_expr.lineno, new))
+                    new = new + (lock,)
+            self.block(st.body, new)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: analyzed as its own function (call sites link
+            # the contexts); don't walk it under the current held set
+            return
+        if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            for t in targets:
+                self._record_write_target(t, held)
+            if getattr(st, "value", None) is not None:
+                self.expr(st.value, held)
+            for t in targets:
+                self._visit_target_exprs(t, held)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._record_write_target(t, held)
+                self._visit_target_exprs(t, held)
+            return
+        # generic: expressions first, then child statement blocks
+        for field in ("value", "test", "iter", "exc", "cause", "msg",
+                      "subject"):
+            v = getattr(st, field, None)
+            if isinstance(v, ast.expr):
+                self.expr(v, held)
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(st, field, None)
+            if body and isinstance(body[0], ast.stmt):
+                self.block(body, held)
+        for h in getattr(st, "handlers", ()):
+            self.block(h.body, held)
+        for case in getattr(st, "cases", ()):
+            self.block(case.body, held)
+
+    # --- expressions ---------------------------------------------------
+    def expr(self, node, held: Tuple[str, ...]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._record_call(sub, held)
+            elif isinstance(sub, (ast.Attribute, ast.Name)):
+                self._note_reference(sub)
+
+    def _note_reference(self, node) -> None:
+        """A known function referenced by value (thread target=...) is an
+        entry point even if it also has direct call sites."""
+        name = node.attr if isinstance(node, ast.Attribute) else node.id
+        if isinstance(getattr(node, "ctx", None), ast.Load):
+            for info in self.fa.resolve(name):
+                # only if referenced OUTSIDE call position; call nodes
+                # are also walked here, so a plain self.f() marks f too —
+                # refine: treat as entry only for Attribute refs whose
+                # parent isn't the call func.  ast.walk loses parents, so
+                # the caller pre-marks call funcs (see _record_call).
+                if id(node) not in self._call_funcs:
+                    info.is_entry = True
+
+    def _record_call(self, call: ast.Call, held: Tuple[str, ...]) -> None:
+        func = call.func
+        self._call_funcs.add(id(func))
+        name = dotted_name(func)
+        attr = name.rsplit(".", 1)[-1] if name else ""
+        # in-file call resolution: self.f(), bare f(), or a configured
+        # cross-object method (see FileLockAnalysis.cross_methods)
+        mode = None
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            mode = "self"
+        elif isinstance(func, ast.Name):
+            mode = "bare"
+        elif attr in self.fa.cross_methods:
+            mode = "cross"
+        if attr and mode is not None and self.fa.resolve(attr):
+            self.info.calls.append(CallSite(attr, call.lineno, held, mode))
+        # blocking classification
+        exempt = None
+        if attr == "wait" or attr == "wait_for":
+            base = name.rsplit(".", 1)[0].rsplit(".", 1)[-1]
+            if base in self.fa.cv_aliases:
+                exempt = self.fa.cv_aliases[base]
+        literal_recv = isinstance(func, ast.Attribute) and \
+            isinstance(func.value, (ast.Constant, ast.JoinedStr))
+        if not literal_recv and (
+                attr in BLOCKING_ATTRS or name in BLOCKING_NAMES
+                or any(name.startswith(p) for p in BLOCKING_PREFIXES)):
+            self.info.blocking.append(
+                BlockingCall(name, call.lineno, held, exempt))
+        # mutator call on a self attribute → write
+        if attr in MUTATOR_METHODS and isinstance(func, ast.Attribute):
+            root = self._self_attr_root(func.value)
+            if root is not None:
+                self.info.writes.append(Write(root, call.lineno, held))
+
+    def _self_attr_root(self, node) -> Optional[str]:
+        """'self.X', 'self.X[...]', 'self.X[...][...]' → 'X'."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _record_write_target(self, t, held: Tuple[str, ...]) -> None:
+        root = self._self_attr_root(t)
+        if root is not None:
+            self.info.writes.append(Write(root, t.lineno, held))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._record_write_target(el, held)
+
+    def _visit_target_exprs(self, t, held: Tuple[str, ...]) -> None:
+        # subscript indices etc. may contain calls
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Call):
+                self._record_call(sub, held)
+
+
+def analyze_file(sf: SourceFile, lock_names: Set[str],
+                 cv_aliases: Dict[str, str],
+                 cross_methods: Set[str] = frozenset()
+                 ) -> FileLockAnalysis:
+    fa = FileLockAnalysis(sf, lock_names, cv_aliases, cross_methods)
+    # register every function first so call resolution sees all of them
+    pending: List[FuncInfo] = []
+
+    def register(node, cls: Optional[str]) -> None:
+        for child in getattr(node, "body", ()):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FuncInfo(child.name, child, cls)
+                fa.add_func(info)
+                pending.append(info)
+                register(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                register(child, child.name)
+
+    register(sf.tree, None)
+    for info in pending:
+        _FuncWalker(fa, info).walk()
+    fa.compute_contexts()
+    return fa
+
+
+def effective_held(info: FuncInfo, held: Tuple[str, ...],
+                   use_may: bool) -> FrozenSet[str]:
+    ctx = info.may_ctx if use_may else info.must
+    return frozenset(set(held) | ctx)

@@ -1,0 +1,92 @@
+"""Pass 1: lock-order discipline + no-blocking-under-leaf-locks.
+
+Validates every lock-acquisition edge in ``gcs.py`` / ``worker.py``
+(including edges reached through local helper calls) against the
+canonical DAGs in ``ray_tpu/_private/lock_watchdog.py`` — the same DAGs
+the ``RAY_TPU_LOCK_WATCHDOG=1`` runtime oracle asserts — and flags any
+call to a known-blocking primitive while a leaf lock is held.
+
+Rules: ``lock-order``, ``lock-blocking``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from tools.rtlint import Finding, SourceFile
+from tools.rtlint.lockmodel import analyze_file
+
+
+class LockSpec:
+    """Which locks a file uses, their DAG, and the no-block leaves."""
+
+    def __init__(self, dag: Dict[str, Set[str]], noblock: Set[str],
+                 cv_aliases: Dict[str, str],
+                 cross_methods: Set[str] = frozenset()):
+        self.dag = dag
+        self.noblock = noblock
+        self.cv_aliases = cv_aliases
+        self.cross_methods = cross_methods
+        from ray_tpu._private.lock_watchdog import reachable
+        self.reach = reachable(dag)
+        self.lock_names = set(dag)
+
+
+def gcs_spec() -> LockSpec:
+    from ray_tpu._private import lock_watchdog as lw
+    # push/push_ctl are WorkerState methods the GCS invokes on worker
+    # objects while holding the global lock — resolve them cross-object
+    return LockSpec(lw.GCS_LOCK_DAG, lw.GCS_NOBLOCK_LOCKS,
+                    lw.GCS_CV_ALIASES, {"push", "push_ctl"})
+
+
+def worker_spec() -> LockSpec:
+    from ray_tpu._private import lock_watchdog as lw
+    return LockSpec(lw.WORKER_LOCK_DAG, lw.WORKER_NOBLOCK_LOCKS,
+                    lw.WORKER_CV_ALIASES)
+
+
+def check_locks(sf: SourceFile, spec: LockSpec) -> List[Finding]:
+    fa = analyze_file(sf, spec.lock_names, spec.cv_aliases,
+                      spec.cross_methods)
+    findings: List[Finding] = []
+    seen = set()
+    for infos in fa.funcs.values():
+        for info in infos:
+            ctx_may = info.may_ctx
+            for acq in info.acquires:
+                outers = set(acq.held) | ctx_may
+                if acq.lock in acq.held:
+                    continue  # reentry of a definitely-held RLock
+                for outer in sorted(outers):
+                    if outer == acq.lock:
+                        continue
+                    if acq.lock in spec.reach.get(outer, set()):
+                        continue
+                    key = (acq.line, outer, acq.lock)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    via = "" if outer in acq.held else \
+                        " (held by a caller of this helper)"
+                    findings.append(Finding(
+                        sf.rel, acq.line, "lock-order",
+                        f"acquires {acq.lock!r} while holding "
+                        f"{outer!r}{via}: edge outside the documented "
+                        f"DAG (lock_watchdog)"))
+            for bc in info.blocking:
+                held = set(bc.held) | ctx_may
+                if bc.exempt is not None:
+                    held.discard(bc.exempt)
+                bad = sorted(held & spec.noblock)
+                if not bad:
+                    continue
+                key = (bc.line, bc.what)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    sf.rel, bc.line, "lock-blocking",
+                    f"calls blocking primitive {bc.what!r} while "
+                    f"holding leaf lock(s) {', '.join(bad)}"))
+    return findings

@@ -1,0 +1,100 @@
+"""Pass 5: metrics-catalog honesty (both directions).
+
+Forward (the original ``tools/check_metrics_catalog.py``, folded in
+here): every ``Counter(``/``Gauge(``/``Histogram(`` instantiation and
+every ``mcat.get(...)`` / ``metrics_catalog.get(...)`` accessor naming
+a built-in ``rtpu_*`` series must be declared in
+``ray_tpu/util/metrics_catalog.CATALOG``.
+
+Reverse (new): every CATALOG entry must be *live* — its name must
+appear somewhere in ``ray_tpu/`` outside the catalog itself (literal
+occurrence: instantiation, ``mcat.get``, or collect-time synthesis).  A
+declared-but-never-referenced entry is dead weight that operators will
+grep dashboards for in vain.  Intentionally-reserved names go in the
+``reserved`` waiver list.
+
+Rules: ``metric-undeclared``, ``metric-dead``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List
+
+from tools.rtlint import Finding, REPO_ROOT
+
+_INST = re.compile(
+    r"\b(?:Counter|Gauge|Histogram)\(\s*[\"'](rtpu_[a-z0-9_]+)[\"']")
+_GET = re.compile(
+    r"\b(?:mcat|metrics_catalog)\.get\(\s*[\"'](rtpu_[a-z0-9_]+)[\"']")
+_ANY = re.compile(r"[\"'](rtpu_[a-z0-9_]+)[\"']")
+
+# Catalog entries that are declared ahead of their emitters on purpose
+# (kept empty when nothing is reserved; see DESIGN.md §4d for why a
+# reservation needs a reason next to it).
+RESERVED_NAMES: frozenset = frozenset()
+
+
+def check_metrics(catalog: Dict[str, dict], roots: Iterable[Path],
+                  catalog_path: Path,
+                  reserved: frozenset = RESERVED_NAMES) -> List[Finding]:
+    findings: List[Finding] = []
+    referenced: set = set()
+    for root in roots:
+        paths = sorted(root.rglob("*.py")) if root.is_dir() else [root]
+        for path in paths:
+            if path.resolve() == catalog_path.resolve():
+                continue
+            text = path.read_text()
+            rel = str(path.relative_to(REPO_ROOT)) \
+                if path.is_relative_to(REPO_ROOT) else str(path)
+            for pat in (_INST, _GET):
+                for m in pat.finditer(text):
+                    name = m.group(1)
+                    if name not in catalog:
+                        line = text[: m.start()].count("\n") + 1
+                        findings.append(Finding(
+                            rel, line, "metric-undeclared",
+                            f"{name} not declared in "
+                            f"metrics_catalog.CATALOG"))
+            referenced.update(m.group(1) for m in _ANY.finditer(text))
+    decl_lines = _catalog_decl_lines(catalog_path)
+    cat_rel = str(catalog_path.relative_to(REPO_ROOT)) \
+        if catalog_path.is_relative_to(REPO_ROOT) else str(catalog_path)
+    for name in sorted(catalog):
+        if name in referenced or name in reserved:
+            continue
+        findings.append(Finding(
+            cat_rel, decl_lines.get(name, 1), "metric-dead",
+            f"catalog entry {name} is never instantiated or mcat.get()-ed "
+            f"anywhere in the tree (dead series; delete it or add it to "
+            f"the reserved list with a reason)"))
+    return findings
+
+
+def _catalog_decl_lines(catalog_path: Path) -> Dict[str, int]:
+    try:
+        tree = ast.parse(catalog_path.read_text())
+    except (OSError, SyntaxError):
+        return {}
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str) and \
+                        k.value.startswith("rtpu_"):
+                    out.setdefault(k.value, k.lineno)
+    return out
+
+
+def default_check() -> List[Finding]:
+    import sys
+    if str(REPO_ROOT) not in sys.path:
+        sys.path.insert(0, str(REPO_ROOT))
+    from ray_tpu.util.metrics_catalog import CATALOG
+    return check_metrics(
+        CATALOG, [REPO_ROOT / "ray_tpu"],
+        REPO_ROOT / "ray_tpu" / "util" / "metrics_catalog.py")

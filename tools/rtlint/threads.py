@@ -1,0 +1,52 @@
+"""Pass 4: spawned-thread hygiene.
+
+Every ``threading.Thread(...)`` (or bare ``Thread(...)`` import form)
+constructed inside ``ray_tpu/`` must:
+
+- set ``daemon=`` explicitly (a forgotten non-daemon thread turns every
+  clean shutdown into a hang; an implicit daemon hides the decision);
+- pass ``name=`` (stack dumps, the lock watchdog, and ``ray_tpu stack``
+  are unreadable when half the threads are ``Thread-12``).
+
+Rules: ``thread-daemon``, ``thread-name``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List
+
+from tools.rtlint import Finding, SourceFile, dotted_name, load
+
+
+def check_threads_file(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name not in ("threading.Thread", "Thread"):
+            continue
+        kwargs = {k.arg for k in node.keywords if k.arg is not None}
+        if "daemon" not in kwargs:
+            findings.append(Finding(
+                sf.rel, node.lineno, "thread-daemon",
+                "threading.Thread(...) without an explicit daemon= "
+                "(decide and say whether shutdown may strand it)"))
+        if "name" not in kwargs:
+            findings.append(Finding(
+                sf.rel, node.lineno, "thread-name",
+                "threading.Thread(...) without a name= (unnamed threads "
+                "make stack dumps and the lock watchdog unreadable)"))
+    return findings
+
+
+def check_threads(paths: List[Path]) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        try:
+            findings.extend(check_threads_file(load(p)))
+        except SyntaxError:
+            continue
+    return findings

@@ -1,0 +1,277 @@
+"""Pass 3: wire-protocol exhaustiveness.
+
+Every kind declared in ``wire.py`` (``_HOT_KINDS`` ∪ ``REF_KINDS``)
+must have:
+
+- a **server dispatch arm**: an ``_h_<kind>`` method, a literal
+  ``kind == "<kind>"`` / ``kind in (...)`` comparison arm in a dispatch
+  file, or a configured out-of-line handler (the actor channel's
+  ``call`` kind executes in ``actor_server._handle_call``);
+- a **client producer**: a ``rpc("<kind>")`` / ``rpc_oneway`` /
+  ``.call`` / ``send_oneway`` / ``local_call`` call, a
+  ``{"kind": "<kind>", ...}`` dict literal, or a ``"<kind>"`` string in
+  a native C client source.  Test clients count — the wire contract is
+  exactly "some speaker exists".
+
+Protocol-shape rules:
+
+- oneway kinds (``REF_KINDS``) must never be awaited for a reply: a
+  two-way producer form (``rpc``/``.call``/``local_call`` outside the
+  GCS itself) of a ref kind is an error;
+- reply kinds must never ride the coalesced ref path:
+  ``REF_KINDS ∩ _DEDUP_KINDS`` must be empty (dedup ids mark two-way
+  mutations), and the ``_apply_ref_op_locked`` dispatch arms must equal
+  ``REF_KINDS`` exactly (an arm outside the declared set would let a
+  non-ref kind slip into the coalescing buffer).
+
+Rules: ``wire-no-handler``, ``wire-no-producer``,
+``wire-oneway-awaited``, ``wire-ref-path``, ``wire-ref-arm``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Set
+
+from tools.rtlint import Finding, SourceFile, dotted_name, load
+
+ONEWAY_FORMS = {"rpc_oneway", "send_oneway"}
+TWOWAY_FORMS = {"rpc", "call", "local_call"}
+
+
+class WireConfig(NamedTuple):
+    wire_path: Path           # declares _HOT_KINDS / REF_KINDS
+    server_paths: List[Path]  # files with _h_* defs / comparison arms
+    producer_paths: List[Path]   # python files scanned for producers
+    c_paths: List[Path]          # native client sources
+    dedup_path: Optional[Path]   # file declaring _DEDUP_KINDS
+    ref_dispatch: str            # function with per-ref-kind arms
+    extra_handlers: Dict[str, str]  # kind -> "path::func" out-of-line
+
+
+def default_config(root: Path) -> WireConfig:
+    priv = root / "ray_tpu" / "_private"
+    producers = sorted((root / "ray_tpu").rglob("*.py")) + \
+        sorted((root / "tests").glob("test_*.py"))
+    return WireConfig(
+        wire_path=priv / "wire.py",
+        server_paths=[priv / "gcs.py", priv / "actor_server.py",
+                      priv / "worker.py"],
+        producer_paths=producers,
+        c_paths=sorted((root / "ray_tpu" / "native" / "src").glob("*.c")),
+        dedup_path=priv / "worker.py",
+        ref_dispatch="_apply_ref_op_locked",
+        extra_handlers={
+            # actor-channel calls bypass the GCS: the worker's actor
+            # server executes them directly (no kind comparison — the
+            # channel carries only this kind)
+            "call": "ray_tpu/_private/actor_server.py::_handle_call",
+        })
+
+
+def _frozenset_strs(node) -> Optional[Set[str]]:
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "frozenset" and node.args:
+        inner = node.args[0]
+        if isinstance(inner, (ast.Set, ast.List, ast.Tuple)):
+            out = set()
+            for el in inner.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    out.add(el.value)
+            return out
+    return None
+
+
+def _kind_decls(sf: SourceFile, names) -> Dict[str, Dict[str, int]]:
+    """{setname: {kind: lineno}} for frozenset-of-string declarations."""
+    out: Dict[str, Dict[str, int]] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in names:
+                kinds: Dict[str, int] = {}
+                if isinstance(node.value, ast.Call):
+                    inner = node.value.args[0] if node.value.args else None
+                    if isinstance(inner, (ast.Set, ast.List, ast.Tuple)):
+                        for el in inner.elts:
+                            if isinstance(el, ast.Constant) and \
+                                    isinstance(el.value, str):
+                                kinds[el.value] = el.lineno
+                out[t.id] = kinds
+    return out
+
+
+def _compare_arms(tree) -> Set[str]:
+    """Literal kinds matched by ``kind == "x"`` / ``kind in ("x", ...)``
+    comparisons (any variable named kind/op, or a msg["kind"] subscript)."""
+    arms: Set[str] = set()
+
+    def is_kind_expr(e) -> bool:
+        if isinstance(e, ast.Name) and e.id in ("kind", "op"):
+            return True
+        if isinstance(e, ast.Subscript) and \
+                isinstance(e.slice, ast.Constant) and \
+                e.slice.value == "kind":
+            return True
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not is_kind_expr(node.left):
+            continue
+        for cmp_ in node.comparators:
+            if isinstance(cmp_, ast.Constant) and \
+                    isinstance(cmp_.value, str):
+                arms.add(cmp_.value)
+            elif isinstance(cmp_, (ast.Tuple, ast.Set, ast.List)):
+                for el in cmp_.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        arms.add(el.value)
+    return arms
+
+
+class _Producers:
+    def __init__(self):
+        # kind -> list of (path, line, form) where form is "oneway",
+        # "twoway", or "dict"
+        self.sites: Dict[str, List] = {}
+
+    def add(self, kind: str, path: str, line: int, form: str) -> None:
+        self.sites.setdefault(kind, []).append((path, line, form))
+
+
+def _scan_producers(paths: List[Path], c_paths: List[Path],
+                    skip_names) -> _Producers:
+    prod = _Producers()
+    for p in paths:
+        if p.name in skip_names or not p.exists():
+            continue
+        try:
+            sf = load(p)
+        except SyntaxError:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func).rsplit(".", 1)[-1]
+                if name in ONEWAY_FORMS | TWOWAY_FORMS and node.args:
+                    a0 = node.args[0]
+                    if isinstance(a0, ast.Constant) and \
+                            isinstance(a0.value, str):
+                        form = "oneway" if name in ONEWAY_FORMS \
+                            else "twoway"
+                        prod.add(a0.value, sf.rel, node.lineno, form)
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and k.value == "kind" \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        prod.add(v.value, sf.rel, node.lineno, "dict")
+    # C producers: only strings passed to the rtmsg ENCODER count (the
+    # C client emits kinds via enc_str(&buf, "<kind>")) — a bare string
+    # scan would let an fprintf message or comment satisfy
+    # wire-no-producer for a kind nothing actually sends.
+    enc_re = re.compile(r'enc_str\([^)]*?"([a-z_]{2,40})"')
+    for p in c_paths:
+        if not p.exists():
+            continue
+        text = p.read_text()
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in enc_re.finditer(line):
+                prod.add(m.group(1), str(p), i, "c")
+    return prod
+
+
+def check_wire(cfg: WireConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    wire_sf = load(cfg.wire_path)
+    decls = _kind_decls(wire_sf, {"_HOT_KINDS", "REF_KINDS"})
+    hot = decls.get("_HOT_KINDS", {})
+    ref = decls.get("REF_KINDS", {})
+    all_kinds = {**hot, **ref}  # ref lines win for ref kinds
+
+    handler_files = [load(p) for p in cfg.server_paths if p.exists()]
+    h_methods: Set[str] = set()
+    arms: Set[str] = set()
+    ref_arms: Set[str] = set()
+    ref_dispatch_line = 0
+    for sf in handler_files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name.startswith("_h_"):
+                    h_methods.add(node.name[3:])
+                if node.name == cfg.ref_dispatch:
+                    ref_arms = _compare_arms(node)
+                    ref_dispatch_line = node.lineno
+        arms |= _compare_arms(sf.tree)
+    for kind, target in cfg.extra_handlers.items():
+        path, func = target.split("::")
+        fp = cfg.wire_path.parent.parent.parent / path
+        found = False
+        if fp.exists():
+            for node in ast.walk(load(fp).tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node.name == func:
+                    found = True
+        if found:
+            h_methods.add(kind)
+        else:
+            findings.append(Finding(
+                wire_sf.rel, all_kinds.get(kind, 1), "wire-no-handler",
+                f"configured out-of-line handler {target} for kind "
+                f"{kind!r} does not exist"))
+
+    prod = _scan_producers(cfg.producer_paths, cfg.c_paths,
+                           skip_names={cfg.wire_path.name}
+                           | {p.name for p in cfg.server_paths
+                              if p.name == "gcs.py"})
+    for kind, line in sorted(all_kinds.items()):
+        if kind not in h_methods and kind not in arms:
+            findings.append(Finding(
+                wire_sf.rel, line, "wire-no-handler",
+                f"wire kind {kind!r} has no server dispatch arm "
+                f"(no _h_{kind} and no kind == comparison)"))
+        if kind not in prod.sites:
+            findings.append(Finding(
+                wire_sf.rel, line, "wire-no-producer",
+                f"wire kind {kind!r} has no client producer anywhere "
+                f"in the tree (python, tests, or C client)"))
+    # oneway kinds must never be awaited for a reply
+    for kind in sorted(ref):
+        for path, line, form in prod.sites.get(kind, ()):
+            if form == "twoway":
+                findings.append(Finding(
+                    path, line, "wire-oneway-awaited",
+                    f"refcount oneway kind {kind!r} sent via a two-way "
+                    f"RPC form (a reply would defeat coalescing and "
+                    f"stall the sender)"))
+    # reply kinds must never ride the coalesced ref path
+    if cfg.dedup_path is not None and cfg.dedup_path.exists():
+        dedup_sf = load(cfg.dedup_path)
+        ddecl = _kind_decls(dedup_sf, {"_DEDUP_KINDS"})
+        for kind, line in sorted(ddecl.get("_DEDUP_KINDS", {}).items()):
+            if kind in ref:
+                findings.append(Finding(
+                    dedup_sf.rel, line, "wire-ref-path",
+                    f"reply (dedup) kind {kind!r} is also declared a "
+                    f"coalescible REF_KIND — a reply kind must never "
+                    f"ride the coalesced ref path"))
+    # the coalesced dispatch arms must equal REF_KINDS exactly
+    if ref_arms or ref:
+        for kind in sorted(set(ref) - ref_arms):
+            findings.append(Finding(
+                wire_sf.rel, ref.get(kind, 1), "wire-ref-arm",
+                f"REF_KIND {kind!r} has no arm in {cfg.ref_dispatch}"))
+        for kind in sorted(ref_arms - set(ref)):
+            findings.append(Finding(
+                handler_files[0].rel if handler_files else wire_sf.rel,
+                ref_dispatch_line, "wire-ref-arm",
+                f"{cfg.ref_dispatch} dispatches kind {kind!r} which is "
+                f"not declared in REF_KINDS"))
+    return findings
