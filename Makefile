@@ -2,7 +2,8 @@
 
 PY ?= python
 
-.PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick
+.PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
+	databench-quick
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -48,4 +49,13 @@ bench:
 microbench-quick:
 	JAX_PLATFORMS=cpu $(PY) -m ray_tpu.scripts.cli microbenchmark --quick \
 		--assert-sane --json benchmarks/results/microbench_ci.json \
+		--label ci
+
+# Data-plane transfer smoke (CI): same-run A/B of the streamed pooled
+# pull vs the in-tree legacy (fresh-dial chunked) path, asserts the
+# streamed path isn't slower + the warm pool beats dial-per-pull, and
+# leaves a JSON artifact for the uploader.
+databench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/data_bench.py --pull --quick \
+		--assert-sane --json benchmarks/results/databench_ci.json \
 		--label ci

@@ -93,6 +93,31 @@ _FLAG_DEFS = [
     _flag("transfer_max_inflight", 2,
           "Concurrent chunked pulls per process; further pulls queue "
           "(reference: PullManager bandwidth admission)."),
+    _flag("data_stream_frame_bytes", 8 * 1024 * 1024,
+          "Payload bytes per bulk frame on a streamed peer pull "
+          "(fetch_stream).  Frames only bound how often a mid-stream "
+          "error can surface — there is no per-frame round trip."),
+    _flag("data_inline_pull_bytes", 128 * 1024,
+          "Streamed pulls at or below this ride the fetch_stream ack "
+          "itself (one message round trip, no bulk frames) — below "
+          "~100KB the pull is syscall-bound, not copy-bound, so one "
+          "pickled copy beats four frame-boundary syscalls."),
+    _flag("data_stripe_threshold_bytes", 32 * 1024 * 1024,
+          "Peer pulls of objects >= this open N parallel range-striped "
+          "streams over pooled connections (data_stripe_streams); "
+          "smaller objects ride one stream."),
+    _flag("data_stripe_streams", 4,
+          "Parallel range streams per striped peer pull (>=2; 1 "
+          "disables striping)."),
+    _flag("data_pool_max_conns", 16,
+          "Per-process data-plane connection pool bound: idle "
+          "connections beyond this are closed LRU-first (in-use "
+          "connections are never reclaimed)."),
+    _flag("data_pull_buffer_cache_mb", 256,
+          "Per-process cap on cached streamed-pull receive buffers "
+          "(already-faulted pages reused across pulls — allocation + "
+          "page-fault cost otherwise rivals the transfer itself for "
+          "large objects).  0 disables caching."),
     # --- scheduler / workers -------------------------------------------------
     _flag("num_workers_per_node", 0, "Size of worker pool (0 = num_cpus)."),
     _flag("prestart_workers", 0,

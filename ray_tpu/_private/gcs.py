@@ -70,6 +70,7 @@ class NodeState:
         self.labels = labels or {}
         self.alive = True
         self.data_addr: Optional[str] = None  # P2P object-plane listener
+        self.data_proto = 0  # holder's data-plane wire version (add_node)
         self.is_remote = False   # owned by a NodeAgent on another host:
         # the GCS cannot fork workers there (the agent owns the pool);
         # actors there listen on TCP and advertise tcp:// addresses
@@ -317,6 +318,11 @@ class GcsServer:
         # guarded by: _peer_delete_lock
         self._peer_delete_q: Dict[str, List[str]] = defaultdict(list)
         self._peer_delete_event = threading.Event()
+        # pooled data-plane conns to holder nodes (relay pull-throughs +
+        # spool deletes reuse one dial+HMAC per holder); internal lock,
+        # never held together with any GCS lock
+        from ray_tpu._private.data_plane import DataPlanePool
+        self._data_pool = DataPlanePool()
         self.driver_ids: Set[str] = set()              # guarded by: lock
         self.log_sink = None                              # callable(line)
         self._shutdown = False
@@ -555,13 +561,19 @@ class GcsServer:
                           is_head: bool = False,
                           labels: Optional[Dict[str, str]] = None,
                           remote: bool = False,
-                          data_addr: Optional[str] = None) -> str:
+                          data_addr: Optional[str] = None,
+                          data_proto: int = 0) -> str:
+        if data_addr and data_proto:
+            # pre-seed the agent's advertised data-plane version so the
+            # head's pooled conns skip the per-conn hello round trip
+            self._data_pool.set_proto(data_addr, data_proto)
         with self.cv:
             res = dict(resources)
             res.setdefault("CPU", float(os.cpu_count() or 4) if is_head else 1.0)
             node = NodeState(node_id, res, labels)
             node.is_remote = remote
             node.data_addr = data_addr
+            node.data_proto = int(data_proto or 0)
             # node-id resource enables NodeAffinity via plain resource matching
             node.resources_total[f"node:{node_id}"] = 1.0
             node.resources_avail[f"node:{node_id}"] = 1.0
@@ -724,7 +736,6 @@ class GcsServer:
         block frees on healthy nodes; batches for addresses no live node
         advertises are dropped (the agent's shutdown rmtree already freed
         that spool)."""
-        from ray_tpu._private.data_plane import delete_batch_on_peer
         while not self._shutdown:
             self._peer_delete_event.wait(1.0)
             if self._shutdown:
@@ -739,9 +750,10 @@ class GcsServer:
                 with self.lock:
                     live = {n.data_addr for n in self.nodes.values()
                             if n.alive and n.data_addr}
-                threads = [threading.Thread(target=delete_batch_on_peer,
-                                            args=(addr, oids), daemon=True,
-                                            name="gcs-peer-delete-batch")
+                threads = [threading.Thread(
+                    target=self._data_pool.delete_batch,
+                    args=(addr, oids), daemon=True,
+                    name="gcs-peer-delete-batch")
                            for addr, oids in batches.items() if addr in live]
                 for t in threads:
                     t.start()
@@ -3047,7 +3059,9 @@ class GcsServer:
         nid = self.add_node_internal(NodeID.new(), msg["resources"],
                                      labels=msg.get("labels"),
                                      remote=bool(msg.get("remote")),
-                                     data_addr=msg.get("data_addr"))
+                                     data_addr=msg.get("data_addr"),
+                                     data_proto=int(msg.get("data_proto")
+                                                    or 0))
         self._pump()
         return {"node_id": nid}
 
@@ -3229,14 +3243,13 @@ class GcsServer:
         try:
             if addr is None:
                 return False
-            from ray_tpu._private import data_plane
             from ray_tpu._private.shm_store import _seg_path
-            tcp = protocol.parse_tcp_addr(addr)
-            if tcp is None:
+            if protocol.parse_tcp_addr(addr) is None:
                 return False
-            wire = data_plane.pull_from_peer(
-                lambda a: protocol.connect_tcp(*tcp, timeout=5.0),
-                addr, oid)
+            with self.lock:
+                m = self.objects.get(oid)
+                size = m.size if m is not None else None
+            wire = self._data_pool.pull(addr, oid, size=size)
             seg = _seg_path(oid)
             tmp = seg.with_name(seg.name + ".pull")
             tmp.write_bytes(wire)
@@ -3253,8 +3266,8 @@ class GcsServer:
                                                     len(wire))
             # the head owns the object now — drop the holder's spool copy
             # or relay-fallback traffic accumulates dead files on A
-            from ray_tpu._private.data_plane import delete_on_peer
-            threading.Thread(target=delete_on_peer, args=(addr, oid),
+            threading.Thread(target=self._data_pool.delete_batch,
+                             args=(addr, [oid]),
                              daemon=True, name="gcs-peer-delete-one").start()
             return True
         except (OSError, EOFError, FileNotFoundError, ConnectionError):
@@ -3421,6 +3434,7 @@ class GcsServer:
             self._listener.close()
         except OSError:
             pass
+        self._data_pool.close_all()
         self.store.shutdown()
         if self.slab is not None:
             self.slab.close()

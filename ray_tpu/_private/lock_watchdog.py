@@ -80,6 +80,27 @@ WORKER_NOBLOCK_LOCKS: Set[str] = {
 
 WORKER_CV_ALIASES: Dict[str, str] = {"_local_cv": "_local_lock"}
 
+# Data-plane (data_plane.py) lock domains — all leaves, one per class:
+# the server's serving-counter lock and the connection pool's table
+# lock.  Neither is ever held across I/O or together with another lock
+# (conn dial/close and frame streaming happen strictly outside them).
+DATA_PLANE_LOCK_DAG: Dict[str, Set[str]] = {
+    "_stats_lock": set(),
+    "_lock": set(),
+}
+
+DATA_PLANE_CV_ALIASES: Dict[str, str] = {}
+
+# Shm object store (shm_store.py): one lock guards the accounting
+# tables (_sealed/_unsealed/_spilled/_used).  Spill file moves happen
+# under it by design (eviction must be atomic with the accounting), so
+# it is not a no-block leaf.
+SHM_STORE_LOCK_DAG: Dict[str, Set[str]] = {
+    "_lock": set(),
+}
+
+SHM_STORE_CV_ALIASES: Dict[str, str] = {}
+
 
 def reachable(dag: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
     """Transitive closure: lock → every lock legally acquirable under it."""

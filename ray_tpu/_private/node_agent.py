@@ -51,13 +51,18 @@ class NodeAgent:
         # large objects produced on this host spool locally and are served
         # directly to sibling hosts; the head is only the fallback relay.
         import tempfile
+        from ray_tpu._private import wire
         from ray_tpu._private.data_plane import DataPlaneServer
         self._spool_dir = tempfile.mkdtemp(prefix="rtpu_spool_")
         self._data_plane = DataPlaneServer(
             self._spool_dir, advertise_host=self._advertise_host())
+        # data_proto advertises this host's data-plane wire ceiling so
+        # the head's pooled pull/delete conns skip the per-conn hello
+        # (an old head ignores the extra field)
         resp = self._chan.call("add_node", resources=res,
                                labels=all_labels, remote=True,
-                               data_addr=self._data_plane.advertise_addr)
+                               data_addr=self._data_plane.advertise_addr,
+                               data_proto=wire.DATA_PROTO_MAX)
         self.node_id = resp["node_id"]
         # dedicate this connection to liveness: the head removes the node
         # when it drops (kill -9 / host crash / partition)
@@ -232,6 +237,10 @@ class NodeAgent:
         except OSError:
             pass
         self._data_plane.stop()
+        logger.info("data plane served %d objects / %d bytes over %d conns",
+                    self._data_plane.objects_served,
+                    self._data_plane.bytes_served,
+                    self._data_plane.conns_accepted)
         import shutil
         shutil.rmtree(self._spool_dir, ignore_errors=True)
 

@@ -100,10 +100,10 @@ class ShmObjectStore:
         self.spill_dir = Path(spill_dir) if spill_dir else None
         self._lock = threading.Lock()
         # object_id -> size, LRU order (oldest first); only *sealed* objects.
-        self._sealed: "OrderedDict[str, int]" = OrderedDict()
-        self._unsealed: Dict[str, int] = {}
-        self._spilled: Dict[str, int] = {}
-        self._used = 0
+        self._sealed: "OrderedDict[str, int]" = OrderedDict()   # guarded by: _lock
+        self._unsealed: Dict[str, int] = {}                     # guarded by: _lock
+        self._spilled: Dict[str, int] = {}                      # guarded by: _lock
+        self._used = 0                                          # guarded by: _lock
 
     # -- creation (writer side) ---------------------------------------------
     def create(self, object_id: str, size: int) -> Tuple[memoryview, object]:
@@ -118,12 +118,32 @@ class ShmObjectStore:
             self._used += size
             self._unsealed[object_id] = size
         path = _seg_path(object_id)
-        fd = os.open(str(path), os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
         try:
-            os.ftruncate(fd, max(size, 1))
-            mm = mmap.mmap(fd, max(size, 1), prot=mmap.PROT_READ | mmap.PROT_WRITE)
-        finally:
-            os.close(fd)
+            fd = os.open(str(path), os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                try:
+                    os.ftruncate(fd, max(size, 1))
+                    mm = mmap.mmap(fd, max(size, 1),
+                                   prot=mmap.PROT_READ | mmap.PROT_WRITE)
+                finally:
+                    os.close(fd)
+            except BaseException:
+                # only after a successful O_EXCL open: the segment is
+                # OURS to remove (unlinking on an open failure could
+                # delete a pre-existing segment of the same name)
+                try:
+                    os.unlink(str(path))
+                except OSError:
+                    pass
+                raise
+        except BaseException:
+            # roll back the reservation: a failed create (ENOSPC on a
+            # full tmpfs, EEXIST, mmap failure) must not leave _used
+            # inflated and a phantom _unsealed entry pinned forever
+            with self._lock:
+                if self._unsealed.pop(object_id, None) is not None:
+                    self._used -= size
+            raise
         return memoryview(mm)[:size], mm
 
     def adopt(self, object_id: str, size: int) -> None:

@@ -213,6 +213,52 @@ REF_KINDS = frozenset({
     "release_all",
 })
 
+# ------------------------------------------------------------ bulk frames
+# Data-plane streaming (``_private/data_plane.py``): after a
+# ``fetch_stream`` request/acknowledge exchange (ordinary control
+# messages), the holder pushes the object's bytes as a sequence of
+# length-prefixed RAW BINARY frames — no pickle, no per-chunk
+# request/response round trip.  On a direct TCP connection the frames
+# are written straight on the socket fd (header ``writev`` +
+# ``os.sendfile`` from the spool file: the payload never enters
+# userspace on the send side) and read with ``recv_into`` straight into
+# the receiver's pre-sized buffer.  Through the head's message-pump
+# relay (which re-frames ``recv_bytes``/``send_bytes`` messages and
+# would corrupt raw fd traffic) each frame instead rides one
+# ``send_bytes`` message: same zero-pickle payload, Connection framing
+# as the length prefix, and a zero-length message as the abort marker.
+#
+# Frame header: ``[u8 kind][u32 payload length]`` big-endian.
+#   BULK_DATA  payload = raw object bytes at the stream cursor
+#   BULK_END   payload empty — stream complete (defensive trailer; the
+#              ack already declared the exact byte count)
+#   BULK_ERR   payload = utf-8 error text; the conn STAYS usable (the
+#              server returns to message mode), so a pooled connection
+#              survives a mid-stream miss
+BULK_DATA = 0x01
+BULK_END = 0x02
+BULK_ERR = 0x03
+_BULK_HDR = struct.Struct(">BI")
+BULK_HDR_LEN = _BULK_HDR.size
+
+
+def bulk_pack_header(kind: int, length: int) -> bytes:
+    return _BULK_HDR.pack(kind, length)
+
+
+def bulk_unpack_header(buf) -> Tuple[int, int]:
+    """(kind, payload_length) from a BULK_HDR_LEN-byte header."""
+    return _BULK_HDR.unpack_from(buf, 0)
+
+
+# Data-plane protocol versions, negotiated per connection with the same
+# ``__proto_hello__`` exchange the control plane uses (PR-2).  A legacy
+# holder answers the hello with an unknown-op error and the puller
+# degrades to the v0 chunk ops; a legacy puller never sends the hello
+# and the server keeps speaking v0 to it.
+DATA_PROTO_MIN = 0   # request-per-chunk pickled dicts (seed protocol)
+DATA_PROTO_MAX = 1   # fetch_stream + bulk frames
+
 _c_codec = None
 _c_codec_tried = False
 

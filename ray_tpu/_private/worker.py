@@ -26,7 +26,7 @@ import uuid
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ray_tpu._private import protocol, rtlog
+from ray_tpu._private import data_plane, protocol, rtlog
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import KIND_PUT, KIND_RETURN, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
@@ -252,6 +252,9 @@ class Worker:
         self._gcs_epoch: Optional[str] = None
         self._pull_sem = threading.Semaphore(
             max(1, GLOBAL_CONFIG.transfer_max_inflight))
+        # pooled data-plane connections for peer pulls (dial+HMAC paid
+        # once per holder, not once per object); thread-safe internally
+        self._data_pool = data_plane.DataPlanePool(dial=self._dial_data)
         self.ctx = _TaskContext()
         self._pid = os.getpid()  # cached: getpid is a real syscall per call
         self._ctl_down = True    # flipped by the ctl thread on attach
@@ -483,6 +486,26 @@ class Worker:
             return protocol.connect_addr(addr, timeout=3.0)
         return protocol.connect_addr(addr)
 
+    def _dial_data(self, addr: str):
+        """Data-plane dial for the connection pool: (conn, raw).
+
+        ``raw=True`` only for a DIRECT tcp connection — bulk frames ride
+        the socket fd itself (sendfile / recv_into).  A tunneled
+        connection crosses the head proxy's message pump, which re-frames
+        Connection messages, so bulk frames must ride ``send_bytes``
+        messages there (same ladder as :meth:`open_conn`)."""
+        tcp = protocol.parse_tcp_addr(addr)
+        if tcp is not None:
+            if self.is_client:
+                try:
+                    return protocol.connect_data(*tcp, timeout=3.0), True
+                except (OSError, ConnectionError):
+                    return self._tunnel(addr), False
+            return protocol.connect_data(*tcp, timeout=3.0), True
+        if self.is_client:
+            return self._tunnel(addr), False
+        return protocol.connect_addr(addr), False
+
     def _send_event(self, msg: dict) -> None:
         with self._task_conn_lock:
             if self._task_conn is not None:
@@ -541,7 +564,7 @@ class Worker:
             return wire_cache[0]
 
         if self.is_client and not tiny:
-            loc = self._spool_or_upload(str(oid), wire())
+            loc = self._spool_or_upload(str(oid), pickled, buffers)
             self.rpc("put_object", object_id=str(oid), loc=loc,
                      size=size, contained=contained, node_id=self.node_id)
         elif slab is not None and size <= GLOBAL_CONFIG.slab_object_max_bytes \
@@ -602,22 +625,26 @@ class Worker:
         (reference: PullManager direct-pull with relay fallback)."""
         spool = os.environ.get("RTPU_SPOOL_DIR")
         if spool and meta.get("node_id") == self.node_id:
-            from ray_tpu._private.data_plane import spool_path
             try:
-                return memoryview(spool_path(spool, oid).read_bytes())
+                return memoryview(
+                    data_plane.spool_path(spool, oid).read_bytes())
             except OSError:
                 pass  # spool lost locally: try the network paths
         addr = meta.get("addr")
         if addr:
-            from ray_tpu._private.data_plane import pull_from_peer
             with self._pull_sem:
                 try:
-                    return memoryview(pull_from_peer(
-                        lambda a: self.open_conn(a), addr, oid))
+                    return memoryview(self._data_pool.pull(
+                        addr, oid, size=meta.get("size")))
                 except (OSError, EOFError, ConnectionError,
                         FileNotFoundError):
                     pass  # unreachable holder: head relay below
-        return self._fetch_remote_wire(oid)
+        t0 = time.monotonic()
+        data = self._fetch_remote_wire(oid)
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_data_pull_seconds").observe(
+                time.monotonic() - t0, tags={"path": "relay"})
+        return data
 
     def _fetch_remote_wire(self, oid: str) -> memoryview:
         """Pull one object's wire bytes over the control plane (the
@@ -1474,6 +1501,7 @@ class Worker:
             for ch in self._actor_channels.values():
                 ch.close()
             self._actor_channels.clear()
+        self._data_pool.close_all()
         self.pool.close_all()
 
     # ====================================================== executor (worker)
@@ -1717,11 +1745,12 @@ class Worker:
         contained = [str(r.id) for r in refs]
         if self.is_client:
             # no local data plane: small results inline on the control
-            # plane; large ones stream to the head's store in chunks
+            # plane; large ones spool locally (writev, no full-wire
+            # staging copy) or stream to the head's store in chunks
             if size <= GLOBAL_CONFIG.transfer_chunk_bytes:
                 return {"loc": "inline", "data": to_wire_bytes(pickled, buffers),
                         "size": size, "contained": contained}
-            return {"loc": "upload", "wire": to_wire_bytes(pickled, buffers),
+            return {"loc": "upload", "parts": (pickled, buffers),
                     "size": size, "contained": contained}
         if size <= GLOBAL_CONFIG.inline_object_max_bytes:
             return {"loc": "inline", "data": to_wire_bytes(pickled, buffers),
@@ -1754,15 +1783,21 @@ class Worker:
                 else:
                     shm_write_value(oid, pickled, buffers, overwrite=True)
             elif res["loc"] == "upload":
-                res["loc"] = self._spool_or_upload(oid, res.pop("wire"))
+                pickled, buffers = res.pop("parts")
+                res["loc"] = self._spool_or_upload(oid, pickled, buffers)
             out.append(res)
         return out
 
-    def _spool_or_upload(self, oid: str, wire: bytes) -> str:
+    def _spool_or_upload(self, oid: str, pickled, buffers) -> str:
         """Large bytes leaving a proxied worker: spool on THIS host's P2P
         data plane when an agent provides one (consumers pull from the
         holder directly; head relays only as fallback) — else stream to
         the head's store in chunks.  Returns the sealed loc.
+
+        The spool write rides ``write_value_to_fd``'s writev path: the
+        pickle head and out-of-band buffers stream straight from their
+        backing memory into the spool file — the full wire bytes are
+        never materialized in this process's heap.
 
         NOTE: remote-spooled objects currently do not survive a HEAD
         restart — agents exit on head loss (liveness watch), taking their
@@ -1771,10 +1806,10 @@ class Worker:
         survival) is the follow-on."""
         spool = os.environ.get("RTPU_SPOOL_DIR")
         if spool:
-            from ray_tpu._private.data_plane import write_spool
-            write_spool(spool, oid, wire)
+            data_plane.write_spool_value(spool, oid, pickled, buffers)
             return "remote"
-        self._upload_wire(oid, wire)
+        from ray_tpu._private.serialization import to_wire_bytes
+        self._upload_wire(oid, to_wire_bytes(pickled, buffers))
         return "shm"  # now lives in the head's tmpfs plane
 
     def _upload_wire(self, oid: str, wire: bytes) -> None:

@@ -95,6 +95,25 @@ CATALOG: Dict[str, dict] = {
                     "per batch), 'inline' = per-call handler (in-process "
                     "short circuit / direct RPC)",
         emitted_by="head (GCS)"),
+    # --- P2P object plane (data_plane.py) -----------------------------------
+    "rtpu_data_pull_seconds": dict(
+        kind="histogram", tag_keys=("path",), buckets=LATENCY_BUCKETS,
+        description="End-to-end peer-object pull time: 'direct' = "
+                    "streamed/chunked pull from the holder's data plane "
+                    "(pooled conns), 'relay' = head pull-through fallback "
+                    "for unreachable holders",
+        emitted_by="every puller (worker/driver/head)"),
+    "rtpu_data_bytes_total": dict(
+        kind="counter", tag_keys=("dir",),
+        description="Data-plane bulk bytes moved by this process: "
+                    "'in' = pulled from peers, 'out' = served from the "
+                    "local spool",
+        emitted_by="pullers ('in') and data-plane servers ('out')"),
+    "rtpu_data_pool_conns": dict(
+        kind="gauge", tag_keys=(),
+        description="Open data-plane connections held by this process's "
+                    "connection pool (idle + checked out)",
+        emitted_by="every process with a DataPlanePool"),
     # --- serve data plane ---------------------------------------------------
     "rtpu_serve_requests_total": dict(
         kind="counter", tag_keys=("deployment", "code"),

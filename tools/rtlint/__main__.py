@@ -33,6 +33,12 @@ def run_pass(name: str) -> List[Finding]:
         out += check_guarded(load(priv / "worker.py"),
                              set(lw.WORKER_LOCK_DAG),
                              lw.WORKER_CV_ALIASES)
+        out += check_guarded(load(priv / "data_plane.py"),
+                             set(lw.DATA_PLANE_LOCK_DAG),
+                             lw.DATA_PLANE_CV_ALIASES)
+        out += check_guarded(load(priv / "shm_store.py"),
+                             set(lw.SHM_STORE_LOCK_DAG),
+                             lw.SHM_STORE_CV_ALIASES)
         return out
     if name == "wire":
         from tools.rtlint.wirecheck import check_wire, default_config
