@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
-	databench-quick
+	databench-quick leakcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -15,12 +15,25 @@ lint:
 	$(PY) tools/lint.py
 	$(PY) -m tools.rtlint
 
-# rtlint (DESIGN.md §4d): machine-enforces the GCS locking discipline
-# (lock-order DAG, no blocking under leaf locks), guarded-field
-# annotations, wire-protocol exhaustiveness, spawned-thread hygiene,
-# and metrics-catalog honesty.  Fixture corpus: tests/rtlint_fixtures/.
+# rtlint (DESIGN.md §4d/§4f): machine-enforces the GCS locking
+# discipline (lock-order DAG, no blocking under leaf locks),
+# guarded-field annotations, wire-protocol exhaustiveness,
+# spawned-thread hygiene, metrics-catalog honesty, resource lifecycle
+# (close/transfer on every exit path incl. exception edges), and wire
+# reply discipline (exactly-one-reply per two-way dispatch arm).
+# Fixture corpus: tests/rtlint_fixtures/.  `--list-rules` prints the
+# catalog.
 rtlint:
 	$(PY) -m tools.rtlint
+
+# Runtime half of the resource pass (DESIGN.md §4f): the leak-hammer
+# suite under RAY_TPU_RESOURCE_SANITIZER=1 — N pulls/tasks/actor churns
+# through a live cluster, then assert zero net leaked
+# sockets/fds/mmaps/threads/conns at clean shutdown (acquisition stacks
+# reported otherwise).
+leakcheck:
+	JAX_PLATFORMS=cpu RAY_TPU_RESOURCE_SANITIZER=1 $(PY) -m pytest \
+		tests/test_resource_sanitizer.py -q -x
 
 # Every built-in rtpu_* metric used in the tree must be declared in
 # ray_tpu/util/metrics_catalog.py — and every declared one must be live

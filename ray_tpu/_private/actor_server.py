@@ -51,27 +51,36 @@ class ActorServer:
             sock_name = f"a_{self.actor_id[:12]}_{os.getpid()}.sock"
             self.addr = worker.session.socket_path(sock_name)
             self._listener = protocol.make_listener(self.addr)
-        self._queue: "queue.Queue" = queue.Queue()
-        self._send_lock = threading.Lock()  # replies come from executor
-        # threads AND the asyncio loop; Connection.send isn't thread-safe
-        # Serial actors (max_concurrency=1) execute calls directly on the
-        # connection-reader thread under _exec_lock instead of hopping
-        # through the queue to the executor thread: one fewer thread
-        # handoff per call (~2 GIL wakeups) on the serial-RT hot path.
-        # The lock preserves the one-call-at-a-time contract across
-        # multiple caller connections exactly as the single executor
-        # thread did.
-        self._exec_lock = threading.Lock()
-        self._direct_exec = self.max_concurrency == 1
-        self._stopped = threading.Event()
-        self._loop: Optional[asyncio.AbstractEventLoop] = None
-        if any(inspect.iscoroutinefunction(getattr(type(instance), m, None))
-               for m in dir(type(instance))):
-            self._loop = asyncio.new_event_loop()
-            threading.Thread(target=self._loop.run_forever,
-                             name="actor-asyncio", daemon=True).start()
-        threading.Thread(target=self._accept_loop, name="actor-accept",
-                         daemon=True).start()
+        try:
+            self._queue: "queue.Queue" = queue.Queue()
+            self._send_lock = threading.Lock()  # replies come from executor
+            # threads AND the asyncio loop; Connection.send isn't
+            # thread-safe.
+            # Serial actors (max_concurrency=1) execute calls directly on
+            # the connection-reader thread under _exec_lock instead of
+            # hopping through the queue to the executor thread: one fewer
+            # thread handoff per call (~2 GIL wakeups) on the serial-RT
+            # hot path.  The lock preserves the one-call-at-a-time
+            # contract across multiple caller connections exactly as the
+            # single executor thread did.
+            self._exec_lock = threading.Lock()
+            self._direct_exec = self.max_concurrency == 1
+            self._stopped = threading.Event()
+            self._loop: Optional[asyncio.AbstractEventLoop] = None
+            if any(inspect.iscoroutinefunction(
+                    getattr(type(instance), m, None))
+                   for m in dir(type(instance))):
+                self._loop = asyncio.new_event_loop()
+                threading.Thread(target=self._loop.run_forever,
+                                 name="actor-asyncio", daemon=True).start()
+            threading.Thread(target=self._accept_loop, name="actor-accept",
+                             daemon=True).start()
+        except BaseException:
+            # a failed boot returns no server: the caller cannot close
+            # the listener it never received (an actor-creation retry
+            # would otherwise leak one bound port/socket per attempt)
+            self._listener.close()
+            raise
 
     # ------------------------------------------------------------- transport
     def _accept_loop(self) -> None:
@@ -81,22 +90,34 @@ class ActorServer:
                                    self._conn_reader, "actor-conn-reader")
 
     def _conn_reader(self, conn) -> None:
-        while not self._stopped.is_set():
+        try:
+            while not self._stopped.is_set():
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                if not self._direct_exec:
+                    self._queue.put((conn, msg))
+                    continue
+                try:
+                    with self._exec_lock:
+                        self._handle_call(conn, msg)
+                except ActorExit:
+                    self._shutdown()
+                    return
+                except Exception:  # noqa: BLE001
+                    # _handle_call replies its own errors, so reaching
+                    # here means the REPLY machinery failed and the
+                    # conn's framing state is unknown: tear it down so
+                    # the caller sees EOF (→ actor-error/resubmit path),
+                    # never an infinite hang on a swallowed dispatch
+                    logger.exception("actor call handling failed")
+                    return
+        finally:
             try:
-                msg = conn.recv()
-            except (EOFError, OSError):
-                return
-            if not self._direct_exec:
-                self._queue.put((conn, msg))
-                continue
-            try:
-                with self._exec_lock:
-                    self._handle_call(conn, msg)
-            except ActorExit:
-                self._shutdown()
-                return
-            except Exception:  # noqa: BLE001
-                logger.exception("actor call handling failed")
+                conn.close()
+            except OSError:
+                pass
 
     def serve_forever(self) -> None:
         if self.max_concurrency > 1:
@@ -119,7 +140,13 @@ class ActorServer:
                 self._shutdown()
                 return
             except Exception:  # noqa: BLE001
+                # reply machinery failed (handlers reply their own
+                # errors): EOF the caller instead of stranding it
                 logger.exception("actor call handling failed")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     # -------------------------------------------------------------- execution
     def _run_method(self, method_name: str, args: list, kwargs: dict) -> Any:
@@ -179,30 +206,41 @@ class ActorServer:
 
     def _complete_async_call(self, conn, msg, value, err) -> None:
         return_ids: List[str] = msg["return_ids"]
-        self._observe_call(msg, msg.pop("_exec_t0", None))
         w = self.worker
         try:
-            if err is None:
+            try:
+                self._observe_call(msg, msg.pop("_exec_t0", None))
+                if err is None:
+                    try:
+                        results = w._store_results(return_ids, value,
+                                                   msg["num_returns"])
+                        ok = True
+                    except Exception as store_err:  # noqa: BLE001 - e.g.
+                        # unpicklable result: the caller must still get a
+                        # reply
+                        err = store_err
+                if err is not None:
+                    if isinstance(err, ActorExit):
+                        wrapped: BaseException = exc.RayActorError(
+                            self.actor_id, "actor exited")
+                    else:
+                        wrapped = exc.RayTaskError.from_exception(
+                            f"{self.spec.get('class_name', 'Actor')}."
+                            f"{msg['method']}", err)
+                    err_res = {"loc": "error",
+                               "data": serialize_to_bytes(wrapped)[0]}
+                    results = [err_res for _ in return_ids]
+                    ok = False
+                self._seal_and_reply(conn, msg, results, ok)
+            except Exception:  # noqa: BLE001 - reply machinery failed.
+                # This runs as a loop-submitted executor job: an escaping
+                # exception lands in an unobserved Future — the caller
+                # would hang forever.  EOF it instead.
+                logger.exception("async actor call completion failed")
                 try:
-                    results = w._store_results(return_ids, value,
-                                               msg["num_returns"])
-                    ok = True
-                except Exception as store_err:  # noqa: BLE001 - e.g.
-                    # unpicklable result: the caller must still get a reply
-                    err = store_err
-            if err is not None:
-                if isinstance(err, ActorExit):
-                    wrapped: BaseException = exc.RayActorError(
-                        self.actor_id, "actor exited")
-                else:
-                    wrapped = exc.RayTaskError.from_exception(
-                        f"{self.spec.get('class_name', 'Actor')}."
-                        f"{msg['method']}", err)
-                err_res = {"loc": "error",
-                           "data": serialize_to_bytes(wrapped)[0]}
-                results = [err_res for _ in return_ids]
-                ok = False
-            self._seal_and_reply(conn, msg, results, ok)
+                    conn.close()
+                except OSError:
+                    pass
         finally:
             if isinstance(err, ActorExit):
                 self._shutdown()
@@ -244,7 +282,11 @@ class ActorServer:
                     asyncio.run_coroutine_threadsafe(
                         self._run_async_call(method, args, kwargs, conn, msg),
                         self._loop)
-                    return  # executor thread freed; reply comes from the loop
+                    # executor thread freed; the reply obligation moves to
+                    # the event loop (_run_async_call → _complete_async_call
+                    # replies or tears the conn down on every path)
+                    # rtlint: reply-missing-ok(deferred reply via event loop)
+                    return
             span = tracing.SpanContext.from_dict(msg.get("trace_ctx"))
             if span is not None:
                 # child span per method call; timeline events link back to
@@ -286,7 +328,8 @@ class ActorServer:
         self._observe_call(msg, t_exec)
         self._seal_and_reply(conn, msg, results, ok)
 
-    def _seal_and_reply(self, conn, msg: dict, results: List[dict], ok: bool) -> None:
+    def _seal_and_reply(self, conn, msg: dict, results: List[dict],
+                        ok: bool) -> None:  # rtlint: replies
         w = self.worker
         # authoritative: seal with GCS (one-way on the worker's task channel)
         w._send_event({"kind": "actor_result", "return_ids": msg["return_ids"],

@@ -69,7 +69,8 @@ def spool_capacity_bytes() -> int:
     return mb * 1024 * 1024
 
 
-def _admit_spool(spool_dir: str, object_id: str, size: int):
+def _admit_spool(spool_dir: str,
+                 object_id: str, size: int):  # rtlint: returns(file)
     """Admission check + reservation for one spool write; returns the
     opened ``.tmp`` file (positioned at 0, reserved to ``size``).
 
@@ -125,7 +126,7 @@ def _admit_spool(spool_dir: str, object_id: str, size: int):
     return f
 
 
-def _seal_spool(spool_dir: str, object_id: str, f) -> None:
+def _seal_spool(spool_dir: str, object_id: str, f) -> None:  # rtlint: owns(f)
     import fcntl
     f.close()
     path = spool_path(spool_dir, object_id)
@@ -138,7 +139,7 @@ def _seal_spool(spool_dir: str, object_id: str, f) -> None:
         os.replace(path.with_suffix(".tmp"), path)
 
 
-def _abort_spool(spool_dir: str, object_id: str, f) -> None:
+def _abort_spool(spool_dir: str, object_id: str, f) -> None:  # rtlint: owns(f)
     f.close()
     try:  # a failed write must not hold its reservation
         os.unlink(spool_path(spool_dir, object_id).with_suffix(".tmp"))
@@ -201,7 +202,7 @@ class _SpoolFdCache:
         # object_id -> (master fd, size), LRU order (oldest first)
         self._fds: Dict[str, tuple] = OrderedDict()  # guarded by: _lock
 
-    def checkout(self, object_id: str):
+    def checkout(self, object_id: str):  # rtlint: returns(fd)
         """(dup'd fd, file size); the caller owns the dup and must
         close it.  Raises OSError/FileNotFoundError on a spool miss."""
         with self._lock:
@@ -278,19 +279,25 @@ class DataPlaneServer:
         self.spool_dir = spool_dir
         Path(spool_dir).mkdir(parents=True, exist_ok=True)
         self._listener = protocol.make_tcp_listener(host, 0)
-        self.port = self._listener.address[1]
-        self.advertise_addr = f"tcp://{advertise_host or host}:{self.port}"
-        # serving counters: one _serve thread per connection mutates
-        # them, stats/tests read them — a bare += would drop updates
-        self._stats_lock = threading.Lock()
-        self.bytes_served = 0       # guarded by: _stats_lock
-        self.objects_served = 0     # guarded by: _stats_lock
-        self.conns_accepted = 0     # guarded by: _stats_lock
-        self._conns: List = []      # guarded by: _stats_lock
-        self._fd_cache = _SpoolFdCache(spool_dir)
-        self._stop = threading.Event()
-        threading.Thread(target=self._accept_loop, name="data-plane",
-                         daemon=True).start()
+        try:
+            self.port = self._listener.address[1]
+            self.advertise_addr = \
+                f"tcp://{advertise_host or host}:{self.port}"
+            # serving counters: one _serve thread per connection mutates
+            # them, stats/tests read them — a bare += would drop updates
+            self._stats_lock = threading.Lock()
+            self.bytes_served = 0       # guarded by: _stats_lock
+            self.objects_served = 0     # guarded by: _stats_lock
+            self.conns_accepted = 0     # guarded by: _stats_lock
+            self._conns: List = []      # guarded by: _stats_lock
+            self._fd_cache = _SpoolFdCache(spool_dir)
+            self._stop = threading.Event()
+            threading.Thread(target=self._accept_loop, name="data-plane",
+                             daemon=True).start()
+        except BaseException:
+            # a failed boot returns no server: close the bound port
+            self._listener.close()
+            raise
 
     def _accept_loop(self) -> None:
         protocol.serve_accept_loop(self._listener, self._stop.is_set,
@@ -382,7 +389,7 @@ class DataPlaneServer:
                 pass
 
     # ---------------------------------------------------------- streaming
-    def _serve_stream(self, conn, msg: dict) -> bool:
+    def _serve_stream(self, conn, msg: dict) -> bool:  # rtlint: replies
         """One fetch_stream: ack {size, len} then push bulk frames.
 
         Returns False when the connection is no longer in a known
@@ -661,7 +668,8 @@ class _PoolConn:
 
     __slots__ = ("conn", "addr", "raw", "proto", "last_used")
 
-    def __init__(self, conn, addr: str, raw: bool, proto: int):
+    def __init__(self, conn, addr: str,
+                 raw: bool, proto: int):  # rtlint: owns(conn)
         self.conn = conn
         self.addr = addr
         self.raw = raw          # direct fd (sendfile/recv_into legal)?
@@ -699,7 +707,7 @@ class DataPlanePool:
         if GLOBAL_CONFIG.metrics_enabled:
             mcat.get("rtpu_data_pool_conns").set(self._open)
 
-    def acquire(self, addr: str) -> _PoolConn:
+    def acquire(self, addr: str) -> _PoolConn:  # rtlint: returns(conn)
         with self._lock:
             lst = self._idle.get(addr)
             if lst:
@@ -726,7 +734,7 @@ class DataPlanePool:
             self._publish_open_locked()
         return pc
 
-    def release(self, pc: _PoolConn) -> None:
+    def release(self, pc: _PoolConn) -> None:  # rtlint: owns(pc)
         """Return a healthy conn; evict LRU idles beyond the bound."""
         pc.last_used = time.monotonic()
         victims: List[_PoolConn] = []
@@ -751,7 +759,7 @@ class DataPlanePool:
             except OSError:
                 pass
 
-    def discard(self, pc: _PoolConn) -> None:
+    def discard(self, pc: _PoolConn) -> None:  # rtlint: owns(pc)
         """Drop a broken checked-out conn."""
         with self._lock:
             self._open -= 1
@@ -891,6 +899,10 @@ class DataPlanePool:
             mine = pc is None
             try:
                 if mine:
+                    # settled on every path, but the discharge is
+                    # mine-correlated (release/discard run iff this
+                    # stripe acquired) — correlation beyond the analyzer
+                    # rtlint: resource-leak-ok(mine-correlated settle)
                     pc = self.acquire(addr)
                 self._stream_range(pc, object_id, mv[off:off + ln],
                                    off, ln)

@@ -205,6 +205,11 @@ _INPROC_SERVER: Optional["GcsServer"] = None
 
 class GcsServer:
     def __init__(self, session: Session, head_resources: Dict[str, float]):
+        # Sanitizer first (RAY_TPU_RESOURCE_SANITIZER=1, §4f): every
+        # acquisition below — shm maps, the listener, worker dials —
+        # must be discharged by shutdown(), so tracking starts here
+        from ray_tpu._private import resource_sanitizer
+        resource_sanitizer.maybe_install()
         self.session = session
         self.store = ShmObjectStore(spill_dir=str(session.spill_dir))
         # Native C++ slab store: the small-object data plane (workers attach
@@ -373,12 +378,21 @@ class GcsServer:
         self.rpc_path = session.socket_path("gcs.sock")
         self._listener = protocol.make_listener(self.rpc_path)
         self._threads: List[threading.Thread] = []
-        t = threading.Thread(target=self._accept_loop, name="gcs-accept", daemon=True)
-        t.start()
-        self._threads.append(t)
-        m = threading.Thread(target=self._monitor_loop, name="gcs-monitor", daemon=True)
-        m.start()
-        self._threads.append(m)
+        try:
+            t = threading.Thread(target=self._accept_loop,
+                                 name="gcs-accept", daemon=True)
+            t.start()
+            self._threads.append(t)
+            m = threading.Thread(target=self._monitor_loop,
+                                 name="gcs-monitor", daemon=True)
+            m.start()
+            self._threads.append(m)
+        except BaseException:
+            # a failed boot returns no server object: the bound socket
+            # file must not survive it (the next head would unlink a
+            # listener it does not own)
+            self._listener.close()
+            raise
         # In-process dispatch short-circuit (reference analog: core_worker
         # short-circuiting its local raylet/plasma): a driver whose head
         # lives in ITS OWN process skips the socket + serve-thread wakeup
@@ -3320,7 +3334,14 @@ class GcsServer:
             if st is None:
                 fd = os.open(str(_seg_path(oid)),
                              os.O_CREAT | os.O_RDWR, 0o600)
-                os.ftruncate(fd, max(total, 1))
+                try:
+                    os.ftruncate(fd, max(total, 1))
+                except OSError:
+                    # ENOSPC on a full tmpfs: the fd must not outlive
+                    # the failed reservation (one leaked fd per retried
+                    # upload chunk adds up to EMFILE on a busy head)
+                    os.close(fd)
+                    raise
                 st = {"fd": fd, "offsets": set(), "got": 0,
                       "ts": time.time()}
                 self._staging[oid] = st
@@ -3438,3 +3459,8 @@ class GcsServer:
         self.store.shutdown()
         if self.slab is not None:
             self.slab.close()
+        # leak oracle: a CLEAN head shutdown must leave zero net
+        # tracked resources (the driver's Worker.shutdown ran first —
+        # __init__.shutdown() orders worker before head)
+        from ray_tpu._private import resource_sanitizer
+        resource_sanitizer.assert_clean_at_shutdown("gcs-shutdown")

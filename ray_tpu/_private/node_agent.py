@@ -46,40 +46,61 @@ class NodeAgent:
             res["TPU"] = self.num_tpus
         all_labels = {"agent": "1", **(labels or {})}
         self._conn = protocol.tunnel_connect(*self.head, "gcs")
-        self._chan = protocol.RpcChannel(self._conn, negotiate=True)
-        # P2P object plane (reference: ObjectManager node↔node transfer):
-        # large objects produced on this host spool locally and are served
-        # directly to sibling hosts; the head is only the fallback relay.
-        import tempfile
-        from ray_tpu._private import wire
-        from ray_tpu._private.data_plane import DataPlaneServer
-        self._spool_dir = tempfile.mkdtemp(prefix="rtpu_spool_")
-        self._data_plane = DataPlaneServer(
-            self._spool_dir, advertise_host=self._advertise_host())
-        # data_proto advertises this host's data-plane wire ceiling so
-        # the head's pooled pull/delete conns skip the per-conn hello
-        # (an old head ignores the extra field)
-        resp = self._chan.call("add_node", resources=res,
-                               labels=all_labels, remote=True,
-                               data_addr=self._data_plane.advertise_addr,
-                               data_proto=wire.DATA_PROTO_MAX)
-        self.node_id = resp["node_id"]
-        # dedicate this connection to liveness: the head removes the node
-        # when it drops (kill -9 / host crash / partition)
-        self._chan.send_oneway("agent_attach", node_id=self.node_id)
-        self._procs: List[subprocess.Popen] = []
-        self._stop = threading.Event()
-        # watch the liveness conn from OUR side too: a dropped TCP conn
-        # makes the head remove the node; without this the agent would
-        # keep an orphaned pool running, silently detached
-        threading.Thread(target=self._liveness_watch, daemon=True,
-                         name="agent-liveness").start()
-        # per-node OOM killer (reference: MemoryMonitor runs inside each
-        # raylet): THIS host's pressure, THIS host's pids.  Victim policy
-        # stays with the head (pick_oom_victim RPC) which pre-marks the
-        # task so the death surfaces as a retriable OutOfMemoryError.
-        threading.Thread(target=self._memory_watch, daemon=True,
-                         name="agent-memory-monitor").start()
+        try:
+            self._chan = protocol.RpcChannel(self._conn, negotiate=True)
+            # P2P object plane (reference: ObjectManager node↔node
+            # transfer): large objects produced on this host spool
+            # locally and are served directly to sibling hosts; the head
+            # is only the fallback relay.
+            import tempfile
+            from ray_tpu._private import wire
+            from ray_tpu._private.data_plane import DataPlaneServer
+            self._spool_dir = tempfile.mkdtemp(prefix="rtpu_spool_")
+            self._data_plane = DataPlaneServer(
+                self._spool_dir, advertise_host=self._advertise_host())
+            # data_proto advertises this host's data-plane wire ceiling
+            # so the head's pooled pull/delete conns skip the per-conn
+            # hello (an old head ignores the extra field)
+            resp = self._chan.call(
+                "add_node", resources=res, labels=all_labels, remote=True,
+                data_addr=self._data_plane.advertise_addr,
+                data_proto=wire.DATA_PROTO_MAX)
+            self.node_id = resp["node_id"]
+            # dedicate this connection to liveness: the head removes the
+            # node when it drops (kill -9 / host crash / partition)
+            self._chan.send_oneway("agent_attach", node_id=self.node_id)
+            self._procs: List[subprocess.Popen] = []
+            self._stop = threading.Event()
+            # watch the liveness conn from OUR side too: a dropped TCP
+            # conn makes the head remove the node; without this the agent
+            # would keep an orphaned pool running, silently detached
+            threading.Thread(target=self._liveness_watch, daemon=True,
+                             name="agent-liveness").start()
+            # per-node OOM killer (reference: MemoryMonitor runs inside
+            # each raylet): THIS host's pressure, THIS host's pids.
+            # Victim policy stays with the head (pick_oom_victim RPC)
+            # which pre-marks the task so the death surfaces as a
+            # retriable OutOfMemoryError.
+            threading.Thread(target=self._memory_watch, daemon=True,
+                             name="agent-memory-monitor").start()
+        except BaseException:
+            # a failed join (version fence, head rejecting add_node,
+            # agent_attach send failing) returns no agent: close the
+            # dialed conn, stop the already-listening data plane, and
+            # drop the spool dir — a retry loop around NodeAgent() must
+            # not accrete a listener + tempdir per attempt
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            dp = getattr(self, "_data_plane", None)
+            if dp is not None:
+                dp.stop()
+            sd = getattr(self, "_spool_dir", None)
+            if sd is not None:
+                import shutil
+                shutil.rmtree(sd, ignore_errors=True)
+            raise
         logger.info("joined head %s:%s as node %s (%d workers)",
                     head_host, head_port, self.node_id[:8], self.num_workers)
 
@@ -225,13 +246,16 @@ class NodeAgent:
                 p.terminate()
             except OSError:
                 pass
+        ch = None
         try:  # fresh conn: the attach conn is dedicated to liveness
             ch = protocol.RpcChannel(
                 protocol.tunnel_connect(*self.head, "gcs"), negotiate=True)
             ch.call("remove_node", node_id=self.node_id)
-            ch.close()
         except Exception:  # noqa: BLE001 - head may already be gone
             pass
+        finally:
+            if ch is not None:
+                ch.close()
         try:
             self._conn.close()
         except OSError:

@@ -124,23 +124,31 @@ def connect_tcp(host: str, port: int,
     import struct
     from multiprocessing.connection import answer_challenge, deliver_challenge
     sock = socket.create_connection((host, port), timeout=timeout)
-    tv = struct.pack("ll", int(timeout), int((timeout % 1.0) * 1e6))
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
-    sock.settimeout(None)  # blocking fd; the sockopts bound each syscall
-    conn = Connection(sock.detach())
+    try:
+        tv = struct.pack("ll", int(timeout), int((timeout % 1.0) * 1e6))
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+        sock.settimeout(None)  # blocking fd; the sockopts bound each syscall
+        conn = Connection(sock.detach())
+    except BaseException:
+        sock.close()  # no-op after a successful detach
+        raise
     try:
         answer_challenge(conn, _AUTHKEY)
         deliver_challenge(conn, _AUTHKEY)
+        # handshake done — restore unbounded blocking I/O for normal
+        # traffic.  The wrapper MUST detach even when setsockopt fails:
+        # a GC'd undetached wrapper closes the fd out from under conn.
+        s2 = socket.socket(fileno=conn.fileno())
+        try:
+            zero = struct.pack("ll", 0, 0)
+            s2.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, zero)
+            s2.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, zero)
+        finally:
+            s2.detach()
     except BaseException:
         conn.close()
         raise
-    # handshake done — restore unbounded blocking I/O for normal traffic
-    s2 = socket.socket(fileno=conn.fileno())
-    zero = struct.pack("ll", 0, 0)
-    s2.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, zero)
-    s2.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, zero)
-    s2.detach()
     return conn
 
 
@@ -179,7 +187,11 @@ def connect_data(host: str, port: int,
     """Dial a peer's data-plane listener: bounded connect + handshake,
     then bulk-transfer socket tuning."""
     conn = connect_tcp(host, port, timeout=timeout)
-    tune_data_socket(conn)
+    try:
+        tune_data_socket(conn)
+    except BaseException:
+        conn.close()
+        raise
     return conn
 
 
@@ -272,8 +284,13 @@ def tunnel_connect(host: str, port: int, target: str) -> Connection:
     """Open a proxied connection to a cluster-local socket via the client
     proxy (single implementation of the {target}→{ok|error} handshake)."""
     conn = connect_tcp(host, port)
-    conn.send({"target": target})
-    resp = conn.recv()
+    try:
+        conn.send({"target": target})
+        resp = conn.recv()
+    except BaseException:
+        # a proxy that dies mid-handshake must not leak the dialed conn
+        conn.close()
+        raise
     if resp.get("error"):
         conn.close()
         raise ConnectionError(f"client proxy: {resp['error']}")
@@ -299,12 +316,20 @@ class RpcChannel:
 
     _rid_counter = itertools.count(1)
 
-    def __init__(self, conn: Connection, negotiate: bool = False):
+    def __init__(self, conn: Connection,
+                 negotiate: bool = False):  # rtlint: owns(conn)
         self._conn = conn
         self._lock = threading.Lock()
         self.version = 0  # legacy until negotiated
         if negotiate:
-            self.negotiate()
+            try:
+                self.negotiate()
+            except BaseException:
+                # the channel owns the conn from here on: a failed
+                # negotiation (version fence, dead peer) must close it,
+                # not strand it — the caller gets no channel back
+                self.close()
+                raise
 
     def negotiate(self) -> int:
         from ray_tpu._private import wire

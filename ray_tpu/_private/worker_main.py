@@ -39,10 +39,15 @@ class _LogShipper(io.TextIOBase):
 
 
 def main() -> None:
-    from ray_tpu._private import rtlog
+    from ray_tpu._private import resource_sanitizer, rtlog
     from ray_tpu._private.session import Session
     from ray_tpu._private.worker import Worker, set_global_worker
     from ray_tpu._private.config import GLOBAL_CONFIG
+
+    # leak oracle (env rides Popen inheritance from the head): every
+    # acquisition from here on must be discharged by the clean-stop
+    # path below
+    resource_sanitizer.maybe_install()
 
     node_id = os.environ["RTPU_NODE_ID"]
     proxy = os.environ.get("RTPU_PROXY_ADDR")
@@ -69,6 +74,12 @@ def main() -> None:
         sys.stdout = _LogShipper(worker, "stdout", sys.stdout)
         sys.stderr = _LogShipper(worker, "stderr", sys.stderr)
     worker.run_worker_loop()
+    # only a CLEAN stop reaches here (stop_worker / head-gone exit);
+    # SIGTERM/SIGKILL teardown never does — the oracle asserts exactly
+    # the paths the static pass models
+    if resource_sanitizer.sanitizer_enabled():
+        worker.shutdown()
+        resource_sanitizer.assert_clean_at_shutdown("worker-exit")
 
 
 if __name__ == "__main__":

@@ -83,10 +83,7 @@ class XlaCollectiveGroup:
         else:
             raise ValueError(kind)
 
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
+        from ray_tpu._private.jax_compat import shard_map
         fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
         return jax.jit(fn)
 

@@ -1,0 +1,52 @@
+"""Positive corpus for the reply-discipline pass: every dispatch arm
+here violates the contract and must be flagged."""
+
+
+class Srv:
+    def _serve(self, conn):
+        while True:
+            msg = conn.recv()
+            op = msg.get("op")
+            if op == "missing_on_branch":
+                if msg.get("x"):
+                    conn.send({"ok": True})
+                continue              # reply-missing: the else path
+            if op == "double":
+                conn.send({"ok": True})
+                conn.send({"ok": True})   # reply-double
+            if op == "escape":
+                data = compute(msg)   # reply-escape: compute may raise
+                conn.send({"data": data})
+            if op == "raises":
+                if not msg.get("x"):
+                    raise ValueError("no x")   # reply-escape
+                conn.send({})
+            if op == "push":
+                conn.send({"ack": True})       # reply-oneway
+
+    def _pump(self, conn):
+        while True:
+            msg = conn.recv()
+            try:
+                self._dispatch(conn, msg)
+            except Exception:
+                log("dispatch failed")         # reply-swallow: keeps
+                #                                looping, caller hangs
+
+    def _dispatch(self, conn, msg):
+        conn.send({})
+
+    def _h_lookup(self, msg):
+        # GCS-style handler: replies by RETURNING — sending directly
+        # would double-reply through the dispatch loop
+        conn = msg["conn"]
+        conn.send({"oops": True})              # reply-side-channel
+        return {"ok": True}
+
+
+def compute(msg):
+    return 1 / msg["denominator"]
+
+
+def log(s):
+    return s

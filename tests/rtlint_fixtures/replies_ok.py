@@ -1,0 +1,71 @@
+"""Negative corpus for the reply-discipline pass: every settle form
+the pass recognizes, plus one waiver.  Must stay silent."""
+
+
+class Srv:
+    def _serve(self, conn):
+        while True:
+            msg = conn.recv()
+            op = msg.get("op")
+            if op == "plain":
+                conn.send({"ok": True})
+            if op == "both_branches":
+                if msg.get("x"):
+                    conn.send({"ok": True})
+                else:
+                    conn.send({"error": "no x"})
+            if op == "error_reply":
+                try:
+                    data = compute(msg)
+                    conn.send({"data": data})
+                except Exception as e:
+                    conn.send({"error": str(e)})
+            if op == "teardown":
+                # a broken stream settles by EOF, not by reply
+                if not msg.get("x"):
+                    conn.close()
+                    return
+                conn.send({})
+            if op == "helper":
+                # the annotated helper settles on every path
+                if not self._reply_stream(conn, msg):
+                    return
+            if op == "deferred":
+                self._queue.append((conn, msg))
+                # the drain thread owns the reply obligation now
+                # rtlint: reply-missing-ok(deferred to the drain thread)
+                continue
+            if op == "push":
+                self._note(msg)       # oneway: no reply, no finding
+
+    def _reply_stream(self, conn, msg):  # rtlint: replies
+        try:
+            conn.send({"ok": True})
+            return True
+        except OSError:
+            return False
+
+    def _pump_reraise(self, conn):
+        while True:
+            msg = conn.recv()
+            try:
+                self._dispatch(conn, msg)
+            except Exception:
+                try:
+                    conn.close()      # EOF routes the caller out
+                except OSError:
+                    pass
+                raise
+
+    def _dispatch(self, conn, msg):
+        conn.send({})
+
+    def _note(self, msg):
+        return msg
+
+    def _h_lookup(self, msg):
+        return {"ok": True}           # replies by returning: clean
+
+
+def compute(msg):
+    return 1 / msg["denominator"]

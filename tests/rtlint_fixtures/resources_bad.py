@@ -1,0 +1,87 @@
+"""Positive corpus for the resource-lifecycle pass: every function
+here leaks on at least one path and must be flagged."""
+import mmap
+import os
+import socket
+import threading
+
+
+def leak_on_fallthrough(addr):
+    s = socket.socket()          # resource-leak: never discharged
+    s.connect(addr)
+
+
+def leak_on_early_return(path, flag):
+    fd = os.open(path, os.O_RDONLY)   # resource-leak on the flag path
+    if flag:
+        return None                   # fd still live
+    data = os.pread(fd, 10, 0)
+    os.close(fd)
+    return data
+
+
+def leak_between_open_and_store(reg, path):
+    fd = os.open(path, os.O_RDONLY)   # resource-exc-leak: parse() may
+    size = parse(path)                # raise while fd is live
+    reg[path] = (fd, size)
+
+
+def leak_dropped_on_the_floor(path):
+    os.open(path, os.O_RDONLY)        # resource-leak: not even bound
+
+
+def leak_via_unowning_helper(addr):
+    s = socket.socket()               # resource-leak: helper only logs
+    s.connect(addr)
+    observe(s)
+
+
+def leak_raise_while_live(path):
+    fd = os.open(path, os.O_RDONLY)
+    if os.fstat(fd).st_size == 0:
+        raise ValueError("empty")     # resource-exc-leak: fd stranded
+    os.close(fd)
+
+
+def leak_mmap_on_error_path(fd, n):
+    m = mmap.mmap(fd, n)              # resource-exc-leak: validate()
+    validate(m)                       # may raise before the return
+    return m
+
+
+def leak_nondaemon_thread():
+    t = threading.Thread(target=work, name="w", daemon=False)
+    t.start()                         # resource-leak: never joined or
+    #                                   stored (daemon=True would waive)
+
+
+class LeakyCtor:
+    def __init__(self, path):
+        self.fd = os.open(path, os.O_RDWR)   # resource-exc-leak: the
+        probe(path)                          # raise strands self.fd —
+        #                                      the caller gets no object
+
+
+def observe(s):
+    log(s.fileno())
+
+
+def parse(path):
+    return len(path)
+
+
+def validate(m):
+    if len(m) == 0:
+        raise ValueError
+
+
+def work():
+    pass
+
+
+def log(x):
+    return x
+
+
+def probe(p):
+    return p

@@ -1,0 +1,96 @@
+"""Negative corpus for the resource-lifecycle pass: every discharge
+form the pass recognizes, plus one waiver.  Must stay silent."""
+import os
+import socket
+import threading
+
+
+def with_block(addr):
+    with socket.socket() as s:
+        s.connect(addr)
+        return s.recv(10)
+
+
+def try_finally(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        return os.pread(fd, 10, 0)
+    finally:
+        os.close(fd)
+
+
+def close_on_error_path(addr):
+    s = socket.socket()
+    try:
+        s.connect(addr)
+    except OSError:
+        s.close()
+        raise
+    return s                          # ownership-transferred: returned
+
+
+def stored_into_owner(self, addr):
+    s = socket.socket()
+    self._conns[addr] = s             # ownership-transferred: stored
+
+
+def appended_to_container(pool, addr):
+    s = socket.socket()
+    pool.append(s)                    # ownership-transferred: container
+
+
+def handed_to_thread(addr):
+    s = socket.socket()
+    t = threading.Thread(target=serve, args=(s,), name="srv", daemon=True)
+    t.start()                         # s rides the thread; t is daemon
+
+
+def adopt(registry, conn):  # rtlint: owns(conn)
+    try:
+        registry.add(conn)
+    except Exception:
+        conn.close()
+        raise
+
+
+def via_owning_helper(registry, addr):
+    s = socket.socket()
+    adopt(registry, s)                # callee owns it (annotation)
+
+
+def settle(conn):
+    """Provably-owning helper WITHOUT an annotation: the fixed point
+    sees the param discharged on every path."""
+    conn.close()
+
+
+def via_computed_helper(addr):
+    s = socket.socket()
+    settle(s)
+
+
+def open_pair(path):  # rtlint: returns(fd)
+    return os.open(path, os.O_RDONLY), 0
+
+
+def factory_call_is_tracked(path):
+    fd, _ = open_pair(path)
+    try:
+        return os.pread(fd, 4, 0)
+    finally:
+        os.close(fd)
+
+
+def waived_intentional_leak(path):
+    # rtlint: resource-leak-ok(process-lifetime fd by design)
+    fd = os.open(path, os.O_RDONLY)
+    note = f"pinned {path} for the process lifetime"
+    return note
+
+
+def daemon_thread_is_policy():
+    threading.Thread(target=serve, name="bg", daemon=True).start()
+
+
+def serve(s):
+    return s

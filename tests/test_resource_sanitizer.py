@@ -1,0 +1,218 @@
+"""Runtime leak-sanitizer oracle (ray_tpu/_private/resource_sanitizer,
+``RAY_TPU_RESOURCE_SANITIZER=1``) — the dynamic half of rtlint's
+static resource pass (DESIGN.md §4f).
+
+Two halves:
+
+- registry-level: seeded leaks of every tracked kind are caught with
+  the acquiring stack; every discharge form (close, detach, GC,
+  close-by-another-wrapper) reads as clean; install/uninstall restore
+  the patched acquisition points exactly.
+- cluster-level leak hammer: a real driver + in-proc head + spawned
+  workers runs tasks, actor churn, and large-object put/get under the
+  sanitizer, and the clean-shutdown assert wired into
+  ``GcsServer.shutdown`` proves zero net resources; a leak seeded in
+  the driver flips the same shutdown into ``ResourceLeakError`` naming
+  this file in the acquisition stack.
+"""
+
+import mmap
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from conftest import time_scale
+from ray_tpu._private import resource_sanitizer as rs
+
+
+@pytest.fixture
+def registry():
+    reg = rs.install()
+    yield reg
+    rs.uninstall()
+
+
+# ---------------------------------------------------------- registry level
+def test_seeded_socket_leak_caught_with_stack(registry):
+    s = socket.socket()
+    with pytest.raises(rs.ResourceLeakError) as ei:
+        registry.assert_clean(tag="seeded", grace_s=0.1)
+    msg = str(ei.value)
+    assert "socket" in msg
+    # the report names THIS file as the acquirer — the whole point
+    assert "test_resource_sanitizer" in msg
+    s.close()
+    registry.assert_clean(tag="after-close", grace_s=0.1)
+
+
+def test_seeded_fd_and_mmap_leaks_caught(registry, tmp_path):
+    p = tmp_path / "seg.bin"
+    fd = os.open(p, os.O_CREAT | os.O_RDWR)
+    os.ftruncate(fd, 4096)
+    m = mmap.mmap(fd, 4096)
+    os.close(fd)  # fd discharged; the map is the leak
+    with pytest.raises(rs.ResourceLeakError) as ei:
+        registry.assert_clean(tag="seeded", grace_s=0.1)
+    assert "mmap" in str(ei.value)
+    counts = registry.counts()
+    assert counts.get("fd", 0) == 0, counts
+    m.close()
+    registry.assert_clean(tag="after-close", grace_s=0.1)
+
+
+def test_fd_closed_by_another_wrapper_reads_clean(registry, tmp_path):
+    """``os.fdopen(fd).close()`` never goes through the patched
+    ``os.close`` — the fstat probe must still see the discharge."""
+    p = tmp_path / "f.txt"
+    fd = os.open(p, os.O_CREAT | os.O_WRONLY)
+    f = os.fdopen(fd, "w")
+    f.write("x")
+    f.close()
+    registry.assert_clean(tag="fdopen", grace_s=0.1)
+
+
+def test_gc_discharge_reads_clean(registry):
+    """A dropped socket is closed by its finalizer — net-zero, even
+    though no explicit close ran (the static pass flags the style; the
+    oracle measures net leaks)."""
+    def make():
+        socket.socket()
+    make()
+    registry.assert_clean(tag="gc", grace_s=0.5)
+
+
+def test_nondaemon_thread_tracked_until_joined(registry):
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="leakcheck-t",
+                         daemon=False)
+    t.start()
+    with pytest.raises(rs.ResourceLeakError) as ei:
+        registry.assert_clean(tag="thread", grace_s=0.1)
+    assert "thread" in str(ei.value) and "leakcheck-t" in str(ei.value)
+    release.set()
+    t.join()
+    registry.assert_clean(tag="joined", grace_s=0.5)
+
+
+def test_daemon_threads_are_policy_exempt(registry):
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="bg", daemon=True)
+    t.start()
+    try:
+        registry.assert_clean(tag="daemon", grace_s=0.1)
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_connection_dial_and_accept_tracked(registry, tmp_path):
+    from multiprocessing.connection import Client, Listener
+    addr = str(tmp_path / "s.sock")
+    with Listener(addr, family="AF_UNIX") as lst:
+        got = []
+        t = threading.Thread(target=lambda: got.append(lst.accept()),
+                             name="acc", daemon=True)
+        t.start()
+        c = Client(addr, family="AF_UNIX")
+        t.join(timeout=10)
+    assert got
+    assert registry.counts().get("conn", 0) >= 2
+    with pytest.raises(rs.ResourceLeakError):
+        registry.assert_clean(tag="conns-open", grace_s=0.1)
+    c.close()
+    got[0].close()
+    registry.assert_clean(tag="conns-closed", grace_s=0.5)
+
+
+def test_install_uninstall_restores_acquisition_points():
+    import multiprocessing.connection as mpc
+    orig = (socket.socket, mmap.mmap, os.open, os.dup, os.close,
+            threading.Thread.start, mpc.Connection.__init__)
+    reg = rs.install()
+    assert rs.install() is reg  # idempotent
+    patched = (socket.socket, mmap.mmap, os.open, os.dup, os.close,
+               threading.Thread.start, mpc.Connection.__init__)
+    assert all(p is not o for p, o in zip(patched, orig))
+    rs.uninstall()
+    restored = (socket.socket, mmap.mmap, os.open, os.dup, os.close,
+                threading.Thread.start, mpc.Connection.__init__)
+    assert all(r is o for r, o in zip(restored, orig))
+    assert rs.get_registry() is None
+
+
+# ----------------------------------------------------------- cluster level
+def _churn_workload(waves: int = 2, tasks: int = 20) -> None:
+    import numpy as np
+
+    @ray_tpu.remote
+    def work(i):
+        return int(np.arange(i + 1).sum())
+
+    @ray_tpu.remote
+    class Box:
+        def __init__(self):
+            self.v = 0
+
+        def add(self, n):
+            self.v += n
+            return self.v
+
+    for _ in range(waves):
+        # plain tasks
+        assert len(ray_tpu.get([work.remote(i) for i in range(tasks)],
+                               timeout=120)) == tasks
+        # actor churn: create, call, release (terminate + conn teardown)
+        actors = [Box.remote() for _ in range(3)]
+        assert ray_tpu.get([a.add.remote(2) for a in actors],
+                           timeout=60) == [2, 2, 2]
+        del actors
+        # large objects: spool writes, fd-cache checkouts, shm segments
+        big = np.random.default_rng(0).integers(
+            0, 255, size=4 << 20, dtype=np.uint8)
+        refs = [ray_tpu.put(big) for _ in range(3)]
+        for r in ray_tpu.get(refs, timeout=60):
+            assert r.nbytes == big.nbytes
+        del refs
+        time.sleep(0.1)
+
+
+def test_leak_hammer_clean_shutdown(monkeypatch):
+    """N pulls/tasks/actor churns under the sanitizer → zero net
+    resources: ``ray_tpu.shutdown()`` runs the assert wired into
+    ``GcsServer.shutdown`` and must NOT raise."""
+    monkeypatch.setenv("RAY_TPU_RESOURCE_SANITIZER", "1")
+    ray_tpu.init(num_cpus=2)
+    try:
+        assert rs.get_registry() is not None, "maybe_install did not fire"
+        _churn_workload()
+    finally:
+        try:
+            ray_tpu.shutdown()  # asserts clean inside
+        finally:
+            rs.uninstall()
+
+
+def test_leak_hammer_seeded_leak_fails_shutdown(monkeypatch):
+    """The same clean-shutdown path reports a leak seeded AFTER install
+    — with the acquisition stack pointing at this test."""
+    monkeypatch.setenv("RAY_TPU_RESOURCE_SANITIZER", "1")
+    ray_tpu.init(num_cpus=1)
+    leak = None
+    try:
+        leak = socket.socket()
+        with pytest.raises(rs.ResourceLeakError) as ei:
+            ray_tpu.shutdown()
+        msg = str(ei.value)
+        assert "socket" in msg and "test_resource_sanitizer" in msg
+    finally:
+        if leak is not None:
+            leak.close()
+        # the failed assert was the LAST step of head shutdown: the
+        # cluster itself is down; only the module global needs clearing
+        ray_tpu._head = None
+        rs.uninstall()
+    assert not ray_tpu.is_initialized()

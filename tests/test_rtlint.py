@@ -20,12 +20,16 @@ if str(ROOT) not in sys.path:
 FIX = ROOT / "tests" / "rtlint_fixtures"
 
 from tools.rtlint import load  # noqa: E402
-from tools.rtlint.__main__ import PASSES, filter_waived, run_pass  # noqa: E402
+from tools.rtlint.__main__ import PASSES, RULES, filter_waived, \
+    run_pass  # noqa: E402
 from tools.rtlint.lockorder import check_locks, gcs_spec  # noqa: E402
 from tools.rtlint.guarded import check_guarded  # noqa: E402
 from tools.rtlint.wirecheck import WireConfig, check_wire  # noqa: E402
 from tools.rtlint.threads import check_threads_file  # noqa: E402
 from tools.rtlint.metricscheck import check_metrics  # noqa: E402
+from tools.rtlint.resources import check_resources  # noqa: E402
+from tools.rtlint.replies import ServeSpec, _check_side_channel, \
+    check_replies, default_specs  # noqa: E402
 
 
 def _rules(findings):
@@ -150,10 +154,157 @@ def test_metrics_silent_on_negative_fixture():
     assert found == [], found
 
 
+# ----------------------------------------------------- resource lifecycle
+def test_resources_flag_positive_fixture():
+    found = check_resources([load(FIX / "resources_bad.py")])
+    assert _rules(found) == {"resource-leak", "resource-exc-leak"}, found
+    msgs = " ".join(f.message for f in found)
+    # every tracked kind shows up: sockets, raw fds, mmaps, threads
+    for kind in ("socket", "fd", "mmap", "thread"):
+        assert kind in msgs, (kind, found)
+    # the distinct shapes: fall-through leak, early-return leak,
+    # raise-between-open-and-store, dropped-on-the-floor, ctor strand
+    assert "immediately dropped" in msgs
+    assert "constructor stores" in msgs
+    assert "return path" in msgs
+    assert len(found) >= 10, found
+
+
+def test_resources_silent_on_negative_fixture_with_waiver():
+    """Every discharge form — with, try/finally, close-on-error,
+    owner-field store, container append, thread-args transfer,
+    annotated AND fixed-point-computed owning helpers, returns()
+    factories — plus exactly one documented waiver."""
+    found = check_resources([load(FIX / "resources_ok.py")])
+    active, waived = filter_waived(found)
+    assert active == [], active
+    assert [f.rule for f in waived] == ["resource-leak"]
+
+
+def test_resources_interprocedural_owns_is_load_bearing():
+    """Deleting the settle() helper's close turns the computed summary
+    non-owning and the caller's acquisition into a finding — the fixed
+    point is doing real work, not the annotation."""
+    import re
+    src = (FIX / "resources_ok.py").read_text()
+    broken = src.replace("    conn.close()\n", "    log_only(conn)\n")
+    broken += "\n\ndef log_only(c):\n    print(\"conn\", c.fileno())\n"
+    import tempfile
+    import os as _os
+    fd, path = tempfile.mkstemp(suffix=".py")
+    try:
+        with _os.fdopen(fd, "w") as f:
+            f.write(broken)
+        found = check_resources([load(path)])
+        assert any(f.rule == "resource-leak" and "via_computed_helper" not
+                   in f.message for f in found), found
+        # the adopt() annotated helper also lost its close, but the
+        # authoritative owns() annotation still holds for its caller
+        assert not any("via_owning_helper" in f.message for f in found)
+        src_lines = broken.splitlines()
+        flagged_funcs = set()
+        for f in found:
+            for i in range(f.line - 1, -1, -1):
+                m = re.match(r"def (\w+)", src_lines[i])
+                if m:
+                    flagged_funcs.add(m.group(1))
+                    break
+        assert "via_computed_helper" in flagged_funcs, flagged_funcs
+    finally:
+        _os.unlink(path)
+
+
+# ------------------------------------------------------- reply discipline
+def _reply_specs(tag: str):
+    rel = f"tests/rtlint_fixtures/replies_{tag}.py"
+    pump = "Srv._pump" if tag == "bad" else "Srv._pump_reraise"
+    return [
+        ServeSpec(rel, "Srv._serve", frozenset({"conn"}),
+                  frozenset({"op"}), frozenset({"push"})),
+        ServeSpec(rel, pump, frozenset({"conn"}), frozenset(),
+                  frozenset(), swallow_check=True),
+    ]
+
+
+def test_replies_flag_positive_fixture():
+    found = check_replies(_reply_specs("bad"), ROOT)
+    found += _check_side_channel(load(FIX / "replies_bad.py"))
+    assert _rules(found) == {"reply-missing", "reply-double",
+                             "reply-escape", "reply-oneway",
+                             "reply-swallow", "reply-side-channel"}, found
+    # escape fires on BOTH shapes: unprotected may-raise call and raise
+    escapes = [f for f in found if f.rule == "reply-escape"]
+    assert len(escapes) == 2, escapes
+
+
+def test_replies_silent_on_negative_fixture_with_waiver():
+    """Every settle form — direct reply, both-branch replies, error
+    reply in except, conn teardown (incl. the try-close-pass idiom),
+    annotated reply helper, oneway silence, re-raising pump — plus one
+    documented deferred-reply waiver."""
+    found = check_replies(_reply_specs("ok"), ROOT)
+    found += _check_side_channel(load(FIX / "replies_ok.py"))
+    active, waived = filter_waived(found)
+    assert active == [], active
+    assert [f.rule for f in waived] == ["reply-missing"]
+
+
+def test_replies_real_specs_resolve():
+    """Every configured serve loop exists in the tree (a renamed
+    dispatch method must fail loudly, not silently un-check itself),
+    and the real-tree run stays within the documented waivers."""
+    found = check_replies(default_specs(), ROOT)
+    assert not any("not found" in f.message for f in found), found
+    active, _ = filter_waived(found)
+    assert active == [], active
+
+
+def test_seeded_reply_hole_is_caught():
+    """Acceptance scratch-edit: removing the error reply from a
+    dispatch arm's except handler is caught."""
+    import textwrap
+    import tempfile
+    import os as _os
+    src = textwrap.dedent("""\
+        class S:
+            def _serve(self, conn):
+                while True:
+                    msg = conn.recv()
+                    op = msg.get("op")
+                    if op == "get":
+                        try:
+                            conn.send({"data": lookup(msg)})
+                        except Exception:
+                            pass  # swallowed: caller hangs
+        """)
+    fd, path = tempfile.mkstemp(suffix=".py", dir=FIX)
+    try:
+        with _os.fdopen(fd, "w") as f:
+            f.write(src)
+        rel = str(Path(path).relative_to(ROOT))
+        found = check_replies(
+            [ServeSpec(rel, "S._serve", frozenset({"conn"}),
+                       frozenset({"op"}), frozenset())], ROOT)
+        assert any(f.rule == "reply-missing" for f in found), found
+    finally:
+        _os.unlink(path)
+
+
+def test_list_rules_catalog_matches_passes():
+    """--list-rules stays in sync with the pass list, and every rule id
+    a pass can emit is in the catalog (fixture corpus as the witness)."""
+    assert set(RULES) == set(PASSES)
+    catalog = {rule for rules in RULES.values() for rule, _ in rules}
+    emitted = _rules(check_resources([load(FIX / "resources_bad.py")]))
+    emitted |= _rules(check_replies(_reply_specs("bad"), ROOT))
+    emitted |= _rules(_check_side_channel(load(FIX / "replies_bad.py")))
+    assert emitted <= catalog, emitted - catalog
+
+
 # ------------------------------------------------- whole-tree invariants
 def test_whole_tree_is_rtlint_clean():
-    """The acceptance bar: zero unwaived findings across all five passes
-    over the real tree (python -m tools.rtlint exits 0)."""
+    """The acceptance bar: zero unwaived findings across all seven
+    passes over the real tree (python -m tools.rtlint exits 0)."""
     for name in PASSES:
         active = _active(run_pass(name))
         assert active == [], (

@@ -1,8 +1,9 @@
-"""Driver: ``python -m tools.rtlint [--pass NAME ...] [--show-waived]``.
+"""Driver: ``python -m tools.rtlint [--pass NAME ...] [--show-waived]
+[--list-rules]``.
 
-Runs the five passes over the real tree (see each pass module for what
-it enforces), prints ``file:line rule-id message`` per finding, and
-exits non-zero when any unwaived finding remains.
+Runs the seven passes over the real tree (see each pass module for
+what it enforces), prints ``file:line rule-id message`` per finding,
+and exits non-zero when any unwaived finding remains.
 """
 
 from __future__ import annotations
@@ -14,7 +15,57 @@ from typing import Dict, List
 
 from tools.rtlint import REPO_ROOT, Finding, SourceFile, load
 
-PASSES = ("locks", "guarded", "wire", "threads", "metrics")
+PASSES = ("locks", "guarded", "wire", "threads", "metrics",
+          "resources", "replies")
+
+# pass -> (rule id, one-line contract) — the --list-rules catalog
+RULES: Dict[str, List] = {
+    "locks": [
+        ("lock-order", "lock acquisition edges must follow the §4c DAG"),
+        ("lock-blocking", "no blocking primitives under leaf locks"),
+    ],
+    "guarded": [
+        ("unguarded", "'# guarded by:' fields written only under "
+                      "their lock"),
+    ],
+    "wire": [
+        ("wire-no-server", "every wire kind has a server dispatch arm"),
+        ("wire-no-producer", "every wire kind has a client producer"),
+        ("wire-ref-awaited", "ref oneways are never awaited"),
+        ("wire-ref-reply", "reply(dedup) kinds never ride the "
+                           "coalesced ref path"),
+        ("wire-ref-arm", "_apply_ref_op_locked arms == REF_KINDS"),
+    ],
+    "threads": [
+        ("thread-unnamed", "every thread sets name= explicitly"),
+        ("thread-daemon", "every thread sets daemon= explicitly"),
+    ],
+    "metrics": [
+        ("metric-undeclared", "no rtpu_* use outside the catalog"),
+        ("metric-dead", "no declared-but-never-referenced series"),
+    ],
+    "resources": [
+        ("resource-leak", "acquired sockets/fds/files/mmaps/threads/"
+                          "conns are closed or ownership-transferred "
+                          "on every normal exit path"),
+        ("resource-exc-leak", "no acquisition can be stranded by an "
+                              "exception edge (raise between open and "
+                              "store)"),
+    ],
+    "replies": [
+        ("reply-missing", "two-way dispatch arms reply on every path "
+                          "that keeps the connection open"),
+        ("reply-double", "no arm replies twice on one path"),
+        ("reply-escape", "no exception escapes a two-way arm before "
+                         "the reply (error replies count)"),
+        ("reply-oneway", "oneway kinds never reply"),
+        ("reply-side-channel", "GCS _h_* handlers reply by returning, "
+                               "never directly on a connection"),
+        ("reply-swallow", "serve pumps never swallow a dispatch "
+                          "failure and keep looping (reply, re-raise, "
+                          "or tear the conn down)"),
+    ],
+}
 
 
 def run_pass(name: str) -> List[Finding]:
@@ -50,6 +101,12 @@ def run_pass(name: str) -> List[Finding]:
     if name == "metrics":
         from tools.rtlint.metricscheck import default_check
         return default_check()
+    if name == "resources":
+        from tools.rtlint.resources import default_check
+        return default_check(REPO_ROOT)
+    if name == "replies":
+        from tools.rtlint.replies import default_check
+        return default_check(REPO_ROOT)
     raise SystemExit(f"unknown pass {name!r}")
 
 
@@ -80,7 +137,14 @@ def main(argv=None) -> int:
                     choices=PASSES, help="run only the named pass(es)")
     ap.add_argument("--show-waived", action="store_true",
                     help="also print findings silenced by waivers")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
     args = ap.parse_args(argv)
+    if args.list_rules:
+        for pname in args.passes or PASSES:
+            for rule, contract in RULES[pname]:
+                print(f"{pname:<10} {rule:<20} {contract}")
+        return 0
     if str(REPO_ROOT) not in sys.path:
         sys.path.insert(0, str(REPO_ROOT))
     selected = args.passes or list(PASSES)

@@ -1,0 +1,549 @@
+"""Pass 7: wire reply discipline (DESIGN.md §4f).
+
+The wire pass (wirecheck.py) proves every kind has a handler; this
+pass proves each handler **settles** its request: for every dispatch
+arm of a reply-expecting kind, exactly one reply reaches the caller on
+every path — including exception paths (an error reply counts; an
+exception escaping the arm with no reply is the "client hangs forever
+on a handler that threw" hole) — and oneway kinds never reply.
+
+Model: a configured *serve loop* (``DataPlaneServer._serve``,
+``GcsServer._serve_conn``, the worker ctl pump) dispatches on a kind
+variable with literal comparisons; each comparison arm is analyzed by
+a path walk counting **reply sites**:
+
+- ``conn.send(...)`` on the loop's connection parameter,
+- ``wire.conn_send(conn, ...)`` / ``protocol.send_msg_writev(conn, ...)``,
+- a call to a helper whose def line carries ``# rtlint: replies`` —
+  the annotation asserts the helper settles the request on every path
+  (reply or connection teardown); the fixture corpus and the runtime
+  oracle keep the annotation honest.
+
+Path outcomes: falling to the next request cycle (``continue`` / end
+of arm) with zero replies on a two-way kind is ``reply-missing``; a
+second reply on a path that definitely already replied is
+``reply-double``; a ``raise`` (or an unprotected may-raise call)
+before any reply is ``reply-escape`` — catching it and replying the
+error is the contract; ``return`` / ``break`` tear the connection down
+(the peer sees EOF, not a hang) and settle the request by
+construction, except in *function arms* (``ActorServer._handle_call``)
+where the connection outlives the handler and a bare return is a
+missing reply.
+
+Two structural rules ride along: ``reply-side-channel`` — GCS
+``_h_*`` handlers reply by RETURNING; one sending directly on a
+connection would double-reply through the dispatch loop — and
+``reply-swallow`` — a serve-pump ``except`` that logs and keeps
+looping strands the in-flight caller forever: it must reply, re-raise,
+or tear the connection down (EOF routes the caller to the
+disconnect/resubmit path).
+
+Rules: ``reply-missing``, ``reply-double``, ``reply-escape``,
+``reply-oneway``, ``reply-side-channel``, ``reply-swallow``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from tools.rtlint import Finding, SourceFile, dotted_name, load
+from tools.rtlint.resources import _FuncAnalysis as _ResAnalysis
+
+_REPLIES_RE = re.compile(r"#\s*rtlint:\s*replies\b")
+
+REPLY_HELPER_CALLS = frozenset({"conn_send", "send_msg_writev"})
+
+
+class ServeSpec(NamedTuple):
+    path: str                 # repo-relative file
+    qualname: str             # "Class.method" or "function"
+    conn_names: frozenset     # names the connection rides under
+    kind_vars: frozenset      # dispatch variable names ("op", "kind")
+    oneway_kinds: frozenset   # arms that must NOT reply
+    function_arm: Optional[str] = None  # whole body is one arm (kind)
+    # pump: also check except-handlers for silent swallows
+    swallow_check: bool = False
+    # function arm whose ESCAPING exceptions are provably settled by an
+    # enclosing pump (that pump carries its own swallow_check spec, so
+    # "the pump tears the conn down on dispatch failure" is itself
+    # machine-enforced, not assumed) — escapes stop being findings;
+    # replyless returns/fall-throughs still are
+    escapes_caught: bool = False
+
+
+def default_specs() -> List[ServeSpec]:
+    return [
+        ServeSpec("ray_tpu/_private/data_plane.py",
+                  "DataPlaneServer._serve",
+                  frozenset({"conn"}), frozenset({"op"}),
+                  frozenset()),
+        ServeSpec("ray_tpu/_private/gcs.py", "GcsServer._serve_conn",
+                  frozenset({"conn"}), frozenset({"kind"}),
+                  frozenset()),
+        ServeSpec("ray_tpu/_private/actor_server.py",
+                  "ActorServer._handle_call",
+                  frozenset({"conn"}), frozenset(),
+                  frozenset(), function_arm="call",
+                  escapes_caught=True),  # pumps below tear down on escape
+        ServeSpec("ray_tpu/_private/actor_server.py",
+                  "ActorServer._complete_async_call",
+                  frozenset({"conn"}), frozenset(),
+                  frozenset(), function_arm="async-complete"),
+        ServeSpec("ray_tpu/_private/actor_server.py",
+                  "ActorServer._conn_reader",
+                  frozenset({"conn"}), frozenset(),
+                  frozenset(), swallow_check=True),
+        ServeSpec("ray_tpu/_private/actor_server.py",
+                  "ActorServer._exec_loop",
+                  frozenset({"conn"}), frozenset(),
+                  frozenset(), swallow_check=True),
+        # the worker ctl pump consumes oneway pushes: replying on the
+        # ctl conn would desynchronize the GCS's push channel
+        ServeSpec("ray_tpu/_private/worker.py", "Worker._handle_oob",
+                  frozenset({"conn"}), frozenset({"kind"}),
+                  frozenset({"cancel", "drop_queued", "dump_stack",
+                             "stop_worker"})),
+    ]
+
+
+def _find_func(sf: SourceFile, qualname: str):
+    parts = qualname.split(".")
+    scope = sf.tree
+    for i, part in enumerate(parts):
+        found = None
+        for node in ast.walk(scope):
+            if isinstance(node, ast.ClassDef) and node.name == part \
+                    and i < len(parts) - 1:
+                found = node
+                break
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == part and i == len(parts) - 1:
+                found = node
+                break
+        if found is None:
+            return None
+        scope = found
+    return scope
+
+
+def collect_reply_helpers(sf: SourceFile) -> Set[str]:
+    """Function names annotated ``# rtlint: replies`` — on the line
+    above the ``def``, or anywhere in the (possibly multi-line)
+    signature."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sig_end = node.body[0].lineno - 1 if node.body else node.lineno
+        for ln in range(node.lineno - 1, sig_end + 1):
+            if 1 <= ln <= len(sf.lines) and \
+                    _REPLIES_RE.search(sf.lines[ln - 1]):
+                out.add(node.name)
+                break
+    return out
+
+
+class _ArmWalker:
+    """Reply-count path walk of one dispatch arm."""
+
+    def __init__(self, sf: SourceFile, spec: ServeSpec, kind: str,
+                 helpers: Set[str], twoway: bool,
+                 return_settles: bool):
+        self.sf = sf
+        self.spec = spec
+        self.kind = kind
+        self.helpers = helpers
+        self.twoway = twoway
+        self.return_settles = return_settles
+        self.findings: List[Finding] = []
+        self._escape_lines: Set[int] = set()
+
+    # ------------------------------------------------------------- helpers
+    def _finding(self, line: int, rule: str, msg: str) -> None:
+        self.findings.append(Finding(self.sf.rel, line, rule, msg))
+
+    def _reply_calls(self, stmt) -> List[ast.Call]:
+        out = []
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call) and self._is_reply(node):
+                out.append(node)
+        return out
+
+    def _is_reply(self, call: ast.Call) -> bool:
+        f = call.func
+        name = dotted_name(f)
+        attr = name.rsplit(".", 1)[-1] if name else ""
+        if isinstance(f, ast.Attribute) and f.attr == "send" and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in self.spec.conn_names:
+            return True
+        if attr in REPLY_HELPER_CALLS and call.args and \
+                isinstance(call.args[0], ast.Name) and \
+                call.args[0].id in self.spec.conn_names:
+            return True
+        if attr in self.helpers:
+            return True
+        return False
+
+    def _is_teardown(self, call: ast.Call) -> bool:
+        """``conn.close()`` on the loop's connection: the peer sees EOF
+        instead of a hang — settles the request without being a reply
+        (legal after a reply too, so it never counts toward double)."""
+        f = call.func
+        return isinstance(f, ast.Attribute) and f.attr == "close" and \
+            isinstance(f.value, ast.Name) and \
+            f.value.id in self.spec.conn_names
+
+    def _may_raise_calls(self, stmt) -> List[ast.Call]:
+        """Non-reply calls in the statement that can raise (reusing the
+        resource pass's safe-call model)."""
+        ra = _ResAnalysis.__new__(_ResAnalysis)  # only _may_raise needed
+        out = []
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call) and not self._is_reply(node) \
+                    and not self._is_teardown(node) \
+                    and _ResAnalysis._may_raise(ra, node):
+                out.append(node)
+        return out
+
+    # ------------------------------------------------------------ the walk
+    def check(self, body: List[ast.stmt], in_try: bool) -> None:
+        exits = self.walk(body, {0}, in_try, loop_depth=0)
+        self._fall(exits.get("fall", set()),
+                   body[-1].lineno if body else 0)
+
+    def _fall(self, counts: Set[int], line: int) -> None:
+        if not counts:
+            return
+        if self.twoway and 0 in counts:
+            self._finding(
+                line, "reply-missing",
+                f"a path through the {self.kind!r} arm reaches the next "
+                f"request cycle without sending a reply — the caller "
+                f"blocks forever")
+
+    def walk(self, stmts, counts: Set[int], in_try: bool,
+             loop_depth: int) -> Dict[str, Set[int]]:
+        """Returns {'fall': counts} for paths that flow past the block;
+        'tear' paths (return/break at serve-loop depth) are settled."""
+        exits: Dict[str, Set[int]] = {}
+        cur = set(counts)
+        for st in stmts:
+            if not cur:
+                break  # unreachable
+            cur = self._stmt(st, cur, in_try, loop_depth, exits)
+        if cur:
+            exits["fall"] = exits.get("fall", set()) | cur
+        return exits
+
+    def _bump(self, call: ast.Call, counts: Set[int]) -> Set[int]:
+        if not self.twoway:
+            self._finding(
+                call.lineno, "reply-oneway",
+                f"oneway kind {self.kind!r} must never reply (a reply "
+                f"frame would desynchronize the request stream)")
+            return counts
+        if counts and min(counts) >= 1:
+            self._finding(
+                call.lineno, "reply-double",
+                f"second reply on a path through the {self.kind!r} arm "
+                f"that already replied")
+        return {min(c + 1, 2) for c in counts}
+
+    def _scan_stmt_calls(self, st, counts: Set[int], in_try: bool
+                         ) -> Set[int]:
+        # escaping before the reply only strands a caller who is
+        # WAITING for one: oneway arms have no reply obligation
+        for call in self._may_raise_calls(st):
+            if self.twoway and not in_try and 0 in counts and \
+                    call.lineno not in self._escape_lines:
+                self._escape_lines.add(call.lineno)
+                self._finding(
+                    call.lineno, "reply-escape",
+                    f"{dotted_name(call.func) or 'a call'}() can raise "
+                    f"before the {self.kind!r} arm has replied, and no "
+                    f"enclosing try turns it into an error reply — the "
+                    f"caller hangs (or the pooled conn dies) on failure")
+        for call in self._reply_calls(st):
+            counts = self._bump(call, counts)
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call) and self._is_teardown(node):
+                counts = {max(c, 1) for c in counts}
+        return counts
+
+    def _stmt(self, st, counts: Set[int], in_try: bool, loop_depth: int,
+              exits: Dict[str, Set[int]]) -> Set[int]:
+        if isinstance(st, ast.Return):
+            counts = self._scan_stmt_calls(st, counts, in_try)
+            if self.twoway and not self.return_settles and 0 in counts:
+                self._finding(
+                    st.lineno, "reply-missing",
+                    f"return from the {self.kind!r} arm without a reply "
+                    f"(and the connection stays open — the caller blocks "
+                    f"forever)")
+            exits["tear"] = exits.get("tear", set()) | counts
+            return set()
+        if isinstance(st, ast.Raise):
+            if self.twoway and 0 in counts and not in_try:
+                self._finding(
+                    st.lineno, "reply-escape",
+                    f"raise before the {self.kind!r} arm has replied "
+                    f"(reply an error instead, or tear the connection "
+                    f"down explicitly)")
+            return set()
+        if isinstance(st, ast.Break):
+            if loop_depth == 0:
+                exits["tear"] = exits.get("tear", set()) | counts
+                return set()
+            exits.setdefault("_loop", set()).update(counts)
+            return set()
+        if isinstance(st, ast.Continue):
+            if loop_depth == 0:
+                self._fall(counts, st.lineno)
+                return set()
+            exits.setdefault("_loop", set()).update(counts)
+            return set()
+        if isinstance(st, ast.If):
+            counts = self._scan_stmt_calls(st.test, counts, in_try)
+            branch_exits: List[Set[int]] = []
+            for body in (st.body, st.orelse):
+                if not body:
+                    branch_exits.append(set(counts))
+                    continue
+                sub = self.walk(body, counts, in_try, loop_depth)
+                for k, v in sub.items():
+                    if k != "fall":
+                        exits[k] = exits.get(k, set()) | v
+                branch_exits.append(sub.get("fall", set()))
+            return branch_exits[0] | branch_exits[1]
+        if isinstance(st, ast.Try):
+            settled_counts = set(counts)
+            # the ``try: conn.close() / except OSError: pass`` idiom: a
+            # teardown ATTEMPT settles even when close() raises (the fd
+            # is dead either way, the peer sees EOF) — credit it to the
+            # handler path when it leads the try body
+            if st.body and any(self._is_teardown(c)
+                               for c in ast.walk(st.body[0])
+                               if isinstance(c, ast.Call)):
+                settled_counts = {max(c, 1) for c in settled_counts}
+            sub = self.walk(st.body, counts, True, loop_depth)
+            for k, v in sub.items():
+                if k != "fall":
+                    exits[k] = exits.get(k, set()) | v
+            body_fall = sub.get("fall", set())
+            handler_fall: Set[int] = set()
+            for h in st.handlers:
+                hs = self.walk(h.body, settled_counts, in_try, loop_depth)
+                for k, v in hs.items():
+                    if k != "fall":
+                        exits[k] = exits.get(k, set()) | v
+                handler_fall |= hs.get("fall", set())
+            out = body_fall | handler_fall
+            if st.orelse and body_fall:
+                es = self.walk(st.orelse, body_fall, in_try, loop_depth)
+                for k, v in es.items():
+                    if k != "fall":
+                        exits[k] = exits.get(k, set()) | v
+                out = es.get("fall", set()) | handler_fall
+            if st.finalbody and out:
+                fs = self.walk(st.finalbody, out, in_try, loop_depth)
+                for k, v in fs.items():
+                    if k != "fall":
+                        exits[k] = exits.get(k, set()) | v
+                out = fs.get("fall", set())
+            return out
+        if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            it = getattr(st, "iter", None) or getattr(st, "test", None)
+            if it is not None:
+                counts = self._scan_stmt_calls(it, counts, in_try)
+            sub = self.walk(st.body, counts, in_try, loop_depth + 1)
+            for k, v in sub.items():
+                if k not in ("fall", "_loop"):
+                    exits[k] = exits.get(k, set()) | v
+            after = counts | sub.get("fall", set()) | sub.get("_loop",
+                                                              set())
+            if st.orelse:
+                es = self.walk(st.orelse, after, in_try, loop_depth)
+                after = es.get("fall", set())
+                for k, v in es.items():
+                    if k != "fall":
+                        exits[k] = exits.get(k, set()) | v
+            return after
+        if isinstance(st, ast.With):
+            for item in st.items:
+                counts = self._scan_stmt_calls(item.context_expr, counts,
+                                               in_try)
+            sub = self.walk(st.body, counts, in_try, loop_depth)
+            for k, v in sub.items():
+                if k != "fall":
+                    exits[k] = exits.get(k, set()) | v
+            return sub.get("fall", set())
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return counts
+        return self._scan_stmt_calls(st, counts, in_try)
+
+
+def _arms_in(func_node, kind_vars: frozenset
+             ) -> List[Tuple[str, List[ast.stmt], bool]]:
+    """(kind, arm body, enclosed_in_try) for every literal dispatch
+    arm in the function."""
+    arms: List[Tuple[str, List[ast.stmt], bool]] = []
+
+    def is_kind_expr(e) -> bool:
+        if isinstance(e, ast.Name) and e.id in kind_vars:
+            return True
+        if isinstance(e, ast.Subscript) and \
+                isinstance(e.slice, ast.Constant) and \
+                e.slice.value in kind_vars:
+            return True
+        # msg.get("kind")
+        if isinstance(e, ast.Call) and \
+                isinstance(e.func, ast.Attribute) and \
+                e.func.attr == "get" and e.args and \
+                isinstance(e.args[0], ast.Constant) and \
+                e.args[0].value in kind_vars:
+            return True
+        return False
+
+    def scan(stmts, in_try: bool) -> None:
+        for st in stmts:
+            if isinstance(st, ast.If):
+                t = st.test
+                if isinstance(t, ast.Compare) and is_kind_expr(t.left) \
+                        and len(t.ops) == 1 and \
+                        isinstance(t.ops[0], ast.Eq) and \
+                        isinstance(t.comparators[0], ast.Constant) and \
+                        isinstance(t.comparators[0].value, str):
+                    arms.append((t.comparators[0].value, st.body, in_try))
+                else:
+                    scan(st.body, in_try)
+                scan(st.orelse, in_try)
+            elif isinstance(st, ast.Try):
+                scan(st.body, True)
+                for h in st.handlers:
+                    scan(h.body, in_try)
+                scan(st.orelse, in_try)
+                scan(st.finalbody, in_try)
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While,
+                                 ast.With)):
+                scan(st.body, in_try)
+                scan(getattr(st, "orelse", []) or [], in_try)
+            elif isinstance(st, ast.Match):
+                for c in st.cases:
+                    scan(c.body, in_try)
+    scan(func_node.body, False)
+    return arms
+
+
+def _check_swallow(sf: SourceFile, spec: ServeSpec,
+                   func_node) -> List[Finding]:
+    """A pump's ``except`` that logs and loops strands the caller."""
+    findings: List[Finding] = []
+    for node in ast.walk(func_node):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        # only broad catches around the dispatch can swallow a call
+        t = node.type
+        names = set()
+        for sub in ast.walk(t) if t is not None else ():
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+        if t is not None and not names & {"Exception", "BaseException"}:
+            continue
+        settled = False
+        for sub in ast.walk(ast.Module(body=list(node.body),
+                                       type_ignores=[])):
+            if isinstance(sub, (ast.Raise, ast.Return, ast.Break)):
+                settled = True
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func)
+                attr = name.rsplit(".", 1)[-1] if name else ""
+                if attr in ("send", "close", "shutdown_conn", "shutdown",
+                            "_shutdown"):
+                    settled = True
+        if not settled and not sf.waived(node.lineno, "reply-swallow"):
+            findings.append(Finding(
+                sf.rel, node.lineno, "reply-swallow",
+                f"{spec.qualname}: this except swallows a dispatch "
+                f"failure and keeps serving — the in-flight caller never "
+                f"gets a reply OR an EOF; reply an error, re-raise, or "
+                f"tear the connection down"))
+    return findings
+
+
+def _check_side_channel(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not node.name.startswith("_h_"):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            name = dotted_name(f)
+            attr = name.rsplit(".", 1)[-1] if name else ""
+            direct = isinstance(f, ast.Attribute) and f.attr == "send" \
+                and isinstance(f.value, ast.Name) and f.value.id == "conn"
+            if direct or attr in REPLY_HELPER_CALLS:
+                findings.append(Finding(
+                    sf.rel, sub.lineno, "reply-side-channel",
+                    f"{node.name} replies by returning; sending on a "
+                    f"connection here would double-reply through the "
+                    f"dispatch loop"))
+    return findings
+
+
+def check_replies(specs: List[ServeSpec], root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    by_file: Dict[str, List[ServeSpec]] = {}
+    for s in specs:
+        by_file.setdefault(s.path, []).append(s)
+    for rel, file_specs in sorted(by_file.items()):
+        p = root / rel
+        if not p.exists():
+            continue
+        sf = load(p)
+        helpers = collect_reply_helpers(sf)
+        for spec in file_specs:
+            node = _find_func(sf, spec.qualname)
+            if node is None:
+                findings.append(Finding(
+                    rel, 1, "reply-missing",
+                    f"configured serve loop {spec.qualname} not found"))
+                continue
+            if spec.swallow_check:
+                findings.extend(_check_swallow(sf, spec, node))
+                continue
+            if spec.function_arm is not None:
+                w = _ArmWalker(sf, spec, spec.function_arm, helpers,
+                               twoway=True, return_settles=False)
+                w.check(node.body, in_try=spec.escapes_caught)
+                findings.extend(w.findings)
+                continue
+            for kind, body, in_try in _arms_in(node, spec.kind_vars):
+                oneway = kind in spec.oneway_kinds
+                w = _ArmWalker(sf, spec, kind, helpers,
+                               twoway=not oneway, return_settles=True)
+                w.check(body, in_try)
+                findings.extend(w.findings)
+    # _h_* side-channel rule over the GCS dispatch surface
+    for rel in ("ray_tpu/_private/gcs.py",):
+        p = root / rel
+        if p.exists():
+            findings.extend(_check_side_channel(load(p)))
+    return findings
+
+
+def default_check(root: Path) -> List[Finding]:
+    return check_replies(default_specs(), root)

@@ -1,0 +1,861 @@
+"""Pass 6: resource-lifecycle discipline (DESIGN.md §4f).
+
+Every acquisition of a leakable resource — sockets, raw fds
+(``os.open``/``os.dup``), files, ``mmap.mmap`` maps, threads,
+``multiprocessing`` Connections/Listeners, protocol dials — must be
+**discharged** on every exit path of the acquiring function:
+
+- **closed**: ``x.close()`` / ``x.detach()`` / ``x.stop()`` /
+  ``os.close(x)`` / ``x.conn.close()`` (closing a wrapped resource
+  settles the wrapper), directly or via ``with`` / ``try/finally``;
+- **ownership-transferred**: returned, stored into an owner field
+  (``self.attr = x``, ``self._conns[k] = x``), appended/put into a
+  container, handed to a thread (``Thread(args=(x, ...))``), or passed
+  to a callee that *owns* the argument — either provably (the callee
+  discharges that parameter on all its own paths; computed to a fixed
+  point over the analyzed files) or by annotation::
+
+      def adopt_conn(self, conn):  # rtlint: owns(conn)
+
+- **waived**: ``# rtlint: resource-leak-ok(<reason>)`` /
+  ``# rtlint: resource-exc-leak-ok(<reason>)`` on the finding line.
+
+Exception edges are modeled: a statement that may raise while an
+undischarged resource is live — with no enclosing ``try`` whose
+``finally`` or handler settles it — is a finding even when the
+straight-line path is clean ("raises between open and store"), and so
+is a ``raise`` with a live unprotected resource.  Threads constructed
+with ``daemon=True`` are self-discharging (shutdown may strand them by
+declared policy — the thread pass already forces the ``daemon=``
+decision to be explicit); non-daemon threads must be stored, joined,
+or transferred.
+
+Deliberate unsoundness (precision over recall, documented so nobody
+trusts the pass for what it does not do): acquisitions inside
+comprehensions/lambdas are treated as transferred to the result;
+rebinding a live resource name silently replaces it; a may-raise call
+*inside* any ``try`` is assumed handled by that try; ``subprocess``
+handles and containers of resources are not tracked.  The runtime
+oracle (``RAY_TPU_RESOURCE_SANITIZER=1``,
+``ray_tpu/_private/resource_sanitizer.py``) covers the other side:
+what the static pass cannot see, the leak-hammer measures.
+
+Rules: ``resource-leak``, ``resource-exc-leak``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Set
+
+from tools.rtlint import Finding, SourceFile, dotted_name, load
+
+# full dotted call name -> resource kind
+ACQUIRE_NAMES: Dict[str, str] = {
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "open": "file",
+    "os.open": "fd",
+    "os.dup": "fd",
+    "os.fdopen": "file",
+    "mmap.mmap": "mmap",
+    "threading.Thread": "thread",
+    "Thread": "thread",
+    "Connection": "conn",
+    "Client": "conn",
+    "Listener": "listener",
+}
+
+# resolved by last component on any receiver (protocol.connect_data,
+# self._dial, listener.accept, ...).  ``connect`` is special-cased in
+# ``_acquire_kind``: only the module-level dial (``protocol.connect`` /
+# bare ``connect``) acquires — ``sock.connect(addr)`` returns None.
+ACQUIRE_ATTRS: Dict[str, str] = {
+    "connect": "conn",
+    "_dial": "conn",
+    "connect_tcp": "conn",
+    "connect_data": "conn",
+    "connect_addr": "conn",
+    "tunnel_connect": "conn",
+    "accept": "conn",
+    "make_listener": "listener",
+    "make_tcp_listener": "listener",
+    "make_tcp_actor_listener": "listener",
+}
+
+# methods that settle the resource they are called on (x.close(), or
+# x.conn.close() — closing the payload settles the wrapper)
+CLOSE_METHODS = frozenset({"close", "detach", "stop", "shutdown",
+                           "close_all", "join", "terminate", "kill"})
+
+# mutator methods that hand a resource argument to a container:
+# lst.append(x) / q.put(x) transfer ownership to the container
+# (containers themselves are not tracked)
+TRANSFER_METHODS = frozenset({"append", "appendleft", "add", "put",
+                              "insert", "extend", "register"})
+
+# cross-module helpers that settle a resource argument even though
+# their def lives outside the analyzed set
+BUILTIN_OWNS: Dict[str, Set[str]] = {
+    "os.close": {"<arg0>"},
+}
+
+# calls that never raise in practice — a live resource across one of
+# these is not an exception edge
+SAFE_CALL_ATTRS = frozenset({
+    "get", "keys", "values", "items", "setdefault", "pop", "popitem",
+    "move_to_end", "append", "appendleft", "add", "discard", "clear",
+    "update", "remove", "count", "index", "copy", "extend",
+    "acquire", "locked", "is_set", "set", "notify", "notify_all",
+    "monotonic", "time", "perf_counter", "debug", "info", "warning",
+    "error", "exception", "getrefcount", "fileno", "startswith",
+    "endswith", "split", "rsplit", "join", "strip", "lstrip", "rstrip",
+    "encode", "decode", "format", "lower", "upper", "partition",
+    "rpartition", "is_alive", "getpid", "with_suffix", "hexdigest",
+    "name", "release",
+})
+SAFE_CALL_NAMES = frozenset({
+    "len", "min", "max", "abs", "int", "float", "str", "bool", "bytes",
+    "bytearray", "memoryview", "isinstance", "issubclass", "hasattr",
+    "getattr", "id", "range", "sorted", "list", "dict", "set", "tuple",
+    "frozenset", "repr", "print", "enumerate", "zip", "type", "iter",
+    "next", "vars", "hash", "format", "callable", "os.close",
+})
+
+# parameter names that look like resources — the constructor check
+# only tracks stores of these (storing ``addr`` is not a leak hazard)
+RESOURCE_PARAM_NAMES = frozenset({
+    "conn", "sock", "socket", "listener", "fd", "f", "fileobj", "mm",
+    "chan", "channel", "connection", "thread", "proc",
+})
+SAFE_CALL_PREFIXES = ("logger.", "rtlog.", "time.", "mcat.", "math.",
+                      "errno.", "os.environ.", "threading.Lock",
+                      "threading.RLock", "threading.Event",
+                      "threading.Condition", "threading.local",
+                      "collections.")
+
+_OWNS_RE = re.compile(r"#\s*rtlint:\s*owns\(([^)]*)\)")
+_RETURNS_RE = re.compile(r"#\s*rtlint:\s*returns\(([a-z]+)\)")
+
+
+class FuncSummary(NamedTuple):
+    owns_params: Set[str]     # params discharged on every normal path
+    param_order: tuple        # declared param names (self/cls stripped)
+    returns_kind: Optional[str] = None  # factory: calls are acquisitions
+
+
+class _Res:
+    """One live resource in the abstract state."""
+
+    __slots__ = ("kind", "line", "name", "protected", "exc_reported",
+                 "is_param")
+
+    def __init__(self, kind: str, line: int, name: str,
+                 is_param: bool = False):
+        self.kind = kind
+        self.line = line
+        self.name = name
+        self.is_param = is_param
+        self.protected = False      # an enclosing finally/handler settles it
+        self.exc_reported = False   # one exc finding per acquisition
+
+
+def _arg_names(node) -> List[str]:
+    a = node.args
+    names = [x.arg for x in a.posonlyargs] + [x.arg for x in a.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _def_annotation_params(sf: SourceFile, node) -> Set[str]:
+    """``# rtlint: owns(a, b)`` on the def line or the line above."""
+    out: Set[str] = set()
+    for ln in (node.lineno, node.lineno - 1):
+        if not 1 <= ln <= len(sf.lines):
+            continue
+        m = _OWNS_RE.search(sf.lines[ln - 1])
+        if m:
+            out |= {p.strip() for p in m.group(1).split(",") if p.strip()}
+    return out
+
+
+def _def_returns_kind(sf: SourceFile, node) -> Optional[str]:
+    """``# rtlint: returns(conn)`` marks a factory: every call site
+    acquires a resource of that kind (the interprocedural half of the
+    pass — ``pc = self.acquire(addr)`` is tracked like a dial)."""
+    for ln in (node.lineno, node.lineno - 1):
+        if not 1 <= ln <= len(sf.lines):
+            continue
+        m = _RETURNS_RE.search(sf.lines[ln - 1])
+        if m:
+            return m.group(1)
+    return None
+
+
+class _FuncAnalysis:
+    """Abstract-interpretation walk of one function body.
+
+    ``seed_params=True`` is the summary mode: parameters enter as live
+    pseudo-resources and the analysis records which are settled on
+    every normal exit (no findings reported); the caller-facing mode
+    reports findings for real acquisitions only.
+    """
+
+    def __init__(self, sf: SourceFile, node,
+                 summaries: Dict[str, FuncSummary],
+                 collect_findings: bool, seed_params: bool,
+                 ctor_mode: bool = False,
+                 file_returns: Optional[Dict[str, str]] = None):
+        self.sf = sf
+        self.node = node
+        self.summaries = summaries
+        # ``# rtlint: returns(kind)`` factories resolve by bare method
+        # name, so they are scoped to the file that declares them — a
+        # same-named method on an unrelated class in another file
+        # (NodeState.acquire vs DataPlanePool.acquire) must not become
+        # a conn factory there
+        self.file_returns = file_returns or {}
+        self.collect = collect_findings
+        self.ctor_mode = ctor_mode
+        self.findings: List[Finding] = []
+        self.state: Dict[str, _Res] = {}
+        # ctor mode: self-attribute -> (kind, store line, reported) for
+        # resources the constructor has taken ownership of — a raise
+        # after the store strands them (the caller gets no object back)
+        self.stored: Dict[str, List] = {}
+        self.param_discharged: Dict[str, bool] = {}
+        if seed_params or ctor_mode:
+            for p in _arg_names(node):
+                self.state[p] = _Res("param", node.lineno, p,
+                                     is_param=True)
+                self.param_discharged[p] = True  # ANDed at each exit
+
+    # ---------------------------------------------------------------- utils
+    def _finding(self, line: int, rule: str, msg: str) -> None:
+        if self.collect:
+            self.findings.append(Finding(self.sf.rel, line, rule, msg))
+
+    def _discharge(self, name: str) -> None:
+        self.state.pop(name, None)
+
+    def _exit(self, line: int, kept: Set[str], why: str) -> None:
+        """A path leaves the function; every live unprotected
+        non-param resource not in ``kept`` leaks."""
+        for name, res in list(self.state.items()):
+            if res.is_param:
+                if name not in kept and not res.protected:
+                    self.param_discharged[name] = False
+                continue
+            if name in kept or res.protected:
+                continue
+            self._finding(
+                res.line, "resource-leak",
+                f"{res.kind} acquired here (as {res.name!r}) is not "
+                f"closed or ownership-transferred on the {why} path "
+                f"ending at line {line}")
+            self._discharge(name)  # one finding per acquisition
+
+    # --------------------------------------------------------- classifiers
+    def _acquire_kind(self, call: ast.Call) -> Optional[str]:
+        name = dotted_name(call.func)
+        attr = name.rsplit(".", 1)[-1] if name else ""
+        if name in ACQUIRE_NAMES:
+            kind = ACQUIRE_NAMES[name]
+        elif attr in ACQUIRE_ATTRS:
+            if attr == "connect" and name not in ("connect",
+                                                  "protocol.connect"):
+                return None  # sock.connect(addr) returns None
+            kind = ACQUIRE_ATTRS[attr]
+        elif attr in self.file_returns:
+            kind = self.file_returns[attr]
+        else:
+            return None
+        if kind == "thread":
+            for kw in call.keywords:
+                if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is True:
+                    return None  # daemonized at construction
+        return kind
+
+    def _owned_params(self, call: ast.Call) -> Set[str]:
+        """Param names the callee owns (annotation or computed)."""
+        name = dotted_name(call.func)
+        if name in BUILTIN_OWNS:
+            return BUILTIN_OWNS[name]
+        attr = name.rsplit(".", 1)[-1] if name else ""
+        summ = self.summaries.get(attr)
+        return set(summ.owns_params) if summ else set()
+
+    def _owned_positions(self, call: ast.Call) -> Set[int]:
+        owned = self._owned_params(call)
+        if not owned:
+            return set()
+        if "<arg0>" in owned:
+            return {0}
+        attr = dotted_name(call.func).rsplit(".", 1)[-1]
+        summ = self.summaries.get(attr)
+        if summ is None:
+            return set()
+        return {i for i, p in enumerate(summ.param_order) if p in owned}
+
+    def _closes_receiver(self, call: ast.Call) -> Optional[str]:
+        """``x.close()`` / ``x.conn.close()`` → ``x`` (when live)."""
+        f = call.func
+        if not isinstance(f, ast.Attribute) or f.attr not in CLOSE_METHODS:
+            return None
+        base = f.value
+        if isinstance(base, ast.Attribute):  # pc.conn.close() settles pc
+            base = base.value
+        if isinstance(base, ast.Name) and base.id in self.state:
+            return base.id
+        return None
+
+    def _may_raise(self, call: ast.Call) -> bool:
+        name = dotted_name(call.func)
+        if not name:
+            return True
+        if name in SAFE_CALL_NAMES:
+            return False
+        if any(name.startswith(p) for p in SAFE_CALL_PREFIXES):
+            return False
+        if name.rsplit(".", 1)[-1] in SAFE_CALL_ATTRS:
+            return False
+        return True
+
+    def _exc_edge(self, line: int, what: str) -> None:
+        for res in self.state.values():
+            if res.protected or res.exc_reported or res.is_param:
+                continue
+            res.exc_reported = True
+            self._finding(
+                res.line, "resource-exc-leak",
+                f"{res.kind} acquired here (as {res.name!r}) leaks if "
+                f"{what} at line {line} raises (wrap in try/finally, "
+                f"close on the error path, or transfer ownership first)")
+        if self.ctor_mode:
+            for attr, rec in self.stored.items():
+                kind, store_line, reported = rec
+                if reported:
+                    continue
+                rec[2] = True
+                self._finding(
+                    store_line, "resource-exc-leak",
+                    f"constructor stores a {kind} in self.{attr} here "
+                    f"but may still raise at line {line} ({what}) — a "
+                    f"failed __init__ returns no object, stranding it; "
+                    f"close stored resources on the failure path")
+
+    # ------------------------------------------------------------ the walk
+    def run(self) -> None:
+        self.walk_block(self.node.body, in_try=False)
+        end = self.node.body[-1].lineno if self.node.body else \
+            self.node.lineno
+        self._exit(end, set(), "fall-through")
+
+    def walk_block(self, stmts: List[ast.stmt], in_try: bool) -> bool:
+        """Returns True when the block always terminates (every path
+        returns / raises / continues / breaks)."""
+        for st in stmts:
+            if self._walk_stmt(st, in_try):
+                return True
+        return False
+
+    def _walk_stmt(self, st: ast.stmt, in_try: bool) -> bool:
+        if isinstance(st, ast.Return):
+            kept = _names_in(st.value)
+            self._eval(st.value, in_try, sink="return")
+            for n in list(kept & set(self.state)):
+                self._discharge(n)
+            self._exit(st.lineno, kept, "return")
+            return True
+        if isinstance(st, ast.Raise):
+            self._eval(st.exc, in_try, sink="drop")
+            self._exc_edge(st.lineno, "the raise")
+            return True
+        if isinstance(st, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(st, ast.With):
+            for item in st.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call) and \
+                        self._acquire_kind(ce) is not None:
+                    # context-managed acquisition: __exit__ discharges;
+                    # still evaluate the args for nested effects
+                    for a in list(ce.args) + [k.value for k in ce.keywords]:
+                        self._eval(a, in_try, sink="drop")
+                    if item.optional_vars is not None and not in_try:
+                        pass  # held by the with; no exc edge
+                else:
+                    self._eval(ce, in_try, sink="drop")
+            return self.walk_block(st.body, in_try)
+        if isinstance(st, ast.Try):
+            return self._walk_try(st, in_try)
+        if isinstance(st, ast.If):
+            self._eval(st.test, in_try, sink="drop")
+            return self._walk_branches([st.body, st.orelse], in_try)
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._eval(st.iter, in_try, sink="drop")
+            self.walk_block(st.body, in_try)
+            self.walk_block(st.orelse, in_try)
+            return False
+        if isinstance(st, ast.While):
+            self._eval(st.test, in_try, sink="drop")
+            self.walk_block(st.body, in_try)
+            self.walk_block(st.orelse, in_try)
+            return False
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return False  # analyzed separately
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._walk_assign(st, in_try)
+            return False
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    self._discharge(t.id)
+            return False
+        if isinstance(st, ast.Expr):
+            self._eval(st.value, in_try, sink="drop")
+            return False
+        if isinstance(st, ast.Match):
+            self._eval(st.subject, in_try, sink="drop")
+            return self._walk_branches([c.body for c in st.cases],
+                                       in_try, has_default=any(
+                                           _case_is_default(c)
+                                           for c in st.cases))
+        if isinstance(st, ast.Assert):
+            self._eval(st.test, in_try, sink="drop")
+            return False
+        return False
+
+    def _walk_branches(self, bodies: List[Optional[List[ast.stmt]]],
+                       in_try: bool, has_default: bool = True) -> bool:
+        """Branch bodies walk on copies of the state; the merged
+        fall-through keeps a resource live if ANY non-terminating
+        branch (or the implicit empty else) leaves it live."""
+        base = dict(self.state)
+        base_stored = {k: list(v) for k, v in self.stored.items()}
+        merged: Dict[str, _Res] = {}
+        merged_stored: Dict[str, List] = {}
+        all_terminate = True
+        explicit_else = bool(bodies) and bool(bodies[-1])
+        for body in bodies:
+            if not body:
+                continue
+            self.state = dict(base)
+            self.stored = {k: list(v) for k, v in base_stored.items()}
+            if not self.walk_block(body, in_try):
+                all_terminate = False
+                for k, v in self.state.items():
+                    merged.setdefault(k, v)
+                for k, v in self.stored.items():
+                    merged_stored.setdefault(k, v)
+        if not explicit_else or not has_default:
+            all_terminate = False
+            for k, v in base.items():
+                merged.setdefault(k, v)
+            for k, v in base_stored.items():
+                merged_stored.setdefault(k, v)
+        self.state = merged
+        self.stored = merged_stored
+        return all_terminate
+
+    def _walk_try(self, st: ast.Try, in_try: bool) -> bool:
+        settled = self._settled_names(st)
+        saved_prot: Dict[str, bool] = {}
+        for n in settled:
+            if n in self.state:
+                saved_prot[n] = self.state[n].protected
+                self.state[n].protected = True
+        pre = dict(self.state)
+        body_term = self.walk_block(st.body, in_try=True)
+        for n in settled:  # body-acquired names the try also settles
+            if n in self.state and n not in saved_prot:
+                self.state[n].protected = True
+        body_state = self.state
+        handler_states: List[Dict[str, _Res]] = []
+        handlers_all_term = bool(st.handlers)
+        for h in st.handlers:
+            self.state = dict(pre)
+            for n in settled:
+                if n in self.state:
+                    self.state[n].protected = True
+            if not self.walk_block(h.body, in_try):
+                handlers_all_term = False
+                handler_states.append(self.state)
+        merged: Dict[str, _Res] = {}
+        if not body_term:
+            merged.update(body_state)
+        for hs in handler_states:
+            for k, v in hs.items():
+                merged.setdefault(k, v)
+        self.state = merged
+        orelse_term = False
+        if st.orelse and not body_term:
+            orelse_term = self.walk_block(st.orelse, in_try)
+        fin_term = False
+        if st.finalbody:
+            fin_term = self.walk_block(st.finalbody, in_try)
+            for n in self._closed_in(st.finalbody):
+                self._discharge(n)  # finally CLOSED it on every path
+        for n, was in saved_prot.items():
+            if n in self.state:
+                self.state[n].protected = was
+        for n in settled:
+            if n in self.state and n not in saved_prot:
+                self.state[n].protected = False
+        if body_term and handlers_all_term:
+            return True
+        return fin_term or orelse_term
+
+    def _settled_names(self, st: ast.Try) -> Set[str]:
+        out = self._closed_in(st.finalbody)
+        for h in st.handlers:
+            out |= self._closed_in(h.body)
+        return out
+
+    def _closed_in(self, body) -> Set[str]:
+        """Names discharged anywhere in ``body`` (syntactic scan)."""
+        out: Set[str] = set()
+        if not body:
+            return out
+        wrapper = ast.Module(body=list(body), type_ignores=[])
+        for node in ast.walk(wrapper):
+            if not isinstance(node, ast.Call):
+                continue
+            recv = self._closes_receiver_any(node)
+            if recv is not None:
+                out.add(recv)
+            owned_pos = self._owned_positions(node)
+            name = dotted_name(node.func)
+            attr = name.rsplit(".", 1)[-1] if name else ""
+            transfers_all = attr in TRANSFER_METHODS and \
+                isinstance(node.func, ast.Attribute)
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Name) and \
+                        (transfers_all or i in owned_pos):
+                    out.add(a.id)
+            if self._owned_params(node):
+                for kw in node.keywords:
+                    if isinstance(kw.value, ast.Name) and \
+                            kw.arg in self._owned_params(node):
+                        out.add(kw.value.id)
+        return out
+
+    def _closes_receiver_any(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if not isinstance(f, ast.Attribute) or f.attr not in CLOSE_METHODS:
+            return None
+        base = f.value
+        if isinstance(base, ast.Attribute):
+            base = base.value
+        if isinstance(base, ast.Name):
+            return base.id
+        return None
+
+    # --------------------------------------------------------- assignments
+    def _walk_assign(self, st, in_try: bool) -> None:
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        value = getattr(st, "value", None)
+        if value is None:
+            return
+        tgt = targets[0] if len(targets) == 1 else None
+        if isinstance(st, ast.AugAssign):
+            self._eval(value, in_try, sink="drop")
+            return
+        if isinstance(tgt, ast.Name):
+            self._eval(value, in_try, sink=("name", tgt.id))
+            return
+        if isinstance(tgt, ast.Tuple) and isinstance(value, ast.Tuple) \
+                and len(tgt.elts) == len(value.elts):
+            for t, v in zip(tgt.elts, value.elts):
+                if isinstance(t, ast.Name):
+                    self._eval(v, in_try, sink=("name", t.id))
+                else:
+                    self._eval(v, in_try, sink="store")
+            return
+        if isinstance(tgt, ast.Tuple) and isinstance(value, ast.Call):
+            # ``fd, size = checkout(...)``: bind the acquisition to the
+            # FIRST name in the target (resources ride first by
+            # convention in this repo)
+            first = next((t.id for t in tgt.elts
+                          if isinstance(t, ast.Name)), None)
+            self._eval(value, in_try,
+                       sink=("name", first) if first else "drop")
+            return
+        # attribute / subscript / starred target: stored into an owner —
+        # live resources referenced by the value are transferred
+        attr = None
+        if self.ctor_mode and isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            attr = tgt.attr
+        self._eval(value, in_try,
+                   sink=("attr", attr, st.lineno) if attr else "store")
+        for n in list(_names_in(value) & set(self.state)):
+            self._discharge(n)
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Call):
+                    self._eval(sub, in_try, sink="drop")
+
+    # -------------------------------------------------------- expressions
+    def _eval(self, node, in_try: bool, sink) -> None:
+        """Evaluate one expression tree.  ``sink`` says where the
+        VALUE goes: ("name", n) binds it, "store"/"return" transfer
+        it, "owned" means a callee takes it, "drop" discards it."""
+        if node is None:
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp, ast.Lambda)):
+            return  # documented unsoundness
+        if isinstance(node, ast.Call):
+            self._eval_call(node, in_try, sink)
+            return
+        if isinstance(node, ast.Name):
+            if sink in ("store", "return", "owned") and \
+                    node.id in self.state:
+                self._discharge(node.id)
+            elif isinstance(sink, tuple) and sink[0] == "attr":
+                res = self.state.get(node.id)
+                if res is not None:
+                    if not res.is_param or node.id in RESOURCE_PARAM_NAMES:
+                        self.stored[sink[1]] = [
+                            res.kind if not res.is_param else "resource",
+                            sink[2], False]
+                    self._discharge(node.id)
+            elif isinstance(sink, tuple) and node.id in self.state:
+                # alias: x = y moves ownership to x
+                res = self.state.pop(node.id)
+                res.name = sink[1]
+                self.state[sink[1]] = res
+            return
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            # a container literal that is itself bound/stored/returned
+            # owns the resources placed in it (containers untracked)
+            el_sink = "store" if sink != "drop" else "drop"
+            for el in node.elts:
+                self._eval(el, in_try, el_sink)
+            return
+        if isinstance(node, ast.Dict):
+            el_sink = "store" if sink != "drop" else "drop"
+            for v in node.values:
+                if v is not None:
+                    self._eval(v, in_try, el_sink)
+            for k in node.keys:
+                if k is not None:
+                    self._eval(k, in_try, "drop")
+            return
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, in_try, "drop")
+            self._eval(node.body, in_try, sink)
+            self._eval(node.orelse, in_try, sink)
+            return
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self._eval(v, in_try, sink)
+            return
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            self._eval(node.value, in_try, sink)
+            return
+        if isinstance(node, ast.Starred):
+            self._eval(node.value, in_try, sink)
+            return
+        # generic: walk children with drop sink
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._eval(child, in_try, "drop")
+
+    def _eval_call(self, call: ast.Call, in_try: bool, sink) -> None:
+        # receiver-close effect first: x.close()
+        recv = self._closes_receiver(call)
+        if recv is not None:
+            self._discharge(recv)
+        if self.ctor_mode and isinstance(call.func, ast.Attribute) and \
+                call.func.attr in CLOSE_METHODS:
+            v = call.func.value
+            if isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and v.value.id == "self":
+                # self.X.close() in a failure handler settles the store
+                self.stored.pop(v.attr, None)
+            elif isinstance(v, ast.Name) and v.id == "self":
+                # self.close() settles everything the ctor stored
+                self.stored.clear()
+        # evaluate arguments
+        owned_pos = self._owned_positions(call)
+        owned_params = self._owned_params(call)
+        name = dotted_name(call.func)
+        attr = name.rsplit(".", 1)[-1] if name else ""
+        transfers_all = attr in TRANSFER_METHODS and \
+            isinstance(call.func, ast.Attribute)
+        is_thread = name in ("threading.Thread", "Thread")
+        for i, a in enumerate(call.args):
+            arg_sink = "owned" if (transfers_all or i in owned_pos) \
+                else "drop"
+            self._eval(a, in_try, arg_sink)
+        for kw in call.keywords:
+            if is_thread and kw.arg == "args" and \
+                    isinstance(kw.value, ast.Tuple):
+                for el in kw.value.elts:
+                    self._eval(el, in_try, "owned")
+                continue
+            kw_sink = "owned" if (kw.arg in owned_params or transfers_all) \
+                else "drop"
+            self._eval(kw.value, in_try, kw_sink)
+        # nested receiver chain (obj in obj.method(...)) — evaluate for
+        # nested calls like RpcChannel(connect(...)).call(...)
+        if isinstance(call.func, ast.Attribute):
+            self._eval(call.func.value, in_try, "drop")
+        # acquisition?
+        kind = self._acquire_kind(call)
+        if kind is not None:
+            if sink in ("store", "return", "owned"):
+                return  # transferred by construction
+            if isinstance(sink, tuple) and sink[0] == "attr":
+                self.stored[sink[1]] = [kind, sink[2], False]
+                return
+            if isinstance(sink, tuple):
+                self.state[sink[1]] = _Res(kind, call.lineno, sink[1])
+                return
+            self._finding(
+                call.lineno, "resource-leak",
+                f"{kind} acquired and immediately dropped (not "
+                f"assigned, stored, closed, or ownership-transferred)")
+            return
+        # plain call: exception edge
+        if recv is None and not in_try and self._may_raise(call):
+            self._exc_edge(call.lineno,
+                           f"{dotted_name(call.func) or 'the call'}()")
+
+    # ------------------------------------------------------------ summary
+    def summary(self) -> FuncSummary:
+        owned = {p for p, ok in self.param_discharged.items() if ok}
+        return FuncSummary(owned, tuple(_arg_names(self.node)))
+
+
+def _names_in(node) -> Set[str]:
+    if node is None:
+        return set()
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _case_is_default(case) -> bool:
+    p = case.pattern
+    return isinstance(p, ast.MatchAs) and p.pattern is None
+
+
+def _functions(sf: SourceFile):
+    """Yield ``(summary name, def node)``: plain defs under their own
+    name, ``__init__`` additionally under its CLASS name so
+    ``_PoolConn(conn, ...)`` resolves to the constructor's summary."""
+    classes = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    classes[id(child)] = node.name
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+            if node.name == "__init__" and id(node) in classes:
+                yield classes[id(node)], node
+
+
+def compute_summaries(files: List[SourceFile],
+                      rounds: int = 3) -> Dict[str, FuncSummary]:
+    """Fixed-point param-ownership summaries across the analyzed files.
+    One namespace keyed by simple function name — same-name collisions
+    merge by intersecting owned params (the safe direction).
+    ``# rtlint: owns(...)`` / ``# rtlint: returns(...)`` annotations
+    are authoritative and win over the analysis."""
+    annotated: Dict[str, FuncSummary] = {}
+    for sf in files:
+        for name, node in _functions(sf):
+            params = _def_annotation_params(sf, node)
+            rk = _def_returns_kind(sf, node)
+            if not params and rk is None:
+                continue
+            prev = annotated.get(name)
+            order = tuple(_arg_names(node))
+            if prev is not None:
+                params = params | prev.owns_params
+                rk = rk or prev.returns_kind
+            annotated[name] = FuncSummary(params, order, rk)
+    summaries: Dict[str, FuncSummary] = dict(annotated)
+    file_returns = {id(sf): collect_file_returns(sf) for sf in files}
+    for _ in range(rounds):
+        nxt: Dict[str, FuncSummary] = {}
+        for sf in files:
+            for name, node in _functions(sf):
+                fa = _FuncAnalysis(sf, node, summaries,
+                                   collect_findings=False,
+                                   seed_params=True,
+                                   file_returns=file_returns[id(sf)])
+                try:
+                    fa.run()
+                except RecursionError:  # pragma: no cover - pathological
+                    continue
+                s = fa.summary()
+                prev = nxt.get(name)
+                if prev is None:
+                    nxt[name] = s
+                else:
+                    nxt[name] = FuncSummary(
+                        prev.owns_params & s.owns_params,
+                        prev.param_order, prev.returns_kind)
+        for name, s in annotated.items():
+            cur = nxt.get(name, s)
+            nxt[name] = FuncSummary(cur.owns_params | s.owns_params,
+                                    cur.param_order or s.param_order,
+                                    s.returns_kind or cur.returns_kind)
+        if nxt == summaries:
+            break
+        summaries = nxt
+    return summaries
+
+
+def collect_file_returns(sf: SourceFile) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for name, node in _functions(sf):
+        rk = _def_returns_kind(sf, node)
+        if rk is not None:
+            out[name] = rk
+    return out
+
+
+def check_resources(files: List[SourceFile]) -> List[Finding]:
+    summaries = compute_summaries(files)
+    findings: List[Finding] = []
+    for sf in files:
+        file_returns = collect_file_returns(sf)
+        seen = set()
+        for _, node in _functions(sf):
+            if id(node) in seen:
+                continue  # __init__ yielded twice (also under class name)
+            seen.add(id(node))
+            fa = _FuncAnalysis(sf, node, summaries,
+                               collect_findings=True, seed_params=False,
+                               ctor_mode=node.name == "__init__",
+                               file_returns=file_returns)
+            try:
+                fa.run()
+            except RecursionError:  # pragma: no cover - pathological
+                continue
+            findings.extend(fa.findings)
+    return findings
+
+
+def default_files(root: Path) -> List[Path]:
+    priv = root / "ray_tpu" / "_private"
+    return [priv / n for n in
+            ("data_plane.py", "gcs.py", "worker.py", "protocol.py",
+             "shm_store.py", "node_agent.py", "actor_server.py",
+             "resource_sanitizer.py")]
+
+
+def default_check(root: Path) -> List[Finding]:
+    files = [load(p) for p in default_files(root) if p.exists()]
+    return check_resources(files)
