@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
-	databench-quick leakcheck
+	databench-quick servebench-quick llmbench-quick leakcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -71,4 +71,21 @@ microbench-quick:
 databench-quick:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/data_bench.py --pull --quick \
 		--assert-sane --json benchmarks/results/databench_ci.json \
+		--label ci
+
+# Serve data-path smoke (CI): tiny BERT through the real controller →
+# router → replica path, scale-up + replica-kill recovery asserted,
+# JSON artifact for the uploader.
+servebench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_bench.py --quick \
+		--assert-sane --json benchmarks/results/servebench_ci.json \
+		--label ci
+
+# LLM serving smoke (CI): the continuous-batching engine vs the naive
+# request-level baseline on one seeded diurnal+burst trace; asserts the
+# engine completes every request and does not lose to the baseline
+# (the committed full-scale artifact shows the 2x goodput target).
+llmbench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/llm_bench.py --ab --quick \
+		--assert-sane --json benchmarks/results/llmbench_ci.json \
 		--label ci

@@ -6,6 +6,11 @@ with closed-loop concurrent clients; reports p50/p99 and QPS, then scales
 replicas and reports the reaction.
 
 Usage: python benchmarks/serve_bench.py [--tiny] [--requests N]
+
+CI contract (mirrors data_bench/llm_bench): ``--quick`` (tiny model,
+small request budget), ``--json PATH`` (one artifact object with every
+row), ``--label``, ``--assert-sane`` (completion + sanity bounds).
+``make servebench-quick`` wires it into ci.yml with artifact upload.
 """
 
 from __future__ import annotations
@@ -83,7 +88,25 @@ def main() -> None:
     ap.add_argument("--compile-cache-ab", action="store_true",
                     help="also measure cold vs hot persistent-XLA-cache "
                          "replica compile on the attached chip")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI scale: implies --tiny, small request budget")
+    ap.add_argument("--json", dest="json_path",
+                    help="write all rows as one JSON artifact")
+    ap.add_argument("--label", default="")
+    ap.add_argument("--assert-sane", action="store_true",
+                    help="fail on dropped phases / absurd latencies")
     args = ap.parse_args()
+    if args.quick:
+        args.tiny = True
+        args.requests = min(args.requests, 60)
+        args.concurrency = min(args.concurrency, 4)
+        args.seq = min(args.seq, 64)
+
+    rows: list = []
+
+    def emit(row: dict) -> None:
+        rows.append(row)
+        print(json.dumps(row))
 
     import os
     # logical CPUs: replicas are IO/compute-light here and oversubscribe
@@ -120,15 +143,15 @@ def main() -> None:
             if time.monotonic() > deadline:
                 raise TimeoutError("light scale-up never reached 3 ready")
             time.sleep(0.05)
-        print(json.dumps({
+        emit({
             "metric": "serve_scale_up_1_to_3_light_s",
             "value": round(time.perf_counter() - t0, 2),
             "warm_pool": args.warm_pool,
             "note": "trivial-init replica: isolates controller+scheduler+"
-                    "worker path from model compile cost"}))
+                    "worker path from model compile cost"})
     except Exception as e:  # noqa: BLE001 - optional row, keep bench going
-        print(json.dumps({"metric": "serve_scale_up_1_to_3_light_s",
-                          "error": str(e)[:200]}))
+        emit({"metric": "serve_scale_up_1_to_3_light_s",
+              "error": str(e)[:200]})
     try:
         serve.delete("echo")   # free its CPUs for the BERT phases
         ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
@@ -182,20 +205,20 @@ def main() -> None:
     wall = time.perf_counter() - t0
 
     arr = np.asarray(sorted(lat))
-    print(json.dumps({
+    emit({
         "metric": f"serve_bert_{preset}", "requests": len(arr),
         "qps": round(len(arr) / wall, 1),
         "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
         "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
-        "concurrency": args.concurrency, "seq": args.seq}))
+        "concurrency": args.concurrency, "seq": args.seq})
 
     # autoscale reaction: bump to 3 replicas, measure time-to-ready
     t0 = time.perf_counter()
     serve.run(Bert.options(num_replicas=3).bind(), route_prefix="/bert")
     handle.remote(tok).result()
-    print(json.dumps({"metric": "serve_scale_up_1_to_3_s",
-                      "value": round(time.perf_counter() - t0, 2),
-                      "warm_pool": args.warm_pool}))
+    emit({"metric": "serve_scale_up_1_to_3_s",
+          "value": round(time.perf_counter() - t0, 2),
+          "warm_pool": args.warm_pool})
 
     # replica death → recovery: kill one replica actor, measure time to
     # the controller re-converging on 3 ready replicas
@@ -216,19 +239,40 @@ def main() -> None:
                 raise TimeoutError(f"no reconvergence: {st}")
             time.sleep(0.1)
         handle.remote(tok).result()
-        print(json.dumps({"metric": "serve_replica_kill_recover_s",
-                          "value": round(time.perf_counter() - t0, 2),
-                          "warm_pool": args.warm_pool}))
+        emit({"metric": "serve_replica_kill_recover_s",
+              "value": round(time.perf_counter() - t0, 2),
+              "warm_pool": args.warm_pool})
     except Exception as e:  # noqa: BLE001 - optional row, keep bench going
-        print(json.dumps({"metric": "serve_replica_kill_recover_s",
-                          "error": str(e)[:200]}))
+        emit({"metric": "serve_replica_kill_recover_s",
+              "error": str(e)[:200]})
 
     ray_tpu.shutdown()
 
     if args.compile_cache_ab:
-        row = {"metric": "serve_replica_compile_cache_ab",
-               **_compile_cache_ab(args.seq)}
-        print(json.dumps(row))
+        emit({"metric": "serve_replica_compile_cache_ab",
+              **_compile_cache_ab(args.seq)})
+
+    if args.json_path:
+        os.makedirs(os.path.dirname(args.json_path) or ".", exist_ok=True)
+        with open(args.json_path, "w") as f:
+            json.dump({"label": args.label, "preset": preset,
+                       "requests": args.requests,
+                       "concurrency": args.concurrency, "rows": rows}, f,
+                      indent=2)
+    if args.assert_sane:
+        by = {r["metric"]: r for r in rows}
+        bert = by.get(f"serve_bert_{preset}")
+        assert bert and "error" not in bert, f"bert phase failed: {bert}"
+        assert bert["qps"] > 0 and bert["requests"] > 0, bert
+        # generous hang-vs-working bound, not a perf target (shared CI)
+        assert bert["p99_ms"] < 120_000, bert
+        su = by.get("serve_scale_up_1_to_3_s")
+        assert su and "error" not in su and su["value"] < 600, \
+            f"scale-up phase failed: {su}"
+        kill = by.get("serve_replica_kill_recover_s")
+        assert kill and "error" not in kill, \
+            f"replica kill/recover failed: {kill}"
+        print("serve_bench: sanity asserts passed")
 
 
 if __name__ == "__main__":

@@ -525,6 +525,16 @@ class DataPlaneServer:
             mm.close()
         return True
 
+    def delete_local(self, object_id: str) -> None:
+        """Producer-side spool eviction: unlink + fd-cache invalidate,
+        same semantics as the remote ``delete_object`` op (in-flight
+        streams keep their dup'd fd; later fetches miss)."""
+        try:
+            os.unlink(spool_path(self.spool_dir, object_id))
+        except FileNotFoundError:
+            pass
+        self._fd_cache.invalidate(object_id)
+
     def stop(self) -> None:
         self._stop.set()
         try:

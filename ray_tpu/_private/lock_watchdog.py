@@ -101,6 +101,26 @@ SHM_STORE_LOCK_DAG: Dict[str, Set[str]] = {
 
 SHM_STORE_CV_ALIASES: Dict[str, str] = {}
 
+# serve/llm paged KV cache (kv_cache.py): one leaf lock guards the
+# allocator tables (free list, block tables, fills, refcounts).  Pool
+# byte writes (scatter/write_token) are engine-loop-owned and happen
+# OUTSIDE it by design — the lock protects placement, not payload.
+LLM_KV_LOCK_DAG: Dict[str, Set[str]] = {
+    "_lock": set(),
+}
+
+LLM_KV_CV_ALIASES: Dict[str, str] = {}
+
+# serve/llm engine (engine.py): one leaf lock guards the cross-thread
+# handoff state (inbox/attached queues, per-request stream registry).
+# Scheduler and cache-payload state are engine-loop-owned (no lock);
+# the cache's own leaf lock is never taken while holding this one.
+LLM_ENGINE_LOCK_DAG: Dict[str, Set[str]] = {
+    "_lock": set(),
+}
+
+LLM_ENGINE_CV_ALIASES: Dict[str, str] = {}
+
 
 def reachable(dag: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
     """Transitive closure: lock → every lock legally acquirable under it."""

@@ -194,7 +194,10 @@ def _resolve_attn(cfg: GPT2Config) -> AttnImpl:
 
 
 def _block(x: jax.Array, lp: Params, cfg: GPT2Config,
-           attn: AttnImpl) -> jax.Array:
+           attn: AttnImpl, collect_kv: bool = False):
+    """One transformer block; with ``collect_kv`` also returns the
+    per-head (k, v) — the SAME body serves training and the serving
+    engine's prefill cache fill, so the two paths cannot diverge."""
     B, T, E = x.shape
     H, D = cfg.n_head, cfg.head_dim
     h = _layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"])
@@ -217,7 +220,10 @@ def _block(x: jax.Array, lp: Params, cfg: GPT2Config,
     h = jax.nn.gelu(h, approximate=True)
     h = h @ lp["mlp_out"]["kernel"].astype(cfg.dtype) \
         + lp["mlp_out"]["bias"].astype(cfg.dtype)
-    return x + h
+    out = x + h
+    if collect_kv:
+        return out, (k, v)
+    return out
 
 
 def forward_hidden(params: Params, tokens: jax.Array,
@@ -411,6 +417,88 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     correct = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
     return (lse - correct.astype(jnp.float32)).mean()
+
+
+# -------------------------------------------------- inference (KV cache)
+def forward_prefill(params: Params, tokens: jax.Array, cfg: GPT2Config,
+                    last_pos: Optional[jax.Array] = None
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Prefill forward: tokens (B, T) → (logits, k, v) with
+    k/v (L, B, T, H, D) — the per-layer KV the serving engine scatters
+    into its paged pool (serve/llm, DESIGN.md §4g).
+
+    ``last_pos`` (traced scalar): compute logits ONLY at that sequence
+    position, returned as (B, V) — prompts are bucket-padded, so the
+    full (B, T, V) head projection would be mostly wasted work and
+    device→host traffic.  None returns the full (B, T, V)."""
+    B, T = tokens.shape
+    attn = _resolve_attn(cfg)
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    x = x + params["wpe"].astype(cfg.dtype)[jnp.arange(T)]
+
+    def body(carry, lp):
+        return _block(carry, lp, cfg, attn, collect_kv=True)
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    if last_pos is not None:
+        x = lax.dynamic_slice_in_dim(x, last_pos, 1, axis=1)
+    logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(cfg.dtype))
+    if last_pos is not None:
+        logits = logits[:, 0]
+    return logits.astype(jnp.float32), ks, vs
+
+
+def forward_decode(params: Params, tokens: jax.Array, positions: jax.Array,
+                   kv_pool: jax.Array, block_tables: jax.Array,
+                   ctx_lens: jax.Array,
+                   cfg: GPT2Config) -> Tuple[jax.Array, jax.Array,
+                                             jax.Array]:
+    """One decode step over the paged KV pool.
+
+    tokens/positions (B,) int32; kv_pool (N, L, 2, bs, H, D) — the
+    engine's shm-backed block pool (read-only here: the new token's K/V
+    is returned, not written); block_tables (B, MAXB) int32;
+    ctx_lens (B,) int32.  Returns (logits (B, V) f32,
+    new_k (L, B, H, D), new_v (L, B, H, D)).
+    """
+    from ray_tpu.ops.paged_attention import paged_attention_decode
+    B = tokens.shape[0]
+    E, H, D = cfg.n_embd, cfg.n_head, cfg.head_dim
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    x = x + params["wpe"].astype(cfg.dtype)[positions]          # (B, E)
+    # (N, L, 2, bs, H, D) → per-layer pools (L, N, bs, H, D)
+    k_pools = kv_pool[:, :, 0].transpose(1, 0, 2, 3, 4)
+    v_pools = kv_pool[:, :, 1].transpose(1, 0, 2, 3, 4)
+
+    def body(carry, xs):
+        x = carry
+        lp, k_pool, v_pool = xs
+        h = _layer_norm(x[:, None, :], lp["ln_1"]["scale"],
+                        lp["ln_1"]["bias"])[:, 0]
+        qkv = jnp.einsum("be,eck->bck",
+                         h, lp["attn_qkv"]["kernel"].astype(cfg.dtype))
+        qkv = qkv + lp["attn_qkv"]["bias"].astype(cfg.dtype)
+        q, k, v = [qkv[:, i, :].reshape(B, H, D) for i in range(3)]
+        a = paged_attention_decode(q, k_pool, v_pool, block_tables,
+                                   ctx_lens, k, v).reshape(B, E)
+        a = a @ lp["attn_out"]["kernel"].astype(cfg.dtype) \
+            + lp["attn_out"]["bias"].astype(cfg.dtype)
+        x = x + a
+        h = _layer_norm(x[:, None, :], lp["ln_2"]["scale"],
+                        lp["ln_2"]["bias"])[:, 0]
+        h = h @ lp["mlp_in"]["kernel"].astype(cfg.dtype) \
+            + lp["mlp_in"]["bias"].astype(cfg.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        h = h @ lp["mlp_out"]["kernel"].astype(cfg.dtype) \
+            + lp["mlp_out"]["bias"].astype(cfg.dtype)
+        return x + h, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_pools, v_pools))
+    x = _layer_norm(x[:, None, :], params["ln_f"]["scale"],
+                    params["ln_f"]["bias"])[:, 0]
+    logits = jnp.einsum("be,ve->bv", x, params["wte"].astype(cfg.dtype))
+    return logits.astype(jnp.float32), ks, vs
 
 
 def flops_per_token(cfg: GPT2Config, seq_len: int) -> float:

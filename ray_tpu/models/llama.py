@@ -145,7 +145,11 @@ def _attention(q, k, v, cfg: LlamaConfig):
     raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
 
 
-def _block(x: jax.Array, lp: Params, cfg: LlamaConfig) -> jax.Array:
+def _block(x: jax.Array, lp: Params, cfg: LlamaConfig,
+           collect_kv: bool = False):
+    """One decoder block; with ``collect_kv`` also returns the post-RoPE
+    pre-GQA-expand (k, v) — the SAME body serves training and the
+    serving engine's prefill cache fill, so the paths cannot diverge."""
     B, T, E = x.shape
     H, D, KV = cfg.n_head, cfg.head_dim, cfg.n_kv_head
     h = _rms_norm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
@@ -153,13 +157,16 @@ def _block(x: jax.Array, lp: Params, cfg: LlamaConfig) -> jax.Array:
     k = (h @ lp["wk"]["kernel"].astype(cfg.dtype)).reshape(B, T, KV, D)
     v = (h @ lp["wv"]["kernel"].astype(cfg.dtype)).reshape(B, T, KV, D)
     q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
-    k, v = _gqa_expand(k, H), _gqa_expand(v, H)
-    a = _attention(q, k, v, cfg).reshape(B, T, E)
+    ke, ve = _gqa_expand(k, H), _gqa_expand(v, H)
+    a = _attention(q, ke, ve, cfg).reshape(B, T, E)
     x = x + a @ lp["wo"]["kernel"].astype(cfg.dtype)
     h = _rms_norm(x, lp["mlp_norm"]["scale"], cfg.rms_eps)
     gate = jax.nn.silu(h @ lp["w_gate"]["kernel"].astype(cfg.dtype))
     up = h @ lp["w_up"]["kernel"].astype(cfg.dtype)
-    return x + (gate * up) @ lp["w_down"]["kernel"].astype(cfg.dtype)
+    out = x + (gate * up) @ lp["w_down"]["kernel"].astype(cfg.dtype)
+    if collect_kv:
+        return out, (k, v)
+    return out
 
 
 def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
@@ -176,6 +183,87 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
     x = _rms_norm(x, params["norm_f"]["scale"], cfg.rms_eps)
     logits = x @ params["lm_head"]["kernel"].astype(cfg.dtype)
     return logits.astype(jnp.float32)
+
+
+# -------------------------------------------------- inference (KV cache)
+def _rope_at(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding for single tokens at explicit positions.
+
+    x (B, H, D); positions (B,) int32 — the absolute position of each
+    sequence's token (decode caches post-RoPE keys, so each key is
+    rotated once, at its own position)."""
+    B, H, D = x.shape
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[:, None, :]            # (B, 1, half)
+    sin = jnp.sin(angles)[:, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
+def forward_prefill(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+                    last_pos: Optional[jax.Array] = None):
+    """Prefill forward: tokens (B, T) → (logits, k, v) with
+    k/v (L, B, T, KV, D).  Keys are cached post-RoPE, values
+    pre-GQA-expand (the paged decode attention expands groups itself) —
+    the layout the serve/llm engine scatters into its pool.
+
+    ``last_pos`` (traced scalar): logits only at that position as
+    (B, V); None returns the full (B, T, V) — see gpt2.forward_prefill."""
+    x = params["wte"].astype(cfg.dtype)[tokens]
+
+    def body(carry, lp):
+        return _block(carry, lp, cfg, collect_kv=True)
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    x = _rms_norm(x, params["norm_f"]["scale"], cfg.rms_eps)
+    if last_pos is not None:
+        x = lax.dynamic_slice_in_dim(x, last_pos, 1, axis=1)
+    logits = x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    if last_pos is not None:
+        logits = logits[:, 0]
+    return logits.astype(jnp.float32), ks, vs
+
+
+def forward_decode(params: Params, tokens: jax.Array, positions: jax.Array,
+                   kv_pool: jax.Array, block_tables: jax.Array,
+                   ctx_lens: jax.Array, cfg: LlamaConfig):
+    """One decode step over the paged KV pool (see gpt2.forward_decode).
+
+    kv_pool (N, L, 2, bs, KV, D); returns (logits (B, V) f32,
+    new_k (L, B, KV, D), new_v (L, B, KV, D))."""
+    from ray_tpu.ops.paged_attention import paged_attention_decode
+    B = tokens.shape[0]
+    E, H, D, KV = cfg.n_embd, cfg.n_head, cfg.head_dim, cfg.n_kv_head
+    x = params["wte"].astype(cfg.dtype)[tokens]                 # (B, E)
+    k_pools = kv_pool[:, :, 0].transpose(1, 0, 2, 3, 4)
+    v_pools = kv_pool[:, :, 1].transpose(1, 0, 2, 3, 4)
+
+    def body(carry, xs):
+        x = carry
+        lp, k_pool, v_pool = xs
+        h = _rms_norm(x, lp["attn_norm"]["scale"], cfg.rms_eps)
+        q = (h @ lp["wq"]["kernel"].astype(cfg.dtype)).reshape(B, H, D)
+        k = (h @ lp["wk"]["kernel"].astype(cfg.dtype)).reshape(B, KV, D)
+        v = (h @ lp["wv"]["kernel"].astype(cfg.dtype)).reshape(B, KV, D)
+        q = _rope_at(q, positions, cfg.rope_theta)
+        k = _rope_at(k, positions, cfg.rope_theta)
+        a = paged_attention_decode(q, k_pool, v_pool, block_tables,
+                                   ctx_lens, k, v).reshape(B, E)
+        x = x + a @ lp["wo"]["kernel"].astype(cfg.dtype)
+        h = _rms_norm(x, lp["mlp_norm"]["scale"], cfg.rms_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"]["kernel"].astype(cfg.dtype))
+        up = h @ lp["w_up"]["kernel"].astype(cfg.dtype)
+        x = x + (gate * up) @ lp["w_down"]["kernel"].astype(cfg.dtype)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_pools, v_pools))
+    x = _rms_norm(x, params["norm_f"]["scale"], cfg.rms_eps)
+    logits = x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    return logits.astype(jnp.float32), ks, vs
 
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array],

@@ -9,6 +9,9 @@ from ray_tpu.ops.attention import (  # noqa: F401
     blockwise_attention, dense_attention,
 )
 from ray_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from ray_tpu.ops.paged_attention import (  # noqa: F401
+    paged_attention_decode,
+)
 from ray_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention, ring_attention_sharded,
 )
@@ -18,6 +21,7 @@ from ray_tpu.ops.ulysses import (  # noqa: F401
 
 __all__ = [
     "dense_attention", "blockwise_attention", "flash_attention",
+    "paged_attention_decode",
     "ring_attention", "ring_attention_sharded",
     "ulysses_attention", "ulysses_attention_sharded",
 ]

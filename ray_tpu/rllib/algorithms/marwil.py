@@ -113,14 +113,26 @@ class MARWIL(Algorithm):
             # the current value head (one batched forward per iteration)
             self.data.rebuild_returns(policy.value)
         bs = int(self.config["train_batch_size"])
-        pi_l = vf_l = 0.0
+        # report the MEAN over the iteration's minibatches (reference
+        # behavior): the last-minibatch value alone is sampling noise —
+        # on a converged BC run it wanders ±5% and makes
+        # monotonic-descent checks flaky
+        pi_ls, vf_ls = [], []
         for _ in range(int(self.config["updates_per_iteration"])):
             mb = self.data.minibatch(self._rng, bs)
             (policy.params, self._opt_state, self._sq_norm, pi_l,
              vf_l) = self._update(policy.params, self._opt_state,
                                   self._sq_norm, mb[OBS], mb[ACTIONS],
                                   mb["returns"])
+            # keep the raw device scalars: a float() here would force a
+            # device sync per minibatch and serialize the update loop
+            pi_ls.append(pi_l)
+            vf_ls.append(vf_l)
             self._trained += len(mb[OBS])
+        pi_l = float(np.mean([np.asarray(x) for x in pi_ls])) \
+            if pi_ls else 0.0
+        vf_l = float(np.mean([np.asarray(x) for x in vf_ls])) \
+            if vf_ls else 0.0
         return {"policy_loss": float(pi_l), "vf_loss": float(vf_l),
                 "num_steps_trained": self._trained,
                 "dataset_episodes": self.data.episodes,

@@ -181,7 +181,8 @@ class ProxyActor:
         req.send_header("Transfer-Encoding", "chunked")
         req.end_headers()
         try:
-            for chunk in resp_f._stream_chunks(marker["__serve_stream__"]):
+            for chunk in resp_f._stream_chunks(marker["__serve_stream__"],
+                                               marker.get("pull", 16)):
                 b = encode_chunk(chunk)
                 if not b:
                     continue  # empty chunk would terminate the encoding

@@ -140,9 +140,10 @@ class Replica:
         must be re-established around each pull, not around the call
         that merely CREATED the generator."""
         from ray_tpu.serve.http_util import StreamingResponse
-        status, ctype, it = 200, "text/plain", None
+        status, ctype, it, pull = 200, "text/plain", None, 16
         if isinstance(result, StreamingResponse):
             status, ctype = result.status_code, result.content_type
+            pull = result.pull_chunks
             it = (self._drive_asyncgen(result.content, model_id)
                   if inspect.isasyncgen(result.content)
                   else iter(result.content))
@@ -164,7 +165,7 @@ class Replica:
         # (stream_next done / cancel / abandoned-reap)
         self._track_ongoing(1)
         return {"__serve_stream__": sid, "status": status,
-                "content_type": ctype}
+                "content_type": ctype, "pull": pull}
 
     def _reap_abandoned_streams(self, max_age_s: float = 600.0) -> None:
         """Drop streams whose client vanished without draining or
@@ -220,7 +221,16 @@ class Replica:
                 pass
 
     def stream_next(self, sid: str, max_chunks: int = 16):
-        """Pull up to ``max_chunks`` items; returns (chunks, done)."""
+        """Pull up to ``max_chunks`` items; returns (chunks, done).
+
+        If the stream object implements ``__serve_poll__(max_chunks)``
+        — returning (ready_chunks, done) without blocking until
+        ``max_chunks`` items EXIST — it is preferred over ``next()``:
+        a latency-bound producer (serve.llm decode loop) then occupies
+        this actor thread only until the first chunk (bounded wait),
+        not for ``max_chunks`` production steps, and an idle stream
+        returns ``([], False)`` so hundreds of pending streams cannot
+        starve the replica's thread pool out of serving new requests."""
         import time as _time
 
         from ray_tpu.serve import multiplex as _mux
@@ -232,12 +242,34 @@ class Replica:
         chunks, done = [], False
         token = _mux._set_model_id(model_id)
         try:
-            for _ in range(max_chunks):
-                try:
-                    chunks.append(next(it))
-                except StopIteration:
-                    done = True
-                    break
+            try:
+                poll = getattr(it, "__serve_poll__", None)
+                if poll is not None:
+                    chunks, done = poll(max_chunks)
+                    chunks = list(chunks)
+                else:
+                    for _ in range(max_chunks):
+                        try:
+                            chunks.append(next(it))
+                        except StopIteration:
+                            done = True
+                            break
+            except BaseException:
+                # a producer failure (e.g. the llm engine failing the
+                # request) ends the stream NOW: deregister and release
+                # the ongoing-request slot instead of pinning both
+                # until the 600s abandoned-stream reap
+                with self._streams_lock:
+                    popped = self._streams.pop(sid, None)
+                if popped is not None:
+                    self._track_ongoing(-1)
+                    close = getattr(popped[0], "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:  # noqa: BLE001 - already dead
+                            pass
+                raise
         finally:
             _mux._current_model_id.reset(token)
         popped = None

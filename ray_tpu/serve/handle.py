@@ -48,10 +48,11 @@ class DeploymentResponse:
         if isinstance(out, dict) and "__serve_stream__" in out:
             # streaming method: hand back a generator that pulls chunks
             # from the replica that owns the generator state
-            return self._stream_chunks(out["__serve_stream__"])
+            return self._stream_chunks(out["__serve_stream__"],
+                                       out.get("pull", 16))
         return out
 
-    def _stream_chunks(self, sid: str):
+    def _stream_chunks(self, sid: str, pull: int = 16):
         # Re-look-up the replica on every pull: generator state lives on
         # the replica, so a replica that dies (or is scaled away) mid-stream
         # must surface as RayServeError, not a raw actor error.
@@ -62,13 +63,19 @@ class DeploymentResponse:
                 raise ray_tpu.exceptions.RayServeError(
                     "streaming replica went away mid-stream")
             try:
-                chunks, done = ray_tpu.get(handle.stream_next.remote(sid))
+                chunks, done = ray_tpu.get(
+                    handle.stream_next.remote(sid, pull))
             except ray_tpu.exceptions.RayActorError as e:
                 raise ray_tpu.exceptions.RayServeError(
                     "streaming replica died mid-stream") from e
             yield from chunks
             if done:
                 return
+            if not chunks:
+                # producer-paced stream (__serve_poll__) with nothing
+                # ready: back off briefly instead of hammering the
+                # replica mailbox
+                time.sleep(0.05)
 
     def _to_object_ref(self):
         return self._ref

@@ -82,13 +82,23 @@ class StreamingResponse:
     """Incremental response (reference: ``StreamingResponse``): ``content``
     is any iterable/generator; chunks reach the client as produced —
     HTTP clients via chunked transfer encoding, handle callers as a
-    generator from ``DeploymentResponse.result()``."""
+    generator from ``DeploymentResponse.result()``.
+
+    ``pull_chunks`` caps the chunks one continuation pull returns.  For
+    plain iterators each pull blocks until that many chunks (or the
+    end), so 16 amortizes round trips for bulk streams.  Producer-paced
+    streams should implement ``__serve_poll__(max_chunks)`` on the
+    content object instead (see ``Replica.stream_next``): a poll
+    returns whatever is READY — first chunk the moment it exists,
+    never parking a replica thread until ``pull_chunks`` items have
+    been produced — and ``pull_chunks`` only bounds the drain."""
 
     def __init__(self, content, content_type: str = "text/plain",
-                 status_code: int = 200):
+                 status_code: int = 200, pull_chunks: int = 16):
         self.content = content
         self.content_type = content_type
         self.status_code = status_code
+        self.pull_chunks = max(1, int(pull_chunks))
 
 
 def encode_chunk(chunk: object) -> bytes:

@@ -1,0 +1,323 @@
+"""Paged KV cache: a shm-backed block pool + per-sequence block tables.
+
+Layout (PagedAttention, Kwon et al. SOSP '23): the cache is ONE
+shared-memory segment (created through ``ShmObjectStore`` so it rides
+the same /dev/shm naming, accounting, and zero-copy mmap semantics as
+every other object) viewed as::
+
+    pool[num_blocks, n_layer, 2, block_size, n_kv, head_dim]
+
+Block-major: block ``i`` is a contiguous byte range — one ``tobytes()``
+slice is a complete, self-describing transfer unit for the data-plane
+export path (``engine.export_seq``), and the whole pool is what the
+bucketed decode step reads through the block table
+(``ops/paged_attention.py``).
+
+The allocator hands out block indices (free list), tracks a block table
+and a refcount per sequence, and frees in block grains — preemption
+under cache pressure returns exactly the preempted sequence's blocks.
+Shared blocks (an attached sequence re-exported, future prefix caching)
+are refcounted: ``free_seq`` returns a block to the free list only at
+refcount zero.
+
+Crash hygiene: /dev/shm files outlive a SIGKILLed replica.  Segment
+names embed the owning pid; ``reap_orphan_segments()`` unlinks segments
+whose owner is gone — called at engine boot (each new engine sweeps its
+predecessors' wreckage) and by the chaos suite's assertions.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private import rtlog
+from ray_tpu._private.shm_store import (ShmObjectStore, _seg_path,
+                                        _SHM_DIR, _PREFIX)
+from ray_tpu.exceptions import ObjectStoreFullError
+
+logger = rtlog.get("serve.llm.kv")
+
+_POOL_TAG = "llmkv"
+
+
+class NoFreeBlocks(Exception):
+    """Allocation failed: the pool is exhausted (caller should preempt)."""
+
+
+def pool_segment_name(pid: int, nonce: str) -> str:
+    return f"{_POOL_TAG}_{pid}_{nonce}"
+
+
+def reap_orphan_segments() -> List[str]:
+    """Unlink llmkv pool segments whose owning pid is dead.
+
+    A SIGKILLed replica cannot unlink its own segment; the file (and its
+    tmpfs pages) would leak until reboot.  Any engine boot — and the
+    chaos suite — sweeps them by the pid baked into the name."""
+    reaped = []
+    try:
+        names = os.listdir(_SHM_DIR)
+    except OSError:
+        return reaped
+    for name in names:
+        if not name.startswith(f"{_PREFIX}{_POOL_TAG}_"):
+            continue
+        try:
+            pid = int(name.split("_")[2])
+        except (IndexError, ValueError):
+            continue
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        try:
+            os.unlink(_SHM_DIR / name)
+            reaped.append(name)
+        except OSError:
+            pass
+    if reaped:
+        logger.info("reaped %d orphaned KV pool segment(s): %s",
+                    len(reaped), reaped)
+    return reaped
+
+
+def reap_orphan_export_spools(base) -> List[str]:
+    """Remove rtpu_llm_export_<pid>_* spool dirs whose owner is dead
+    (the data-plane export half of :func:`reap_orphan_segments`)."""
+    import shutil
+    reaped = []
+    if not base:
+        return reaped
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return reaped
+    for name in names:
+        if not name.startswith("rtpu_llm_export_"):
+            continue
+        try:
+            pid = int(name.split("_")[3])
+        except (IndexError, ValueError):
+            continue
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+        reaped.append(name)
+    if reaped:
+        logger.info("reaped %d orphaned export spool(s): %s",
+                    len(reaped), reaped)
+    return reaped
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+class PagedKVCache:
+    """Block pool + tables + refcounts for one engine instance."""
+
+    def __init__(self, num_blocks: int, n_layer: int, block_size: int,
+                 n_kv: int, head_dim: int, dtype=np.float32):
+        self.num_blocks = num_blocks
+        self.block_shape = (n_layer, 2, block_size, n_kv, head_dim)
+        self.block_size = block_size
+        self.dtype = np.dtype(dtype)
+        self.block_nbytes = int(np.prod(self.block_shape)) * \
+            self.dtype.itemsize
+        nbytes = self.block_nbytes * num_blocks
+        self._seg_name = pool_segment_name(os.getpid(), uuid.uuid4().hex[:8])
+        # ShmObjectStore.create gives the O_EXCL + rollback discipline and
+        # capacity accounting for free; the pool stays "unsealed" (mutable)
+        # for the engine's whole life and is deleted at close().
+        self._store = ShmObjectStore(capacity_bytes=nbytes + 1)
+        view, handle = self._store.create(self._seg_name, nbytes)
+        self._view = view
+        self._mm = handle
+        self.pool = np.frombuffer(view, dtype=self.dtype).reshape(
+            (num_blocks,) + self.block_shape)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))  # guarded by: _lock
+        self._tables: Dict[str, List[int]] = {}                      # guarded by: _lock
+        self._fill: Dict[str, int] = {}                              # guarded by: _lock
+        self._ref: Dict[int, int] = {}                               # guarded by: _lock
+        self._closed = False                                         # guarded by: _lock
+
+    # ------------------------------------------------------------ allocation
+    def blocks_needed(self, n_tokens: int) -> int:
+        return max(1, -(-n_tokens // self.block_size))
+
+    def free_block_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def used_block_count(self) -> int:
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+    def can_alloc(self, n_blocks: int) -> bool:
+        with self._lock:
+            return len(self._free) >= n_blocks
+
+    def alloc_seq(self, seq_id: str, n_tokens: int) -> List[int]:
+        """Allocate blocks for ``n_tokens`` of context; table starts full
+        to ``n_tokens`` (prefill scatters into them immediately)."""
+        n = self.blocks_needed(n_tokens)
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id!r} already allocated")
+            if len(self._free) < n:
+                raise NoFreeBlocks(
+                    f"need {n} blocks, {len(self._free)} free")
+            blocks = [self._free.pop() for _ in range(n)]
+            for b in blocks:
+                self._ref[b] = 1
+            self._tables[seq_id] = blocks
+            self._fill[seq_id] = n_tokens
+        return blocks
+
+    def append_slot(self, seq_id: str) -> tuple:
+        """Reserve the next token slot for ``seq_id``.
+
+        Returns (block_id, offset_in_block, grew); grows the table by
+        one block at a block boundary (``grew`` True).  Raises
+        NoFreeBlocks under cache pressure — the scheduler's preemption
+        trigger.  A reservation whose decode step then fails must be
+        returned with :meth:`rollback_slot` or every later slot is off
+        by one."""
+        with self._lock:
+            fill = self._fill[seq_id]
+            table = self._tables[seq_id]
+            blk_i, off = divmod(fill, self.block_size)
+            grew = False
+            if blk_i == len(table):
+                if not self._free:
+                    raise NoFreeBlocks(f"pool exhausted growing {seq_id!r}")
+                b = self._free.pop()
+                self._ref[b] = 1
+                table.append(b)
+                grew = True
+            self._fill[seq_id] = fill + 1
+            return table[blk_i], off, grew
+
+    def rollback_slot(self, seq_id: str, grew: bool) -> None:
+        """Undo one :meth:`append_slot` reservation (failed decode step)."""
+        with self._lock:
+            if seq_id not in self._fill:
+                return                     # freed/preempted meanwhile
+            self._fill[seq_id] -= 1
+            if grew:
+                b = self._tables[seq_id].pop()
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    del self._ref[b]
+                    self._free.append(b)
+
+    def free_seq(self, seq_id: str) -> int:
+        """Release a sequence's blocks (refcounted); returns #freed."""
+        with self._lock:
+            blocks = self._tables.pop(seq_id, None)
+            self._fill.pop(seq_id, None)
+            if not blocks:
+                return 0
+            freed = 0
+            for b in blocks:
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    del self._ref[b]
+                    self._free.append(b)
+                    freed += 1
+            return freed
+
+    def fork_seq(self, seq_id: str, new_seq_id: str) -> None:
+        """Share a sequence's blocks with a new id (refcount bump) —
+        the prefix-sharing/export primitive."""
+        with self._lock:
+            blocks = list(self._tables[seq_id])
+            for b in blocks:
+                self._ref[b] += 1
+            self._tables[new_seq_id] = blocks
+            self._fill[new_seq_id] = self._fill[seq_id]
+
+    # ------------------------------------------------------------- accessors
+    def table(self, seq_id: str) -> List[int]:
+        with self._lock:
+            return list(self._tables[seq_id])
+
+    def fill(self, seq_id: str) -> int:
+        with self._lock:
+            return self._fill[seq_id]
+
+    def has_seq(self, seq_id: str) -> bool:
+        with self._lock:
+            return seq_id in self._tables
+
+    def seq_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._tables)
+
+    # ------------------------------------------------------- block transfer
+    def block_bytes(self, block_id: int) -> bytes:
+        """One block's contiguous bytes (the data-plane export unit)."""
+        return self.pool[block_id].tobytes()
+
+    def load_block(self, block_id: int, raw) -> None:
+        np.copyto(self.pool[block_id],
+                  np.frombuffer(raw, dtype=self.dtype).reshape(
+                      self.block_shape))
+
+    def scatter_prefill(self, seq_id: str, ks: np.ndarray,
+                        vs: np.ndarray, n_tokens: int) -> None:
+        """Write prefill KV (L, T_pad, KV, D) into the seq's blocks
+        (only the first ``n_tokens`` positions are real)."""
+        table = self.table(seq_id)
+        bs = self.block_size
+        for i, b in enumerate(table):
+            lo = i * bs
+            hi = min(n_tokens, lo + bs)
+            if hi <= lo:
+                break
+            self.pool[b, :, 0, :hi - lo] = ks[:, lo:hi]
+            self.pool[b, :, 1, :hi - lo] = vs[:, lo:hi]
+
+    def write_token(self, block_id: int, offset: int, k: np.ndarray,
+                    v: np.ndarray) -> None:
+        """Write one decoded token's (L, KV, D) K/V into its slot."""
+        self.pool[block_id, :, 0, offset] = k
+        self.pool[block_id, :, 1, offset] = v
+
+    # -------------------------------------------------------------- teardown
+    def close(self) -> None:
+        """Unmap and unlink the pool segment (idempotent)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.pool = None   # drop the ndarray ref before releasing its buffer
+        try:
+            self._view.release()
+        except (BufferError, ValueError):
+            pass
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass
+        self._store.delete_object(self._seg_name)
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @property
+    def segment_path(self) -> str:
+        return str(_seg_path(self._seg_name))

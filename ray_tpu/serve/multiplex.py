@@ -69,6 +69,15 @@ class _MultiplexWrapper:
         with self._global_lock:
             return list(self._models)
 
+    def pop_all(self) -> list:
+        """Drain the LRU (replica teardown): returns the loaded models;
+        the caller runs their unload hooks — kept sync-callable because
+        graceful drain runs outside the replica's asyncio loop."""
+        with self._global_lock:
+            models = list(self._models.values())
+            self._models.clear()
+            return models
+
     async def load(self, owner, model_id: Optional[str]) -> Any:
         if model_id is None:
             model_id = get_multiplexed_model_id()

@@ -146,6 +146,44 @@ CATALOG: Dict[str, dict] = {
                     "decision tick (equals num_replicas when autoscaling "
                     "is off)",
         emitted_by="serve controller"),
+    # --- serve.llm continuous-batching engine -------------------------------
+    "rtpu_llm_sequences": dict(
+        kind="gauge", tag_keys=("model", "state"),
+        description="Sequences inside an LLM engine by state "
+                    "(running = in the decode batch, waiting = queued "
+                    "for prefill admission, incl. preempted)",
+        emitted_by="llm replica"),
+    "rtpu_llm_kv_blocks": dict(
+        kind="gauge", tag_keys=("model", "state"),
+        description="Paged KV cache blocks by state (used | free) in "
+                    "an engine's shm block pool",
+        emitted_by="llm replica"),
+    "rtpu_llm_batch_occupancy": dict(
+        kind="gauge", tag_keys=("model",),
+        description="Decode batch occupancy: running sequences / "
+                    "max_num_seqs after the last scheduler iteration",
+        emitted_by="llm replica"),
+    "rtpu_llm_preemptions_total": dict(
+        kind="counter", tag_keys=("model",),
+        description="Sequences evicted under KV cache pressure "
+                    "(blocks freed, re-prefilled later)",
+        emitted_by="llm replica"),
+    "rtpu_llm_ttft_seconds": dict(
+        kind="histogram", tag_keys=("model",), buckets=LATENCY_BUCKETS,
+        description="Time to first token: request submission to the "
+                    "first sampled token (queueing + prefill)",
+        emitted_by="llm replica"),
+    "rtpu_llm_tpot_seconds": dict(
+        kind="histogram", tag_keys=("model",), buckets=LATENCY_BUCKETS,
+        description="Time per output token after the first (decode "
+                    "cadence), observed once per finished sequence",
+        emitted_by="llm replica"),
+    "rtpu_llm_tokens_total": dict(
+        kind="counter", tag_keys=("model", "phase"),
+        description="Tokens processed by an LLM engine: 'prefill' = "
+                    "prompt tokens prefilled, 'decode' = tokens "
+                    "generated by decode iterations",
+        emitted_by="llm replica"),
     # --- train --------------------------------------------------------------
     "rtpu_train_step_seconds": dict(
         kind="histogram", tag_keys=("rank",), buckets=LATENCY_BUCKETS,

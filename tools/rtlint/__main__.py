@@ -90,6 +90,13 @@ def run_pass(name: str) -> List[Finding]:
         out += check_guarded(load(priv / "shm_store.py"),
                              set(lw.SHM_STORE_LOCK_DAG),
                              lw.SHM_STORE_CV_ALIASES)
+        llm = REPO_ROOT / "ray_tpu" / "serve" / "llm"
+        out += check_guarded(load(llm / "kv_cache.py"),
+                             set(lw.LLM_KV_LOCK_DAG),
+                             lw.LLM_KV_CV_ALIASES)
+        out += check_guarded(load(llm / "engine.py"),
+                             set(lw.LLM_ENGINE_LOCK_DAG),
+                             lw.LLM_ENGINE_CV_ALIASES)
         return out
     if name == "wire":
         from tools.rtlint.wirecheck import check_wire, default_config
