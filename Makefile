@@ -3,7 +3,8 @@
 PY ?= python
 
 .PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
-	databench-quick servebench-quick llmbench-quick leakcheck
+	databench-quick servebench-quick llmbench-quick tracebench-quick \
+	leakcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -79,6 +80,15 @@ databench-quick:
 servebench-quick:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/serve_bench.py --quick \
 		--assert-sane --json benchmarks/results/servebench_ci.json \
+		--label ci
+
+# Tracing-overhead smoke (CI): serial task RTs with the always-on
+# observability layer (timeline + flight recorder + wire trace field at
+# default sampling) vs fully off, interleaved A/B in one process;
+# asserts <5% overhead and leaves a JSON artifact for the uploader.
+tracebench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/trace_bench.py --quick \
+		--assert-sane --json benchmarks/results/tracebench_ci.json \
 		--label ci
 
 # LLM serving smoke (CI): the continuous-batching engine vs the naive

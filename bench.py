@@ -19,6 +19,7 @@ single JSON object; everything else goes to stderr.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import time
@@ -110,9 +111,100 @@ def _delivered_matmul_tflops(jax, jnp) -> dict:
             "fused_pipelined": round(fused_pipelined, 1)}
 
 
-def main() -> None:
-    import os
+# Device-trace op classes for the overlap breakdown.  Fusion names in
+# XLA device traces carry the HLO op of their root: collectives are
+# all-reduce/all-gather/reduce-scatter/collective-permute (+ the jax
+# spellings psum/ppermute); everything else on a compute lane counts as
+# compute.
+_COLLECTIVE_PAT = ("all-reduce", "all-gather", "reduce-scatter",
+                   "all-to-all", "collective-permute", "ppermute", "psum")
 
+
+def _merged_busy_us(intervals) -> float:
+    """Total busy time of a set of (ts, dur) device events, overlaps
+    merged — the union length, not the sum."""
+    if not intervals:
+        return 0.0
+    ivs = sorted((ts, ts + dur) for ts, dur in intervals)
+    total = 0.0
+    cur_s, cur_e = ivs[0]
+    for s, e in ivs[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    return total + (cur_e - cur_s)
+
+
+def _overlap_breakdown(jax, step_once, steps: int = 3):
+    """Collective-vs-compute span accounting per train step (the ROADMAP
+    item-4 prerequisite): run ``steps`` steps under a jax device trace,
+    bucket device events into collective vs compute, and report per-step
+    busy time, the overlapped fraction, and the EXPOSED collective time
+    (collective busy that no compute hides) — the number the
+    overlap-scheduled step must drive to zero.
+
+    Only DEVICE-lane events count: jax's profiler writes host threads
+    (python / TSL TraceMe spans) into the same trace files, and a host
+    span covering the whole step would land in "compute" and make every
+    collective look hidden.  Lanes are identified by their
+    ``process_name`` metadata containing ``/device:``.  Best-effort:
+    returns None when the capture yields no device lanes (CPU smoke,
+    relay configs) — the headline metric is unaffected."""
+    import shutil
+    import tempfile
+
+    from ray_tpu.util.tracing import profile_event_lists
+
+    out_dir = tempfile.mkdtemp(prefix="rtpu_overlap_")
+    try:
+        try:
+            with jax.profiler.trace(out_dir):
+                for _ in range(steps):
+                    step_once()
+        except Exception:  # noqa: BLE001 - profiler unavailable
+            return None
+        coll, comp = [], []
+        for raw in profile_event_lists(out_dir):
+            dev_pids = {
+                e.get("pid") for e in raw
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "/device:" in str((e.get("args") or {}).get("name", ""))}
+            for e in raw:
+                if e.get("ph") != "X" or e.get("ts") is None \
+                        or e.get("pid") not in dev_pids:
+                    continue
+                name = str(e.get("name", "")).lower()
+                dur = float(e.get("dur", 0) or 0)
+                if not dur:
+                    continue
+                iv = (float(e["ts"]), dur)
+                if any(p in name for p in _COLLECTIVE_PAT):
+                    coll.append(iv)
+                else:
+                    comp.append(iv)
+        if not coll and not comp:
+            return None
+        coll_us = _merged_busy_us(coll)
+        comp_us = _merged_busy_us(comp)
+        both_us = _merged_busy_us(coll + comp)
+        overlapped_us = max(0.0, coll_us + comp_us - both_us)
+        exposed_us = coll_us - overlapped_us
+        return {
+            "steps": steps,
+            "compute_ms_per_step": round(comp_us / steps / 1e3, 3),
+            "collective_ms_per_step": round(coll_us / steps / 1e3, 3),
+            "exposed_collective_ms_per_step":
+                round(exposed_us / steps / 1e3, 3),
+            "overlap_frac":
+                round(overlapped_us / coll_us, 4) if coll_us else None,
+        }
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+
+def main() -> None:
     from ray_tpu._private.config import GLOBAL_CONFIG
     GLOBAL_CONFIG.apply_xla_cache_env(os.environ)
     import jax
@@ -175,6 +267,17 @@ def main() -> None:
     float(jax.device_get(m["loss"]))
     step_s = (time.perf_counter() - t0) / steps
 
+    # Overlap breakdown (ROADMAP item 4 prerequisite): where does the
+    # step's device time go — compute, collectives, and how much of the
+    # collective time is EXPOSED (unhidden by compute)?
+    _ostate = [state]
+
+    def _step_once():
+        _ostate[0], mm = prog.step_fn(_ostate[0], b)
+        float(jax.device_get(mm["loss"]))
+    overlap = _overlap_breakdown(jax, _step_once,
+                                 steps=3 if on_tpu else 2)
+
     tokens_per_step = batch * seq
     tok_s = tokens_per_step / step_s
     fpt = gpt2.flops_per_token(cfg, seq)
@@ -206,6 +309,7 @@ def main() -> None:
         "model_tflops": round(tok_s * fpt / 1e12, 1),
         "mfu_vs_delivered": round(tok_s * fpt / delivered_peak, 4)
         if delivered_peak else None,
+        "overlap_breakdown": overlap,
     }
     if on_tpu:
         # The BASELINE #5 flagship at its NAMED size: GPT-2-XL 1.5B,

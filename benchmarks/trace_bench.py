@@ -1,0 +1,175 @@
+"""Tracing-overhead microbench: serial task RTs with the observability
+layer on vs off.
+
+The tracing tentpole's contract is that the ALWAYS-ON configuration —
+timeline recording armed, flight recorder ring active, wire trace field
+negotiated, default head-based sampling — costs near zero on the
+control-plane hot path.  This bench measures exactly that, A/B in ONE
+process with interleaved phases (host noise hits both sides):
+
+- ``off``:  timeline_enabled=0, flight_recorder_enabled=0,
+            trace_sample_rate=0 — the pre-tracing configuration.
+- ``on``:   all defaults (the always-on configuration); no explicit
+            span is open, so per-task cost is the flight-recorder
+            record + the sampled-out fast paths.
+- ``traced``: every op runs inside an explicit ``tracing.trace`` root —
+            the 100%-sampled worst case (span emit per task), reported
+            for context, not bounded.
+
+``--assert-sane`` bounds ``on`` vs ``off`` overhead at <5% (min-of-N
+p50s per side; one full retry before failing — CI hosts are shared).
+
+Usage::
+
+    python benchmarks/trace_bench.py --quick --assert-sane \
+        --json benchmarks/results/tracebench_ci.json --label ci
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OVERHEAD_BOUND = 0.05
+
+_OFF_CFG = {"timeline_enabled": False, "flight_recorder_enabled": False,
+            "trace_sample_rate": 0.0}
+_ON_CFG = {"timeline_enabled": True, "flight_recorder_enabled": True,
+           "trace_sample_rate": 0.01}
+
+
+def _measure_phase(cfg: dict, ops: int, traced: bool = False) -> dict:
+    """One fresh cluster; returns the serial submit+get floor (min) and
+    p50 in µs.  The FLOOR is the A/B statistic: a fixed per-op cost
+    shifts the fastest op as much as the median, but the fastest op is
+    immune to the scheduler noise that dominates shared CI hosts (the
+    p50 swings ±50% across phases there; the floor is stable)."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    ray_tpu.init(num_cpus=2, _system_config=cfg)
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        for _ in range(10):             # warm the worker + lease cache
+            ray_tpu.get(f.remote(), timeout=60)
+        samples: List[float] = []
+
+        def run_ops() -> None:
+            for _ in range(ops):
+                t0 = time.perf_counter()
+                ray_tpu.get(f.remote(), timeout=60)
+                samples.append(time.perf_counter() - t0)
+
+        if traced:
+            with tracing.trace("trace_bench"):
+                run_ops()
+        else:
+            run_ops()
+        samples.sort()
+        return {"floor": samples[0] * 1e6,
+                "p50": samples[len(samples) // 2] * 1e6}
+    finally:
+        ray_tpu.shutdown()
+
+
+def _run_sides(ops: int, repeat: int) -> Dict[str, dict]:
+    """Interleaved best-of-N (per-statistic min): off / on alternate so
+    host-load drift lands on both sides."""
+    best: Dict[str, dict] = {
+        "off": {"floor": float("inf"), "p50": float("inf")},
+        "on": {"floor": float("inf"), "p50": float("inf")}}
+    for _ in range(repeat):
+        for side, cfg in (("off", _OFF_CFG), ("on", _ON_CFG)):
+            got = _measure_phase(cfg, ops)
+            best[side] = {k: min(best[side][k], got[k]) for k in got}
+    return best
+
+
+def run(quick: bool = False) -> dict:
+    # many SHORT interleaved phases beat few long ones: the shared
+    # host's load drifts on a seconds scale, and the floor statistic
+    # only needs each side to catch ONE quiet phase
+    ops = 120 if quick else 200
+    repeat = 3 if quick else 6
+    # throwaway phase: the process's FIRST cluster boot pays one-time
+    # costs (imports, page cache, XLA probe) that would otherwise land
+    # entirely on whichever side runs first
+    _measure_phase(_OFF_CFG, max(30, ops // 5))
+    best = _run_sides(ops, repeat)
+    overhead = best["on"]["floor"] / best["off"]["floor"] - 1.0
+    if overhead > OVERHEAD_BOUND:
+        # shared-host hiccup on one side: one full interleaved retry
+        # before declaring a regression
+        again = _run_sides(ops, repeat)
+        for side in best:
+            best[side] = {k: min(best[side][k], again[side][k])
+                          for k in best[side]}
+        overhead = best["on"]["floor"] / best["off"]["floor"] - 1.0
+    traced = _measure_phase(_ON_CFG, max(50, ops // 3), traced=True)
+    out = {
+        "ops": ops,
+        "off_floor_us": round(best["off"]["floor"], 1),
+        "on_floor_us": round(best["on"]["floor"], 1),
+        "off_p50_us": round(best["off"]["p50"], 1),
+        "on_p50_us": round(best["on"]["p50"], 1),
+        "overhead_frac": round(overhead, 4),
+        "traced_floor_us": round(traced["floor"], 1),
+        "traced_overhead_frac":
+            round(traced["floor"] / best["off"]["floor"] - 1.0, 4),
+        "bound": OVERHEAD_BOUND,
+    }
+    print(f"serial RT floor: off={out['off_floor_us']}us "
+          f"on={out['on_floor_us']}us "
+          f"({100 * out['overhead_frac']:+.2f}%)  "
+          f"traced={out['traced_floor_us']}us "
+          f"({100 * out['traced_overhead_frac']:+.2f}%)  "
+          f"[p50 off={out['off_p50_us']} on={out['on_p50_us']}]")
+    return out
+
+
+def assert_sane(res: dict) -> None:
+    assert res["off_floor_us"] > 0 and res["on_floor_us"] > 0, res
+    assert res["overhead_frac"] < OVERHEAD_BOUND, (
+        f"always-on tracing overhead {100 * res['overhead_frac']:.2f}% "
+        f"exceeds the {100 * OVERHEAD_BOUND:.0f}% bound "
+        f"(floor off={res['off_floor_us']}us on={res['on_floor_us']}us)")
+    print("trace_bench --assert-sane: OK")
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", default=None, metavar="PATH")
+    ap.add_argument("--label", default=None)
+    ap.add_argument("--assert-sane", action="store_true")
+    args = ap.parse_args(argv)
+    res = run(quick=args.quick)
+    if args.json:
+        doc = {}
+        if os.path.exists(args.json):
+            try:
+                with open(args.json) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = {}
+        doc[args.label or "run"] = res
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"wrote {args.json}")
+    if args.assert_sane:
+        assert_sane(res)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -269,9 +269,17 @@ def available_resources() -> dict:
     return _worker_mod.global_worker().rpc("cluster_resources")["available"]
 
 
-def timeline(filename: Optional[str] = None) -> List[dict]:
-    """Chrome-trace events (reference: ``ray timeline``, SURVEY.md §5.1)."""
+def timeline(filename: Optional[str] = None,
+             trace_id: Optional[str] = None) -> List[dict]:
+    """Chrome-trace events (reference: ``ray timeline``, SURVEY.md §5.1).
+
+    With ``trace_id``, returns only that request's causal tree — host
+    spans across every process plus the device rows captured under it
+    (``util/trace_assembly.py``; CLI: ``ray_tpu trace <trace_id>``)."""
     events = _worker_mod.global_worker().rpc("timeline")["events"]
+    if trace_id is not None:
+        from ray_tpu.util import trace_assembly
+        events = trace_assembly.trace_events(events, trace_id)
     if filename:
         import json
         with open(filename, "w") as f:

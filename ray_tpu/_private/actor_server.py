@@ -210,6 +210,14 @@ class ActorServer:
         try:
             try:
                 self._observe_call(msg, msg.pop("_exec_t0", None))
+                actx = msg.pop("_span_ctx", None)
+                at0 = msg.pop("_span_t0", None)
+                if actx is not None and at0 is not None:
+                    tracing.emit_ctx_span(
+                        actx,
+                        f"{self.spec.get('class_name', 'Actor')}."
+                        f"{msg.get('method', '?')}",
+                        at0, time.time() - at0, cat="actor_task")
                 if err is None:
                     try:
                         results = w._store_results(return_ids, value,
@@ -271,6 +279,12 @@ class ActorServer:
             except (OSError, EOFError):
                 pass  # control plane hiccup: at-least-once fallback
         t_exec = time.monotonic()
+        from ray_tpu._private import flight_recorder
+        if flight_recorder.enabled():
+            flight_recorder.record(
+                "actor_call",
+                f"{self.spec.get('class_name', 'Actor')}."
+                f"{msg.get('method', '?')}")
         try:
             args, kwargs = w._unpack_args(msg)
             method_name = msg["method"]
@@ -279,9 +293,26 @@ class ActorServer:
                 method = getattr(self.instance, method_name, None)
                 if method is not None and inspect.iscoroutinefunction(method):
                     msg["_exec_t0"] = t_exec
-                    asyncio.run_coroutine_threadsafe(
-                        self._run_async_call(method, args, kwargs, conn, msg),
-                        self._loop)
+                    # span context flows into the coroutine: adopt the
+                    # child span on THIS thread — run_coroutine_threadsafe
+                    # captures the caller's contextvars — and stash it on
+                    # the msg so _complete_async_call emits the event
+                    aspan = tracing.SpanContext.from_dict(
+                        msg.get("trace_ctx"))
+                    tok = None
+                    if aspan is not None:
+                        ctx = tracing.child_span(aspan, method_name)
+                        msg["_span_ctx"] = ctx
+                        msg["_span_t0"] = time.time()
+                        tok = tracing.adopt(ctx)
+                    try:
+                        asyncio.run_coroutine_threadsafe(
+                            self._run_async_call(method, args, kwargs,
+                                                 conn, msg),
+                            self._loop)
+                    finally:
+                        if tok is not None:
+                            tracing.restore(tok)
                     # executor thread freed; the reply obligation moves to
                     # the event loop (_run_async_call → _complete_async_call
                     # replies or tears the conn down on every path)
@@ -290,24 +321,22 @@ class ActorServer:
             span = tracing.SpanContext.from_dict(msg.get("trace_ctx"))
             if span is not None:
                 # child span per method call; timeline events link back to
-                # the caller's span (reference: ray.util.tracing)
+                # the caller's span (reference: ray.util.tracing).  The
+                # event carries the SAME span id the method body saw, so
+                # spans opened inside (engine submits, nested calls)
+                # parent correctly; rows use the stable per-thread tid +
+                # thread_name metadata (emit_ctx_span).
                 t0 = time.time()
-                tracing._set_span(tracing.SpanContext(
-                    span.trace_id, tracing._new_id(), span.span_id,
-                    method_name))
+                tracing._set_span(tracing.child_span(span, method_name))
             try:
                 value = self._run_method(method_name, args, kwargs)
             finally:
                 if span is not None:
-                    cur = tracing.current_span()
-                    tracing._emit([{
-                        "name": f"{self.spec.get('class_name', 'Actor')}."
-                                f"{method_name}",
-                        "cat": "actor_task", "ph": "X",
-                        "pid": w.node_id, "tid": os.getpid(),
-                        "ts": t0 * 1e6,
-                        "dur": (time.time() - t0) * 1e6,
-                        "args": cur.to_dict() if cur else None}])
+                    tracing.emit_ctx_span(
+                        tracing.current_span(),
+                        f"{self.spec.get('class_name', 'Actor')}."
+                        f"{method_name}",
+                        t0, time.time() - t0, cat="actor_task")
                     tracing._set_span(None)
             results = w._store_results(return_ids, value, num_returns)
             ok = True

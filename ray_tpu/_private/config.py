@@ -173,6 +173,22 @@ _FLAG_DEFS = [
           "Background metrics publisher period (jittered per cycle; "
           "clamped to >= 1s so publishing stays off the task hot path)."),
     _flag("timeline_enabled", True, "Record profile events for `ray_tpu timeline`."),
+    _flag("trace_sample_rate", 0.01,
+          "Head-based sampling rate for automatically-rooted request "
+          "traces (e.g. one Serve HTTP request = one candidate root). "
+          "Explicit tracing.trace() spans are always sampled; children "
+          "inherit the root's decision, so a sampled-out request costs "
+          "one random() call cluster-wide.  0 disables auto roots."),
+    _flag("flight_recorder_enabled", True,
+          "Always-on per-process flight recorder: a fixed-size mmap ring "
+          "buffer in the session dir recording recent wire frames, "
+          "scheduler decisions, lock-watchdog waits, and engine "
+          "iterations.  Crash-surviving by construction (the ring file "
+          "outlives a SIGKILLed process); read it with "
+          "`ray_tpu debug dump`."),
+    _flag("flight_recorder_slots", 2048,
+          "Ring-buffer capacity (records) per process; older records are "
+          "overwritten in place (fixed memory, no growth)."),
 ]
 
 _DEFS: Dict[str, _FlagDef] = {d.name: d for d in _FLAG_DEFS}

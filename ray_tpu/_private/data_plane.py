@@ -318,6 +318,7 @@ class DataPlaneServer:
                                                   tags={"dir": "out"})
 
     def _serve(self, conn) -> None:
+        from ray_tpu._private import flight_recorder
         protocol.tune_data_socket(conn)
         with self._stats_lock:
             self.conns_accepted += 1
@@ -329,6 +330,11 @@ class DataPlaneServer:
                 except (EOFError, OSError):
                     return
                 op = msg.get("op")
+                if flight_recorder.enabled():
+                    flight_recorder.record(
+                        "data_frame",
+                        f"{op} {str(msg.get('object_id', ''))[:20]} "
+                        f"off={msg.get('offset', 0)}")
                 if op == "__proto_hello__":
                     try:
                         conn.send({"proto": wire.negotiate_version(
@@ -396,9 +402,14 @@ class DataPlaneServer:
         framing state (mid-stream socket/read failure) — the caller
         must close it.  Pre-stream misses reply {error} and keep the
         conn pooled."""
+        from ray_tpu.util import tracing
         offset = int(msg.get("offset", 0) or 0)
         length = msg.get("length")
         raw = bool(msg.get("raw", True))
+        # wire-propagated span (DATA_PROTO_TRACE peers only): the serve
+        # leg becomes a child of the puller's span, tagged bytes/path
+        span = tracing.extract_wire_trace(msg)
+        t0 = time.time()
         try:
             fd, size = self._fd_cache.checkout(msg.get("object_id", ""))
         except OSError:
@@ -425,6 +436,12 @@ class DataPlaneServer:
                     protocol.send_msg_writev(
                         conn, {"size": size, "len": n, "data": data})
                     self._count_served(n, obj=offset == 0)
+                    if span is not None:
+                        tracing.emit_span(
+                            "data.serve_stream", span, t0,
+                            time.time() - t0, cat="data", bytes=n,
+                            offset=offset, path="inline",
+                            object_id=msg.get("object_id", ""))
                     return True
                 conn.send({"size": size, "len": n})
                 frame = max(64 * 1024, GLOBAL_CONFIG.data_stream_frame_bytes)
@@ -441,6 +458,12 @@ class DataPlaneServer:
                 pass
         if ok:
             self._count_served(n, obj=offset == 0)
+            if span is not None:
+                tracing.emit_span(
+                    "data.serve_stream", span, t0, time.time() - t0,
+                    cat="data", bytes=n, offset=offset,
+                    path="raw" if raw else "relay",
+                    object_id=msg.get("object_id", ""))
         return ok
 
     def _stream_raw(self, conn, in_fd: int, offset: int, n: int,
@@ -831,12 +854,32 @@ class DataPlanePool:
         holders get the chunk protocol — still over a pooled conn, so
         even legacy pulls stop paying dial+HMAC per object."""
         t0 = time.monotonic()
-        buf = self._pull(addr, object_id, size)
+        t0w = time.time()
+        # the pull's child span is created BEFORE the transfer and
+        # adopted for its duration, so the per-stream fetch_stream
+        # requests carry ITS id — the holder's data.serve_stream spans
+        # then nest under this data.pull node in the assembled tree
+        from ray_tpu.util import tracing
+        span = tracing.current_span()
+        pull_ctx = tok = None
+        if span is not None and span.sampled:
+            pull_ctx = tracing.child_span(span, "data.pull")
+            tok = tracing.adopt(pull_ctx)
+        try:
+            buf = self._pull(addr, object_id, size)
+        finally:
+            if tok is not None:
+                tracing.restore(tok)
         if GLOBAL_CONFIG.metrics_enabled:
             mcat.get("rtpu_data_pull_seconds").observe(
                 time.monotonic() - t0, tags={"path": "direct"})
             mcat.get("rtpu_data_bytes_total").inc(len(buf),
                                                   tags={"dir": "in"})
+        if pull_ctx is not None:
+            tracing.emit_ctx_span(pull_ctx, "data.pull", t0w,
+                                  time.monotonic() - t0, cat="data",
+                                  bytes=len(buf), path="direct",
+                                  object_id=object_id)
         return buf
 
     def _pull(self, addr: str, object_id: str,
@@ -885,8 +928,12 @@ class DataPlanePool:
         return buf
 
     def _pull_stream(self, pc: _PoolConn, object_id: str):
-        pc.conn.send({"op": "fetch_stream", "object_id": object_id,
-                      "offset": 0, "length": -1, "raw": pc.raw})
+        msg = {"op": "fetch_stream", "object_id": object_id,
+               "offset": 0, "length": -1, "raw": pc.raw}
+        if pc.proto >= wire.DATA_PROTO_TRACE:
+            from ray_tpu.util import tracing
+            tracing.attach_wire_trace(msg)
+        pc.conn.send(msg)
         n, inline = self._read_stream_ack(pc, object_id, expect=None)
         if inline is not None:
             return bytearray(inline)
@@ -904,6 +951,10 @@ class DataPlanePool:
         bounds = [(i * base, base if i < k - 1 else size - (k - 1) * base)
                   for i in range(k)]
         errors: List[BaseException] = []
+        # span context captured HERE: stripe threads are fresh threads,
+        # the context variable does not follow them
+        from ray_tpu.util import tracing
+        ctx = tracing.current_span()
 
         def run(off: int, ln: int, pc: Optional[_PoolConn]) -> None:
             mine = pc is None
@@ -915,7 +966,7 @@ class DataPlanePool:
                     # rtlint: resource-leak-ok(mine-correlated settle)
                     pc = self.acquire(addr)
                 self._stream_range(pc, object_id, mv[off:off + ln],
-                                   off, ln)
+                                   off, ln, ctx=ctx)
             except BaseException as e:  # noqa: BLE001 - joined below
                 errors.append(e)
                 if mine and pc is not None:
@@ -937,9 +988,14 @@ class DataPlanePool:
         return buf
 
     def _stream_range(self, pc: _PoolConn, object_id: str,
-                      view: memoryview, offset: int, length: int) -> None:
-        pc.conn.send({"op": "fetch_stream", "object_id": object_id,
-                      "offset": offset, "length": length, "raw": pc.raw})
+                      view: memoryview, offset: int, length: int,
+                      ctx=None) -> None:
+        msg = {"op": "fetch_stream", "object_id": object_id,
+               "offset": offset, "length": length, "raw": pc.raw}
+        if pc.proto >= wire.DATA_PROTO_TRACE:
+            from ray_tpu.util import tracing
+            tracing.attach_wire_trace(msg, ctx=ctx)
+        pc.conn.send(msg)
         n, inline = self._read_stream_ack(pc, object_id, expect=length)
         if inline is not None:
             view[:n] = inline

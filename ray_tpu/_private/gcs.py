@@ -211,6 +211,11 @@ class GcsServer:
         from ray_tpu._private import resource_sanitizer
         resource_sanitizer.maybe_install()
         self.session = session
+        # Flight recorder (DESIGN.md §4h): crash-surviving mmap ring in
+        # the session dir recording recent frames / dispatch decisions;
+        # installed before any serve thread so nothing escapes it.
+        from ray_tpu._private import flight_recorder
+        flight_recorder.maybe_install(session.path, "gcs")
         self.store = ShmObjectStore(spill_dir=str(session.spill_dir))
         # Native C++ slab store: the small-object data plane (workers attach
         # and read/write directly; the GCS owns lifecycle + refcount deletes).
@@ -1048,12 +1053,29 @@ class GcsServer:
         submit->dispatch wait (rtpu_task_queue_seconds).  pop: a retried
         or resubmitted spec re-enters the queue and re-measures."""
         t = spec.pop("_enqueued_at", None)
-        if t is None or not GLOBAL_CONFIG.metrics_enabled:
+        if t is None:
             return
-        mcat.get("rtpu_task_queue_seconds").observe(
-            time.monotonic() - t,
-            tags={"name": spec.get("name") or spec.get("class_name")
-                  or "task"})
+        wait = time.monotonic() - t
+        name = spec.get("name") or spec.get("class_name") or "task"
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_task_queue_seconds").observe(
+                wait, tags={"name": name})
+        tc = spec.get("trace_ctx")
+        if tc and GLOBAL_CONFIG.timeline_enabled:
+            # GCS leg of the request tree: one span for the scheduler
+            # queue wait (submit -> dispatch), child of the submitter's
+            # span, on the dedicated "gcs" timeline row.  Appended to
+            # the event buffer directly — this runs under self.lock and
+            # lock -> _events_lock is a legal DAG edge; an RPC here
+            # would be blocking work under the global lock.
+            from ray_tpu.util import tracing as _tracing
+            ev = _tracing.span_event(
+                f"sched:{name}", _tracing.SpanContext.from_dict(tc),
+                t0=time.time() - wait, dur=wait, cat="sched",
+                pid="gcs", tid=0, task_id=spec.get("task_id"))
+            if ev is not None:
+                with self._events_lock:
+                    self.events.append(ev)
 
     def _pop_pending(self) -> dict:
         spec = self.pending_tasks.popleft()
@@ -1257,6 +1279,12 @@ class GcsServer:
                         extra["_dseq"] = worker.dseq
                         queued.append(extra)
                     worker.pipeline.extend(queued)
+                from ray_tpu._private import flight_recorder
+                if flight_recorder.enabled():
+                    flight_recorder.record(
+                        "dispatch",
+                        f"{spec['task_id'][:16]}->{worker.worker_id[:8]} "
+                        f"{kind} queued={len(queued)}")
                 if not worker.push({"kind": kind, "spec": spec,
                                     "dseq": worker.dseq,
                                     "queued": queued}):
@@ -1537,8 +1565,9 @@ class GcsServer:
                                    self._serve_conn, "gcs-serve-conn")
 
     def _serve_conn(self, conn) -> None:
-        from ray_tpu._private import wire
+        from ray_tpu._private import flight_recorder, wire
         from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu.util import tracing as _tracing
         client_id: Optional[str] = None
         ver = 0  # negotiated wire version for THIS connection
         # Codec mirroring: a peer that sends rtmsg frames may not speak
@@ -1569,6 +1598,16 @@ class GcsServer:
                     break
                 kind = msg.get("kind")
                 rid = msg.get("rid")
+                if flight_recorder.enabled():
+                    flight_recorder.record(
+                        "frame", f"{kind} rid={rid} "
+                                 f"client={str(client_id)[:8]}")
+                # wire-propagated span context: pop the optional trace
+                # field BEFORE any dispatch path (handlers never see an
+                # alien key); adopted only around the dispatch below so
+                # it cannot leak onto this thread's next frame.  The
+                # field only arrives on >= PROTO_TRACE conns.
+                _ctx = _tracing.extract_wire_trace(msg)
                 if rid is None and kind in wire.REF_KINDS and \
                         (ver > 0 or GLOBAL_CONFIG.proto_min_version == 0):
                     # (legacy peers on a version-fenced server fall
@@ -1644,7 +1683,14 @@ class GcsServer:
                         continue
                 reply = None
                 try:
-                    resp = self._dispatch(kind, msg)
+                    if _ctx is None:
+                        resp = self._dispatch(kind, msg)
+                    else:
+                        _tok = _tracing.adopt(_ctx)
+                        try:
+                            resp = self._dispatch(kind, msg)
+                        finally:
+                            _tracing.restore(_tok)
                     reply = {"error": None, **(resp or {})}
                 except Exception as e:  # noqa: BLE001 - report to caller
                     try:
@@ -3419,6 +3465,15 @@ class GcsServer:
                     pass
         return {"stacks": dict(collected), "expected": len(targets)}
 
+    def _h_debug_dump(self, msg: dict) -> dict:
+        """Flight-recorder dump for every process of this session
+        (`ray_tpu debug dump`).  Rings are shared-mmap files in the
+        session dir, so dead (SIGKILLed) processes' recent frames read
+        exactly like live ones — no cooperation needed."""
+        from ray_tpu._private import flight_recorder
+        return {"procs": flight_recorder.collect(
+            self.session.path, tail=int(msg.get("tail", 200)))}
+
     def _h_ping(self, msg: dict) -> dict:
         return {"pong": True, "time": time.time()}
 
@@ -3459,6 +3514,10 @@ class GcsServer:
         self.store.shutdown()
         if self.slab is not None:
             self.slab.close()
+        # discharge the flight recorder's mmap (the ring FILE stays —
+        # it is the crash artifact); must precede the leak assert below
+        from ray_tpu._private import flight_recorder
+        flight_recorder.close()
         # leak oracle: a CLEAN head shutdown must leave zero net
         # tracked resources (the driver's Worker.shutdown ran first —
         # __init__.shutdown() orders worker before head)

@@ -221,9 +221,22 @@ class WatchdogLock:
         self.name = name
         self._state = state
 
+    # Contended acquires above this land in the flight recorder: a
+    # post-mortem ring then shows WHICH lock the process was starving
+    # on in its final seconds (DESIGN.md §4h).
+    SLOW_WAIT_S = 0.05
+
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         self._state.on_acquire(self.name)
+        import time as _time
+        t0 = _time.monotonic()
         got = self._inner.acquire(blocking, timeout)
+        waited = _time.monotonic() - t0
+        if waited > self.SLOW_WAIT_S:
+            from ray_tpu._private import flight_recorder
+            if flight_recorder.enabled():
+                flight_recorder.record(
+                    "lockwait", f"{self.name} {waited * 1e3:.1f}ms")
         if got:
             self._state.push(self.name)
         return got

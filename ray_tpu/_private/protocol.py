@@ -354,6 +354,12 @@ class RpcChannel:
         from ray_tpu._private import wire
         rid = next(self._rid_counter)
         msg = {"kind": kind, "rid": rid, **fields}
+        if self.version >= wire.PROTO_TRACE:
+            # wire-propagated span context (no-op unless the calling
+            # thread holds a sampled span): the server adopts it for the
+            # dispatch so its flight-recorder/timeline rows link back
+            from ray_tpu.util import tracing
+            tracing.attach_wire_trace(msg)
         with self._lock:
             wire.conn_send(self._conn, msg, self.version)
             while True:
@@ -367,9 +373,12 @@ class RpcChannel:
 
     def send_oneway(self, kind: str, **fields: Any) -> None:
         from ray_tpu._private import wire
+        msg = {"kind": kind, "rid": None, **fields}
+        if self.version >= wire.PROTO_TRACE:
+            from ray_tpu.util import tracing
+            tracing.attach_wire_trace(msg)
         with self._lock:
-            wire.conn_send(self._conn, {"kind": kind, "rid": None, **fields},
-                           self.version)
+            wire.conn_send(self._conn, msg, self.version)
 
     def close(self) -> None:
         try:

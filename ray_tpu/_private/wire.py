@@ -39,8 +39,18 @@ import struct
 from typing import Any, Tuple
 
 PROTO_MIN = 1   # framed, pickle codec only
-PROTO_MAX = 2   # framed, rtmsg control codec + pickle payload fallback
+PROTO_TRACE = 3  # understands the optional TRACE_FIELD on any frame
+PROTO_MAX = 3   # framed, rtmsg codec + pickle fallback + trace field
 _PICKLE_OPCODE = 0x80  # first byte of every pickle protocol>=2 stream
+
+# Optional span-context frame field (Dapper-style wire propagation):
+# ``msg[TRACE_FIELD] = [trace_id, span_id]`` — attached ONLY on
+# connections that negotiated >= PROTO_TRACE (control plane) or
+# >= DATA_PROTO_TRACE (data plane), so un-upgraded peers see
+# byte-identical frames.  The single writer/reader of this field is
+# ray_tpu/util/tracing.py (attach_wire_trace / extract_wire_trace);
+# rtlint's wire-trace rule rejects ad-hoc plumbing of the key.
+TRACE_FIELD = "trace"
 
 _CODEC_PICKLE = 0
 _CODEC_RTMSG = 1
@@ -257,7 +267,8 @@ def bulk_unpack_header(buf) -> Tuple[int, int]:
 # degrades to the v0 chunk ops; a legacy puller never sends the hello
 # and the server keeps speaking v0 to it.
 DATA_PROTO_MIN = 0   # request-per-chunk pickled dicts (seed protocol)
-DATA_PROTO_MAX = 1   # fetch_stream + bulk frames
+DATA_PROTO_TRACE = 2  # accepts the optional TRACE_FIELD on fetch_stream
+DATA_PROTO_MAX = 2   # fetch_stream + bulk frames + trace field
 
 _c_codec = None
 _c_codec_tried = False

@@ -274,6 +274,12 @@ class Worker:
         self.node_id = info["node_id"]
         if self._gcs_epoch is None:
             self._gcs_epoch = info.get("epoch")
+        if session is not None and not self.is_client:
+            # crash-surviving flight recorder (DESIGN.md §4h); in the
+            # head==driver process the GCS already installed one and
+            # this is a no-op (first installer of a session wins)
+            from ray_tpu._private import flight_recorder
+            flight_recorder.maybe_install(session.path, role)
         self._start_metrics_publisher()
 
     # ------------------------------------------------------ metrics publisher
@@ -640,10 +646,18 @@ class Worker:
                         FileNotFoundError):
                     pass  # unreachable holder: head relay below
         t0 = time.monotonic()
+        t0w = time.time()
         data = self._fetch_remote_wire(oid)
         if GLOBAL_CONFIG.metrics_enabled:
             mcat.get("rtpu_data_pull_seconds").observe(
                 time.monotonic() - t0, tags={"path": "relay"})
+        span = tracing.current_span()
+        if span is not None and span.sampled:
+            # relay-path leg of the request tree (the direct-pull span is
+            # emitted inside DataPlanePool.pull, bytes/path tagged there)
+            tracing.emit_span("data.pull", span, t0w,
+                              time.monotonic() - t0, cat="data",
+                              bytes=len(data), path="relay", object_id=oid)
         return data
 
     def _fetch_remote_wire(self, oid: str) -> memoryview:
@@ -1140,9 +1154,10 @@ class Worker:
             **fields,
         }
         span = tracing.current_span()
-        if span is not None:
+        if span is not None and span.sampled:
             # OTel-style propagation: the task's span will parent to this
-            # one in the timeline dump (reference: ray.util.tracing)
+            # one in the timeline dump (reference: ray.util.tracing).
+            # Head-based sampling: a sampled-out root propagates nothing.
             spec["trace_ctx"] = span.to_dict()
         # one-way submit: return ids are generated client-side, so there is
         # nothing to wait for — pipelined submissions instead of a control-
@@ -1458,7 +1473,9 @@ class Worker:
         msg = {"kind": "call", "call_id": call_id, "method": method,
                "return_ids": return_ids, "num_returns": num_returns,
                "_retries_left": max_task_retries,
-               "trace_ctx": span.to_dict() if span else None,
+               "trace_ctx": (span.to_dict()
+                             if span is not None and span.sampled
+                             else None),
                "arg_ledger": f"call:{call_id}" if hold else None, **fields}
         ch = self._actor_channel(actor_id, max_task_retries)
         with self._actor_chan_lock:
@@ -1497,6 +1514,13 @@ class Worker:
         # best-effort telemetry flush
         self._stop.set()
         self._final_metrics_flush()
+        from ray_tpu._private import flight_recorder
+        flight_recorder.record("shutdown", "clean worker shutdown")
+        if self._local_server() is None:
+            # pure worker/driver process: discharge the recorder mmap
+            # now.  In a head==driver process the GCS still serves after
+            # this worker closes — GcsServer.shutdown closes it.
+            flight_recorder.close()
         with self._actor_chan_lock:
             for ch in self._actor_channels.values():
                 ch.close()
@@ -1560,6 +1584,8 @@ class Worker:
         self._open_ctl_conn()
         self._exec_thread_id = threading.get_ident()
         from collections import deque as _deque
+
+        from ray_tpu._private import flight_recorder
         lookahead: "_deque" = _deque()  # frames pre-read by the OOB drain
         while not self._stop.is_set():
             if lookahead:
@@ -1579,6 +1605,13 @@ class Worker:
                         break
                     continue
             kind = msg.get("kind")
+            if flight_recorder.enabled():
+                # execute_task receipt is recorded by _execute_task's
+                # "exec" record itself (task id included) — recording
+                # the frame too would double the hot path's record cost
+                # for no extra forensics
+                if kind != "execute_task":
+                    flight_recorder.record("task_frame", str(kind))
             if kind == "execute_task":
                 dseq = msg.get("dseq")
                 self._execute_task(msg["spec"])
@@ -1844,6 +1877,12 @@ class Worker:
         self._current_spec = spec
         self.ctx.in_task = True
         self.ctx.task_id = spec["task_id"]
+        from ray_tpu._private import flight_recorder
+        if flight_recorder.enabled():
+            flight_recorder.record(
+                "exec", f"{spec.get('name', 'task')} "
+                        f"{spec['task_id'][:16]}")
+        done: dict = {}  # terminal frame (for the flight record below)
         parent_span = tracing.SpanContext.from_dict(spec.get("trace_ctx"))
         task_span = None
         if parent_span is not None:
@@ -1882,6 +1921,10 @@ class Worker:
             self._attach_timeline_event(done, spec, t0, task_span)
             self._send_event(done)
         finally:
+            if flight_recorder.enabled():
+                flight_recorder.record(
+                    "task_done", f"{spec['task_id'][:16]} "
+                                 f"{done.get('status', '?')}")
             self._restore_runtime_env(saved_env)
             self._current_spec = None
             self.ctx.in_task = False

@@ -63,12 +63,32 @@ static unsigned xorshift(unsigned* st) {
   return *st = x;
 }
 
-// one worker process: mixed ops until killed or deadline
-static int worker(const char* path, int role, unsigned seed, int seconds) {
-  rtpu_store* s = rtpu_store_open(path, kCap, kMaxObj, 0);
+// one worker process: mixed ops until killed or deadline.  ``gen`` is
+// the respawn generation: right after opening, the worker seals a tiny
+// heartbeat object ("hb<role>_<gen>") so the PARENT can observe that
+// this incarnation actually reached the store before arming the next
+// SIGKILL — a fixed kill cadence raced respawns on contended hosts
+// (the one PR-6 in-run flake: a victim killed before it finished
+// opening / while recovery was mid-flight).
+static int worker(const char* path, int role, unsigned seed, int seconds,
+                  int gen) {
+  rtpu_store* s = nullptr;
+  // bounded open retry: a respawn can land while robust-mutex recovery
+  // of its SIGKILLed predecessor is still in progress — transient, not
+  // a store-corruption verdict, so don't hard-exit rc=2 on it
+  for (int i = 0; i < 100 && !s; ++i) {
+    s = rtpu_store_open(path, kCap, kMaxObj, 0);
+    if (!s) usleep(50 * 1000);
+  }
   if (!s) return 2;
   char id[64];
   char buf[1 << 16];
+  {
+    char hb_id[64];
+    snprintf(hb_id, sizeof(hb_id), "hb%d_%d", role, gen);
+    char beat = 1;
+    rtpu_put(s, hb_id, &beat, 1);
+  }
   time_t end = time(nullptr) + seconds;
   unsigned st = seed | 1;
   while (time(nullptr) < end) {
@@ -190,11 +210,16 @@ int main(int argc, char** argv) {
   pid_t pids[kWorkers];
   for (int i = 0; i < kWorkers; ++i) {
     pid_t pid = fork();
-    if (pid == 0) _exit(worker(path, i, seed + i * 977, seconds));
+    if (pid == 0) _exit(worker(path, i, seed + i * 977, seconds, 0));
     pids[i] = pid;
   }
 
-  // chaos: SIGKILL a (re-forked) writer mid-run, every ~200ms
+  // chaos: SIGKILL a (re-forked) writer mid-run.  The kill re-arms on
+  // OBSERVED state, not a fixed cadence: after each respawn the parent
+  // waits (bounded) for the new incarnation's heartbeat object to
+  // appear in the store — killing is throttled by the machine's actual
+  // respawn+recovery rate, so a loaded host slows the chaos down
+  // instead of killing workers that never got to open the store.
   time_t end = time(nullptr) + seconds;
   unsigned st = seed;
   int kills = 0;
@@ -207,8 +232,15 @@ int main(int argc, char** argv) {
     waitpid(pids[victim], &status, 0);
     rtpu_reap_dead(s);  // what the GCS monitor does on worker death
     pid_t pid = fork();
-    if (pid == 0) _exit(worker(path, victim, seed + kills * 31, seconds));
+    if (pid == 0)
+      _exit(worker(path, victim, seed + kills * 31, seconds, kills));
     pids[victim] = pid;
+    char hb_id[64];
+    snprintf(hb_id, sizeof(hb_id), "hb%d_%d", victim, kills);
+    // bounded: LRU pressure can evict the heartbeat right after it
+    // seals — fall through after 2s rather than waiting forever
+    for (int i = 0; i < 200 && !rtpu_exists(s, hb_id); ++i)
+      usleep(10 * 1000);
   }
 
   int rc = 0;

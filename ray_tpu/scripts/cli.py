@@ -177,6 +177,65 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Assemble one request's cross-process trace tree
+    (``ray_tpu trace <trace_id> [-o out.json]``); with no id, list the
+    trace ids present in the timeline, most recent first."""
+    _connect(args.address)
+    import ray_tpu
+    from ray_tpu.util import trace_assembly
+    events = ray_tpu.timeline()
+    if not args.trace_id:
+        ids = trace_assembly.trace_ids(events)
+        if not ids:
+            print("no traces in the timeline (is trace_sample_rate 0, "
+                  "or nothing traced yet?)")
+            return 1
+        for t in ids[:20]:
+            print(t)
+        return 0
+    roots = trace_assembly.build_tree(events, args.trace_id)
+    if not roots:
+        print(f"no events for trace {args.trace_id!r}", file=sys.stderr)
+        return 1
+    print(trace_assembly.render_tree(roots))
+    if args.output:
+        doc = trace_assembly.to_chrome(events, args.trace_id)
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
+        print(f"wrote {len(doc['traceEvents'])} events to {args.output} "
+              f"(chrome://tracing / perfetto format)")
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """Flight-recorder access (``ray_tpu debug dump``): fetch every
+    process's ring — dead (SIGKILLed) processes included — via the GCS
+    ``debug_dump`` op."""
+    if args.action != "dump":
+        print(f"unknown debug action {args.action!r}", file=sys.stderr)
+        return 2
+    _connect(args.address)
+    from ray_tpu._private import worker as _worker
+    resp = _worker.global_worker().rpc("debug_dump", tail=args.tail)
+    procs = resp.get("procs", {})
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(procs, f, indent=2)
+        print(f"wrote flight-recorder dump of {len(procs)} process(es) "
+              f"to {args.output}")
+        return 0
+    for name, info in sorted(procs.items()):
+        state = "alive" if info.get("alive") else "DEAD"
+        print(f"===== {name} (pid={info.get('pid')}, {state}) =====")
+        for r in info.get("records", []):
+            ts = time.strftime("%H:%M:%S", time.localtime(r["ts"]))
+            frac = f"{r['ts'] % 1:.3f}"[1:]
+            print(f"  {ts}{frac} #{r['seq']:<8d} {r['kind']:<12s} "
+                  f"{r['detail']}")
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     from ray_tpu._private import ray_perf
     results = ray_perf.main(quick=args.quick, json_path=args.json,
@@ -250,6 +309,25 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--group-by", default="loc",
                             choices=("loc", "state"))
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("trace", help="assemble one request's "
+                        "cross-process trace tree (no id: list traces)")
+    sp.add_argument("trace_id", nargs="?", default=None)
+    sp.add_argument("--address", default=None)
+    sp.add_argument("-o", "--output", default=None,
+                    help="also write the Chrome/Perfetto trace JSON here")
+    sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("debug", help="debugging aids (flight recorder)")
+    sp.add_argument("action", choices=("dump",),
+                    help="dump: every process's flight-recorder ring "
+                         "(SIGKILLed processes included)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--tail", type=int, default=50,
+                    help="records per process (newest first kept)")
+    sp.add_argument("-o", "--output", default=None,
+                    help="write the full dump as JSON instead of text")
+    sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("list", help="list cluster entities")
     sp.add_argument("kind", choices=("nodes", "actors", "tasks", "objects",

@@ -128,6 +128,19 @@ class ProxyActor:
             self._respond(req, 404, b"no route matched", "text/plain")
             return
         prefix, dep_key = match
+        # one HTTP request = one candidate trace root, sampled head-based
+        # at trace_sample_rate; when sampled, the span context rides the
+        # actor call to the replica (router → handle_request) and on into
+        # the engine, so the whole proxy→router→replica→engine path is
+        # ONE tree.  Streaming requests keep the span open until the last
+        # chunk (the latency metric below still records TTFB).
+        from ray_tpu.util import tracing
+        with tracing.request_trace(f"serve.{dep_key}", http_path=path,
+                                   method=req.command):
+            self._handle_routed(req, path, prefix, dep_key)
+
+    def _handle_routed(self, req: BaseHTTPRequestHandler, path: str,
+                       prefix: str, dep_key: str) -> None:
         length = int(req.headers.get("Content-Length") or 0)
         body = req.rfile.read(length) if length else b""
         request = Request.from_parts(req.command, req.path,

@@ -38,6 +38,7 @@ from ray_tpu.serve.llm.model_runner import ModelRunner
 from ray_tpu.serve.llm.scheduler import (FAILED, FINISHED, IterationScheduler,
                                          Plan, Sequence)
 from ray_tpu.util import metrics_catalog as mcat
+from ray_tpu.util import tracing
 
 logger = rtlog.get("serve.llm.engine")
 
@@ -194,6 +195,12 @@ class LLMEngine:
         sampling = sampling or SamplingParams()
         seq = Sequence(seq_id=uuid.uuid4().hex[:12],
                        prompt=[int(t) for t in prompt], sampling=sampling)
+        # request tracing: the submitter's span (serve replica method /
+        # driver trace) parents every engine span for this sequence —
+        # captured HERE because the engine loop thread has no context
+        span = tracing.current_span()
+        if span is not None and span.sampled:
+            seq.trace = span
         q: queue.Queue = queue.Queue()
         with self._lock:
             # checked under the same lock shutdown() drains streams
@@ -265,6 +272,14 @@ class LLMEngine:
                          f"holds ({self.cache.num_blocks})")
         plan = self.sched.plan(self.cache.free_block_count(),
                                self.cache.blocks_needed)
+        from ray_tpu._private import flight_recorder
+        if flight_recorder.enabled() and \
+                (plan.prefill is not None or plan.decode):
+            flight_recorder.record(
+                "llm_step",
+                f"prefill={'1' if plan.prefill is not None else '0'} "
+                f"decode={len(plan.decode)} "
+                f"free={self.cache.free_block_count()}")
         if plan.prefill is not None:
             self._do_prefill(plan.prefill)
         elif plan.decode:
@@ -280,6 +295,7 @@ class LLMEngine:
             # plan() checked free blocks, but be safe: requeue
             self.sched.waiting.appendleft(seq)
             return
+        t0 = time.time()
         try:
             logits, ks, vs = self.runner.prefill(seq.prompt)
         except Exception as e:  # noqa: BLE001 - surface to the caller
@@ -297,6 +313,13 @@ class LLMEngine:
         # into the prompt) draws the same rng stream position as the
         # pressure-free run — seeded sampling stays reproducible
         tok = self.runner.sample(logits, seq.sampling, step=seq.generated)
+        if seq.trace is not None:
+            # per-sequence prefill span (explicit parent: the engine
+            # loop thread never holds the request's context variable)
+            tracing.emit_span("llm.prefill", seq.trace, t0,
+                              time.time() - t0, cat="llm",
+                              seq_id=seq.seq_id, tokens=len(seq.prompt),
+                              model=self.cfg.model)
         self.sched.start_running(seq)
         self._emit(seq, tok)
         self._count_tokens(len(seq.prompt), phase="prefill")
@@ -340,6 +363,7 @@ class LLMEngine:
             toks[i] = s.output[-1] if s.output else s.prompt[-1]
             poss[i] = s.ctx_len - 1
             lens[i] = s.ctx_len - 1
+        t0 = time.time()
         try:
             logits, ks, vs = self.runner.decode(toks, poss,
                                                 self.cache.pool, tables,
@@ -362,6 +386,14 @@ class LLMEngine:
                                      step=s.generated)
             self._emit(s, tok)
             self._maybe_finish(s)
+        traced = next((s for s in batch if s.trace is not None), None)
+        if traced is not None:
+            # one span per decode ITERATION (the batch is the unit of
+            # execution), parented to the first traced sequence in it
+            tracing.emit_span("llm.decode_step", traced.trace, t0,
+                              time.time() - t0, cat="llm",
+                              batch=len(batch), seq_id=traced.seq_id,
+                              model=self.cfg.model)
         self._count_tokens(len(batch), phase="decode")
 
     def _preempt_one(self, slots: Dict) -> bool:
@@ -374,6 +406,14 @@ class LLMEngine:
             return False
         logger.info("preempting %s under cache pressure (ctx=%d)",
                     victim.seq_id, victim.ctx_len)
+        from ray_tpu._private import flight_recorder
+        if flight_recorder.enabled():
+            flight_recorder.record(
+                "llm_preempt", f"{victim.seq_id} ctx={victim.ctx_len}")
+        if victim.trace is not None:
+            tracing.emit_span("llm.preempt", victim.trace, time.time(),
+                              0.0, cat="llm", seq_id=victim.seq_id,
+                              ctx=victim.ctx_len)
         self.cache.free_seq(victim.seq_id)
         slots.pop(victim.seq_id, None)
         self.sched.preempt(victim)
@@ -427,6 +467,10 @@ class LLMEngine:
             raise RuntimeError("engine shut down")
         seq_id = "pf_" + uuid.uuid4().hex[:12]
         prompt = [int(t) for t in prompt]
+        span = tracing.current_span()   # caller's thread context
+        if span is not None and not span.sampled:
+            span = None
+        t0 = time.time()
         self.cache.alloc_seq(seq_id, len(prompt))
         try:
             logits, ks, vs = self.runner.prefill(prompt)
@@ -455,11 +499,19 @@ class LLMEngine:
             for old in evict:
                 srv.delete_local(old)
             self._count_tokens(len(prompt), phase="prefill")
+            # the manifest carries the prefill-side SPAN (compact wire
+            # form): attach() on the decode engine parents its tree to
+            # it — the cross-process link between the two engines
+            ctx = tracing.emit_span(
+                "llm.prefill_remote", span, t0, time.time() - t0,
+                cat="llm", tokens=len(prompt), blocks=len(oids),
+                model=self.cfg.model) if span is not None else None
             return dict(addr=srv.advertise_addr, blocks=oids,
                         block_nbytes=self.cache.block_nbytes,
                         tokens=prompt, first_token=int(first),
                         model=self.cfg.model,
-                        block_size=self.cfg.block_size)
+                        block_size=self.cfg.block_size,
+                        trace=ctx.to_wire() if ctx is not None else None)
         except BaseException:
             if self._stop.is_set():
                 # a shutdown racing this call closed the cache/export
@@ -498,8 +550,22 @@ class LLMEngine:
         prompt = [int(t) for t in manifest["tokens"]]
         seq = Sequence(seq_id=uuid.uuid4().hex[:12], prompt=prompt,
                        sampling=sampling)
+        # link the decode-side tree to the prefill-side one: the
+        # manifest's span (prefill_remote on the other engine) parents
+        # the attach span, which parents this sequence's decode spans.
+        # Falls back to the caller's own span for untraced manifests.
+        parent = tracing.SpanContext.from_wire(manifest.get("trace"),
+                                               name="llm.prefill_remote")
+        if parent is None:
+            cur = tracing.current_span()
+            parent = cur if cur is not None and cur.sampled else None
+        t0 = time.time()
         self.cache.alloc_seq(seq.seq_id, len(prompt))
+        tok = tracing.adopt(parent) if parent is not None else None
         try:
+            # with the manifest span adopted, the block pulls' data.pull
+            # spans (and their server-side serve_stream children on the
+            # prefill engine) land inside the same tree
             table = self.cache.table(seq.seq_id)
             for b, oid in zip(table, manifest["blocks"]):
                 raw = pool.pull(manifest["addr"], oid,
@@ -510,6 +576,14 @@ class LLMEngine:
             if self._stop.is_set():
                 raise RuntimeError("engine shut down") from None
             raise
+        finally:
+            if tok is not None:
+                tracing.restore(tok)
+        if parent is not None:
+            seq.trace = tracing.emit_span(
+                "llm.attach", parent, t0, time.time() - t0, cat="llm",
+                seq_id=seq.seq_id, blocks=len(manifest["blocks"]),
+                tokens=len(prompt), model=self.cfg.model)
         q: queue.Queue = queue.Queue()
         released = False
         with self._lock:

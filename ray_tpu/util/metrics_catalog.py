@@ -184,6 +184,26 @@ CATALOG: Dict[str, dict] = {
                     "prompt tokens prefilled, 'decode' = tokens "
                     "generated by decode iterations",
         emitted_by="llm replica"),
+    # --- request tracing / flight recorder ----------------------------------
+    "rtpu_trace_spans_total": dict(
+        kind="counter", tag_keys=("cat",),
+        description="Timeline span events emitted by this process, by "
+                    "category (span | task | actor_task | sched | data | "
+                    "llm | serve | device)",
+        emitted_by="every traced process"),
+    "rtpu_trace_sampled_total": dict(
+        kind="counter", tag_keys=("decision",),
+        description="Head-based sampling decisions at auto-rooted "
+                    "request traces (sampled | dropped) — explicit "
+                    "tracing.trace() roots are always sampled and not "
+                    "counted here",
+        emitted_by="request-root processes (serve proxy)"),
+    "rtpu_trace_flight_records_total": dict(
+        kind="counter", tag_keys=(),
+        description="Flight-recorder ring records written by this "
+                    "process (amortized count; the ring itself is "
+                    "fixed-size and overwrites in place)",
+        emitted_by="every process with a flight recorder"),
     # --- train --------------------------------------------------------------
     "rtpu_train_step_seconds": dict(
         kind="histogram", tag_keys=("rank",), buckets=LATENCY_BUCKETS,

@@ -1,12 +1,24 @@
-"""rtlint fixture: POSITIVE wire server — handles alpha only, and its
-coalesced ref dispatch names a kind outside REF_KINDS."""
+"""rtlint fixture: POSITIVE wire server — handles alpha only, its
+coalesced ref dispatch names a kind outside REF_KINDS, and it plumbs
+the trace frame field by hand (literal key writes/reads) instead of
+through the tracing helpers."""
 
 
 class Server:
     def _h_alpha(self, msg):
+        ctx = msg.pop("trace", None)          # wire-trace: literal read
+        send({"kind": "alpha", "trace": ctx})  # wire-trace: literal key
+        return {}
+
+    def _h_attach(self, msg, ctx):
+        msg["trace"] = ctx                     # wire-trace: literal store
         return {}
 
     def _apply_ref_op_locked(self, kind, msg):
         if kind == "delta":
             return {}
         return None
+
+
+def send(msg):
+    return msg

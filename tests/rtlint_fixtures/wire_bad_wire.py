@@ -12,3 +12,5 @@ _HOT_KINDS = frozenset({
 REF_KINDS = frozenset({
     "gamma",
 })
+
+TRACE_FIELD = "trace"

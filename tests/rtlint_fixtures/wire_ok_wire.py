@@ -11,3 +11,5 @@ _HOT_KINDS = frozenset({
 REF_KINDS = frozenset({
     "gamma",
 })
+
+TRACE_FIELD = "trace"

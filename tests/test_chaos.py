@@ -130,6 +130,55 @@ def test_actor_state_reset_on_chaos_restart(ray_start_regular):
     assert n2 >= 1  # fresh instance restarts counting
 
 
+def test_chaos_flight_recorder_survives_sigkill(monkeypatch):
+    """Chaos × flight recorder under BOTH runtime oracles (lock watchdog
+    + resource sanitizer): a SIGKILLed worker's ring file keeps the
+    frames leading up to death and `ray_tpu debug dump` (the GCS
+    ``debug_dump`` op) collects it while the cluster keeps working."""
+    from ray_tpu._private import resource_sanitizer as rs
+
+    monkeypatch.setenv("RAY_TPU_RESOURCE_SANITIZER", "1")
+    monkeypatch.setenv("RAY_TPU_LOCK_WATCHDOG", "1")
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(max_retries=-1)
+        def work(i):
+            time.sleep(0.02)
+            return i * 2
+
+        assert ray_tpu.get([work.remote(i) for i in range(10)],
+                           timeout=120) == [i * 2 for i in range(10)]
+        victims = [w for w in state.list_workers()
+                   if w["state"] in ("busy", "actor", "idle")
+                   and w["pid"] != os.getpid()]
+        assert victims, "no worker to kill"
+        victim = victims[0]["pid"]
+        os.kill(victim, signal.SIGKILL)
+        # the dead worker's ring is collectable immediately (it is a
+        # shared-mmap file in the session dir — no cooperation needed)
+        from ray_tpu._private import worker as worker_mod
+        deadline = time.time() + 30 * time_scale()
+        dead = None
+        while dead is None and time.time() < deadline:
+            resp = worker_mod.global_worker().rpc("debug_dump", tail=300)
+            for info in resp["procs"].values():
+                if info["pid"] == victim and not info["alive"]:
+                    dead = info
+            time.sleep(0.2)
+        assert dead is not None, "SIGKILLed worker's ring not collected"
+        kinds = {r["kind"] for r in dead["records"]}
+        assert {"task_frame", "exec"} & kinds, kinds
+        # chaos must not take the cluster down
+        assert ray_tpu.get([work.remote(i) for i in range(10)],
+                           timeout=120 * time_scale()) == \
+            [i * 2 for i in range(10)]
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            rs.uninstall()
+
+
 def test_chaos_kill_leaves_no_net_resources(monkeypatch):
     """Chaos × leak oracle (DESIGN.md §4f): SIGKILLing a worker mid-
     workload must not leak head-side resources — the dead peer's

@@ -101,14 +101,26 @@ def _wire_cfg(tag: str) -> WireConfig:
         c_paths=[],
         dedup_path=FIX / f"wire_{tag}_client.py",
         ref_dispatch="_apply_ref_op_locked",
-        extra_handlers={})
+        extra_handlers={},
+        trace_scan_paths=[FIX / f"wire_{tag}_server.py"])
 
 
 def test_wire_flags_positive_fixture():
     found = check_wire(_wire_cfg("bad"))
     rules = _rules(found)
     assert {"wire-no-handler", "wire-no-producer", "wire-oneway-awaited",
-            "wire-ref-path", "wire-ref-arm"} <= rules, found
+            "wire-ref-path", "wire-ref-arm", "wire-trace"} <= rules, found
+    # all three hand-plumbing forms of the trace field are caught: the
+    # literal dict key, the subscript store, and the .pop() read
+    assert sum(1 for f in found if f.rule == "wire-trace") >= 3, found
+
+
+def test_wire_trace_missing_declaration():
+    """A wire module without TRACE_FIELD is itself a finding — the
+    field's name must have exactly one source of truth."""
+    cfg = _wire_cfg("bad")._replace(wire_path=FIX / "wire_bad_client.py")
+    found = [f for f in check_wire(cfg) if f.rule == "wire-trace"]
+    assert any("TRACE_FIELD" in f.message for f in found), found
 
 
 def test_wire_silent_on_negative_fixture():

@@ -1,0 +1,289 @@
+"""End-to-end request tracing: wire propagation, head-based sampling,
+and cross-process tree assembly (``ray_tpu trace`` /
+``util/trace_assembly.py``).
+
+The flagship test drives ONE traced request through every layer —
+driver root → task → nested task (with the GCS dispatch legs) → a ≥32M
+streamed data-plane pull (client AND holder spans) → an LLM token
+stream including a prefill→decode disaggregated handoff — and asserts
+the assembled tree's parent/child ids."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol, wire
+from ray_tpu.util import tracing, trace_assembly
+
+
+# ------------------------------------------------------ wire trace field
+def _capture_server(listener, server_max, seen):
+    """One-connection mini GCS mirroring _serve_conn's negotiation, with
+    a capped ceiling — records every raw decoded frame."""
+    conn = listener.accept()
+    ver = 0
+    try:
+        while True:
+            msg, _ = wire.conn_recv(conn)
+            kind, rid = msg.get("kind"), msg.get("rid")
+            if kind == "__proto_hello__":
+                ver = wire.negotiate_version(msg["versions"], 0,
+                                             server_max=server_max)
+                wire.conn_send(conn, {"rid": rid, "error": None,
+                                      "proto": ver}, ver)
+                continue
+            seen.append(dict(msg))
+            wire.conn_send(conn, {"rid": rid, "error": None}, ver)
+    except (EOFError, OSError):
+        pass
+
+
+@pytest.mark.parametrize("server_max,expect_field", [
+    (wire.PROTO_MAX, True),   # trace-aware peer: field rides the frame
+    (2, False),               # pre-trace peer: byte-identical old frames
+])
+def test_wire_trace_field_version_gated(tmp_path, server_max,
+                                        expect_field):
+    path = str(tmp_path / "sock")
+    listener = protocol.make_listener(path)
+    seen = []
+    t = threading.Thread(target=_capture_server,
+                         args=(listener, server_max, seen),
+                         daemon=True, name="mini-gcs")
+    t.start()
+    ch = protocol.RpcChannel(protocol.connect(path), negotiate=True)
+    with tracing.trace("root") as root:
+        ch.call("ping")
+    ch.close()
+    listener.close()
+    assert len(seen) == 1
+    if expect_field:
+        assert seen[0].get(wire.TRACE_FIELD) == \
+            [root.trace_id, root.span_id]
+    else:
+        assert wire.TRACE_FIELD not in seen[0]
+
+
+def test_wire_trace_field_absent_when_sampled_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("RTPU_TRACE_SAMPLE_RATE", "0.0")
+    path = str(tmp_path / "sock")
+    listener = protocol.make_listener(path)
+    seen = []
+    t = threading.Thread(target=_capture_server,
+                         args=(listener, wire.PROTO_MAX, seen),
+                         daemon=True, name="mini-gcs")
+    t.start()
+    ch = protocol.RpcChannel(protocol.connect(path), negotiate=True)
+    with tracing.request_trace("req") as ctx:
+        assert ctx is None  # sampled out at the root
+        ch.call("ping")
+    ch.close()
+    listener.close()
+    assert len(seen) == 1 and wire.TRACE_FIELD not in seen[0]
+
+
+# ----------------------------------------------------------- tree helpers
+def _collect(node, out):
+    out.append(node)
+    for c in node.children:
+        _collect(c, out)
+
+
+def _find(roots, name):
+    all_nodes = []
+    for r in roots:
+        _collect(r, all_nodes)
+    return [n for n in all_nodes if n.name == name]
+
+
+def _await_tree(trace_id, need_names, timeout=30):
+    """Poll the timeline until every name in ``need_names`` shows up in
+    the assembled tree for ``trace_id``."""
+    deadline = time.time() + timeout
+    roots = []
+    while time.time() < deadline:
+        events = ray_tpu.timeline()
+        roots = trace_assembly.build_tree(events, trace_id)
+        have = {n.name for r in roots
+                for n in (lambda out: (_collect(r, out), out)[1])([])}
+        if all(any(n.startswith(want) for n in have)
+               for want in need_names):
+            return roots
+        time.sleep(0.25)
+    return roots
+
+
+# ------------------------------------------------- the single-tree test
+def test_one_tree_spans_tasks_dataplane_and_llm_handoff(tmp_path):
+    """Driver root → task → nested task (+ GCS sched legs), a ≥32M
+    streamed pull (client data.pull + holder data.serve_stream), and an
+    LLM prefill→decode disaggregated handoff stream — ONE causal tree,
+    parent/child ids asserted at every hop."""
+    from ray_tpu._private.data_plane import (DataPlanePool,
+                                             DataPlaneServer, write_spool)
+    from ray_tpu.serve.llm.engine import LLMEngine
+    from test_serve_llm import tiny_cfg
+
+    ray_tpu.init(num_cpus=2)
+    server = None
+    pool = None
+    eng_a = eng_b = None
+    try:
+        @ray_tpu.remote
+        def child_task():
+            return 7
+
+        @ray_tpu.remote
+        def parent_task():
+            return ray_tpu.get(child_task.remote(), timeout=60)
+
+        spool = tmp_path / "spool"
+        spool.mkdir()
+        server = DataPlaneServer(str(spool), host="127.0.0.1",
+                                 advertise_host="127.0.0.1")
+        big = bytes(bytearray(33 * 1024 * 1024))          # >= 32M: stripes
+        write_spool(str(spool), "bigobj", big)
+        pool = DataPlanePool()
+
+        eng_a = LLMEngine(tiny_cfg())
+        eng_b = LLMEngine(tiny_cfg())
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+
+        with tracing.trace("root") as root:
+            assert ray_tpu.get(parent_task.remote(), timeout=60) == 7
+            got = pool.pull(server.advertise_addr, "bigobj",
+                            size=len(big))
+            assert len(got) == len(big)
+            manifest = eng_a.prefill_remote(prompt)
+            stream = eng_b.attach(manifest)
+            toks = stream.tokens()
+            assert len(toks) >= 2  # first_token + decoded continuation
+
+        roots = _await_tree(root.trace_id, [
+            "parent_task", "child_task", "sched:parent_task",
+            "data.pull", "data.serve_stream", "llm.prefill_remote",
+            "llm.attach", "llm.decode_step"])
+        # ---- ONE causally-linked tree
+        assert len(roots) == 1, \
+            [f"{r.name}({r.parent_id})" for r in roots]
+        tree = roots[0]
+        assert tree.name == "root"
+        rid = tree.span_id
+
+        def args(n):
+            return n.primary.get("args") or {}
+
+        # ---- driver → GCS dispatch → worker exec, parent ids exact
+        parent_nodes = _find(roots, "parent_task")
+        assert parent_nodes and args(parent_nodes[0])["parent_id"] == rid
+        pnode = parent_nodes[0]
+        child_nodes = _find(roots, "child_task")
+        assert child_nodes and \
+            args(child_nodes[0])["parent_id"] == pnode.span_id
+        sched_p = _find(roots, "sched:parent_task")
+        assert sched_p and args(sched_p[0])["parent_id"] == rid
+        sched_c = _find(roots, "sched:child_task")
+        assert sched_c and \
+            args(sched_c[0])["parent_id"] == pnode.span_id
+
+        # ---- >= 32M data-plane pull: client span under root, holder's
+        # serve spans under the pull span, byte counts tagged
+        pulls = [n for n in _find(roots, "data.pull")
+                 if args(n).get("object_id") == "bigobj"]
+        assert pulls and args(pulls[0])["parent_id"] == rid
+        assert args(pulls[0])["bytes"] >= 32 * 1024 * 1024
+        serves = [n for n in _find(roots, "data.serve_stream")
+                  if args(n).get("object_id") == "bigobj"]
+        assert serves, "holder-side serve spans missing"
+        assert all(args(s)["parent_id"] == pulls[0].span_id
+                   for s in serves)
+        assert sum(args(s)["bytes"] for s in serves) == len(big)
+
+        # ---- LLM handoff: prefill-side and decode-side trees LINKED
+        pre = _find(roots, "llm.prefill_remote")
+        assert pre and args(pre[0])["parent_id"] == rid
+        att = _find(roots, "llm.attach")
+        assert att and args(att[0])["parent_id"] == pre[0].span_id
+        decodes = _find(roots, "llm.decode_step")
+        assert decodes, "decode iteration spans missing"
+        assert all(args(d)["parent_id"] == att[0].span_id
+                   for d in decodes)
+        # the attach-side KV block pulls also sit inside the tree
+        kv_pulls = [n for n in _find(roots, "data.pull")
+                    if str(args(n).get("object_id", "")
+                           ).startswith("llmkv_")]
+        assert kv_pulls and all(
+            args(n)["parent_id"] == pre[0].span_id for n in kv_pulls)
+
+        # ---- timeline(trace_id=...) filters to exactly this tree
+        only = ray_tpu.timeline(trace_id=root.trace_id)
+        assert only and all(
+            (e.get("args") or {}).get("trace_id") == root.trace_id
+            for e in only if e.get("ph") != "M")
+        # ---- render + chrome doc (the `ray_tpu trace` surfaces)
+        text = trace_assembly.render_tree(roots)
+        assert "root" in text and "llm.attach" in text \
+            and "data.pull" in text
+        doc = trace_assembly.to_chrome(ray_tpu.timeline(), root.trace_id)
+        assert doc["metadata"]["trace_id"] == root.trace_id
+        assert len(doc["traceEvents"]) == len(only)
+        assert root.trace_id in trace_assembly.trace_ids(
+            ray_tpu.timeline())
+    finally:
+        for eng in (eng_a, eng_b):
+            if eng is not None:
+                eng.shutdown()
+        if pool is not None:
+            pool.close_all()
+        if server is not None:
+            server.stop()
+        ray_tpu.shutdown()
+
+
+# ----------------------------------------------------- sampling behavior
+def test_request_root_sampled_in_yields_full_tree(monkeypatch):
+    monkeypatch.setenv("RTPU_TRACE_SAMPLE_RATE", "1.0")
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        with tracing.request_trace("req") as ctx:
+            assert ctx is not None and ctx.sampled
+            assert ray_tpu.get(f.remote(), timeout=60) == 1
+        roots = _await_tree(ctx.trace_id, ["req", "f"])
+        assert len(roots) == 1 and roots[0].name == "req"
+        fs = _find(roots, "f")
+        assert fs and (fs[0].primary["args"]["parent_id"]
+                       == roots[0].span_id)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_request_root_sampled_out_emits_nothing(monkeypatch):
+    monkeypatch.setenv("RTPU_TRACE_SAMPLE_RATE", "0.0")
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        def traced_count():
+            return sum(1 for e in ray_tpu.timeline()
+                       if (e.get("args") or {}).get("trace_id"))
+
+        base = traced_count()
+        with tracing.request_trace("req") as ctx:
+            assert ctx is None  # head-based decision: sampled out
+            # children inherit the decision — nested explicit spans
+            # stay silent instead of rooting orphan trees
+            with tracing.trace("inner") as inner:
+                assert not inner.sampled
+                assert ray_tpu.get(f.remote(), timeout=60) == 1
+        time.sleep(1.0)
+        assert traced_count() == base
+    finally:
+        ray_tpu.shutdown()

@@ -89,6 +89,76 @@ def test_device_trace_merges_onto_timeline(ray_start_regular):
     assert host_span, "host span missing from the same dump"
 
 
+def test_thread_rows_distinct_and_named(ray_start_regular):
+    """Satellite: ``get_ident() % 100000`` could collide across threads;
+    spans must land on stable per-thread rows with Chrome thread_name
+    metadata so multi-threaded traces render on distinct, named rows."""
+    import threading
+
+    with tracing.trace("tid-root") as root:
+        def body(ctx, name):
+            tok = tracing.adopt(ctx)  # contexts don't cross threads
+            try:
+                with tracing.trace(name):
+                    time.sleep(0.01)
+            finally:
+                tracing.restore(tok)
+
+        ts = [threading.Thread(target=body, args=(root, f"side-{i}"),
+                               name=f"span-thread-{i}", daemon=True)
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    deadline = time.time() + 10
+    spans = []
+    while time.time() < deadline:
+        events = ray_tpu.timeline()
+        spans = [e for e in _spans(events, cat="span")
+                 if e["args"]["trace_id"] == root.trace_id
+                 and e["name"].startswith("side-")]
+        if len(spans) >= 2:
+            break
+        time.sleep(0.2)
+    assert len(spans) == 2, spans
+    tids = {e["tid"] for e in spans}
+    assert len(tids) == 2, f"thread rows collided: {spans}"
+    metas = [e for e in events if e.get("ph") == "M"
+             and e.get("name") == "thread_name"
+             and e.get("tid") in tids]
+    names = {m["args"]["name"] for m in metas}
+    assert {"span-thread-0", "span-thread-1"} <= names, metas
+
+
+def test_device_rebase_carries_counter_events():
+    """Satellite: ``profile_device`` dropped ``ph:"C"`` counter events
+    (memory/occupancy series) when re-basing device traces — they must
+    survive with rebased timestamps and merged span args."""
+    span = tracing.SpanContext("t" * 16, "s" * 16, None, "step")
+    raw = [
+        {"name": "fusion.1", "ph": "X", "ts": 1000.0, "dur": 50.0,
+         "tid": 3},
+        {"name": "hbm_in_use", "ph": "C", "ts": 1010.0,
+         "args": {"bytes": 12345}},
+        {"name": "flow", "ph": "s", "ts": 1020.0},   # still dropped
+        {"name": "no_ts", "ph": "C"},                # unanchored: dropped
+    ]
+    out = tracing._rebase_device_events(raw, 5_000_000.0, span, "step")
+    xs = [e for e in out if e["ph"] == "X"]
+    cs = [e for e in out if e["ph"] == "C"]
+    assert len(xs) == 1 and len(cs) == 1
+    assert xs[0]["ts"] == 5_000_000.0            # base is min X ts
+    assert cs[0]["ts"] == 5_000_000.0 + 10.0     # rebased, same clock
+    assert cs[0]["args"]["bytes"] == 12345       # counter value kept
+    assert cs[0]["args"]["trace_id"] == span.trace_id
+    assert not any(e.get("ph") == "s" for e in out)
+    # with no X events there is no anchor: nothing is emitted
+    assert tracing._rebase_device_events(
+        [{"name": "c", "ph": "C", "ts": 5.0, "args": {}}],
+        0.0, None, "d") == []
+
+
 def test_jax_trainer_step_in_timeline(ray_start_regular, tmp_path):
     """VERDICT r1 #9's 'done' artifact: one timeline() dump showing host
     task spans AND device compute for a JaxTrainer step."""

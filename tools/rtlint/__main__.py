@@ -35,6 +35,9 @@ RULES: Dict[str, List] = {
         ("wire-ref-reply", "reply(dedup) kinds never ride the "
                            "coalesced ref path"),
         ("wire-ref-arm", "_apply_ref_op_locked arms == REF_KINDS"),
+        ("wire-trace", "the optional trace frame field is declared in "
+                       "wire.py and plumbed only via the tracing "
+                       "helpers"),
     ],
     "threads": [
         ("thread-unnamed", "every thread sets name= explicitly"),

@@ -24,8 +24,18 @@ Protocol-shape rules:
   ``REF_KINDS`` exactly (an arm outside the declared set would let a
   non-ref kind slip into the coalescing buffer).
 
+Trace-field rule (``wire-trace``): the optional span-context frame
+field (``wire.TRACE_FIELD``) must be declared once in wire.py, and the
+protocol layer may only touch it through the tracing helpers
+(``tracing.attach_wire_trace`` / ``extract_wire_trace``) — any literal
+``{"trace": ...}`` dict key, ``msg["trace"] = ...`` store, or
+``.get("trace")`` / ``.pop("trace")`` read in a protocol-layer file is
+a finding.  Central plumbing is what keeps version gating (old peers
+never see the field) and sampled-out suppression in ONE place.
+
 Rules: ``wire-no-handler``, ``wire-no-producer``,
-``wire-oneway-awaited``, ``wire-ref-path``, ``wire-ref-arm``.
+``wire-oneway-awaited``, ``wire-ref-path``, ``wire-ref-arm``,
+``wire-trace``.
 """
 
 from __future__ import annotations
@@ -49,6 +59,8 @@ class WireConfig(NamedTuple):
     dedup_path: Optional[Path]   # file declaring _DEDUP_KINDS
     ref_dispatch: str            # function with per-ref-kind arms
     extra_handlers: Dict[str, str]  # kind -> "path::func" out-of-line
+    trace_scan_paths: List[Path] = []  # protocol-layer files where the
+    # trace frame field must ride the tracing helpers (wire-trace rule)
 
 
 def default_config(root: Path) -> WireConfig:
@@ -68,7 +80,10 @@ def default_config(root: Path) -> WireConfig:
             # server executes them directly (no kind comparison — the
             # channel carries only this kind)
             "call": "ray_tpu/_private/actor_server.py::_handle_call",
-        })
+        },
+        trace_scan_paths=[priv / "gcs.py", priv / "actor_server.py",
+                          priv / "worker.py", priv / "protocol.py",
+                          priv / "data_plane.py", priv / "node_agent.py"])
 
 
 def _frozenset_strs(node) -> Optional[Set[str]]:
@@ -187,9 +202,75 @@ def _scan_producers(paths: List[Path], c_paths: List[Path],
     return prod
 
 
+def _trace_field_decl(wire_sf) -> Optional[str]:
+    """The string value of wire.py's ``TRACE_FIELD`` declaration."""
+    for node in ast.walk(wire_sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "TRACE_FIELD" \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                return node.value.value
+    return None
+
+
+def _check_trace_field(cfg: WireConfig, wire_sf) -> List[Finding]:
+    """``wire-trace``: the optional trace frame field is declared once
+    in wire.py and only ever plumbed through the tracing helpers —
+    protocol-layer files must not write or read the literal key."""
+    findings: List[Finding] = []
+    field = _trace_field_decl(wire_sf)
+    if field is None:
+        findings.append(Finding(
+            wire_sf.rel, 1, "wire-trace",
+            "wire.py must declare TRACE_FIELD (the optional span-context "
+            "frame field) as a string constant"))
+        return findings
+    hint = ("route the optional trace frame field through "
+            "tracing.attach_wire_trace/extract_wire_trace, not a "
+            f"literal {field!r} key (version gating and sampled-out "
+            "suppression live in the helpers)")
+    for p in cfg.trace_scan_paths:
+        if not p.exists():
+            continue
+        try:
+            sf = load(p)
+        except SyntaxError:
+            continue
+        for node in ast.walk(sf.tree):
+            line = None
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if isinstance(k, ast.Constant) and k.value == field:
+                        line = node.lineno
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.slice, ast.Constant) \
+                            and t.slice.value == field:
+                        line = node.lineno
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in ("get", "pop", "setdefault") \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and node.args[0].value == field:
+                    line = node.lineno
+                for kw in node.keywords:
+                    if kw.arg == field and isinstance(
+                            f, ast.Name) and f.id == "dict":
+                        line = node.lineno
+            if line is not None:
+                findings.append(Finding(sf.rel, line, "wire-trace", hint))
+    return findings
+
+
 def check_wire(cfg: WireConfig) -> List[Finding]:
     findings: List[Finding] = []
     wire_sf = load(cfg.wire_path)
+    findings += _check_trace_field(cfg, wire_sf)
     decls = _kind_decls(wire_sf, {"_HOT_KINDS", "REF_KINDS"})
     hot = decls.get("_HOT_KINDS", {})
     ref = decls.get("REF_KINDS", {})
