@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
 	databench-quick servebench-quick llmbench-quick tracebench-quick \
-	leakcheck
+	releasebench-quick leakcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -90,6 +90,17 @@ tracebench-quick:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/trace_bench.py --quick \
 		--assert-sane --json benchmarks/results/tracebench_ci.json \
 		--label ci
+
+# Raylet lease-protocol smoke (CI): 2 simulated nodes (NodeAgent
+# processes with per-node local schedulers) on this host running the
+# many_tasks workload with fixed simulated work; asserts completion and
+# that the fleet actually parallelizes (>1 effective worker slot).
+# The committed full-scale artifact (release_suite_r10.json, --nodes-ab)
+# shows the node-count scaling claim.
+releasebench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/release_suite.py --nodes 2 \
+		--node-cpus 2 --tasks 60 --task-ms 10 --assert-sane \
+		--json benchmarks/results/releasebench_ci.json --label ci
 
 # LLM serving smoke (CI): the continuous-batching engine vs the naive
 # request-level baseline on one seeded diurnal+burst trace; asserts the

@@ -8,6 +8,23 @@ clusters, so absolute values differ; the contract is completion + a
 tracked rate).
 
 Usage: python benchmarks/release_suite.py [--scale 1.0] [--only name,...]
+
+Simulated multi-node mode (the raylet A/B harness, DESIGN.md §4i):
+
+  python benchmarks/release_suite.py --nodes 4 [--node-cpus 2]
+      [--raylets on|off] [--task-ms 10] [--tasks N]
+      [--json PATH] [--label rXX] [--assert-sane]
+  python benchmarks/release_suite.py --nodes-ab \
+      --json benchmarks/results/release_suite_rXX.json --label rXX
+
+``--nodes N`` boots a zero-CPU head plus N NodeAgent processes on THIS
+host (scaled fake CPU resources; ``--raylets off`` forces the legacy
+direct-GCS worker path) and runs ``many_tasks`` with a fixed per-task
+simulated work sleep — so throughput is bound by cluster worker slots
+and control-plane capacity, not by oversubscribing the host's physical
+cores, and scaling with the simulated node count measures the
+scheduler architecture.  ``--nodes-ab`` runs the interleaved
+raylet-vs-direct × node-count matrix and emits one artifact.
 """
 
 from __future__ import annotations
@@ -261,6 +278,168 @@ def head_kill_chaos(scale: float) -> None:
         ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
 
 
+# ------------------------------------------------ simulated multi-node
+class SimCluster:
+    """Zero-CPU head + N NodeAgents (raylets on/off) on this host."""
+
+    def __init__(self, nodes: int, node_cpus: int, raylets: bool):
+        import subprocess
+
+        import ray_tpu
+        from ray_tpu._private import worker as wm
+        from ray_tpu.util import state
+        from ray_tpu.util.client import ClientProxyServer
+
+        self.nodes = nodes
+        self.node_cpus = node_cpus
+        ray_tpu.init(num_cpus=0)  # CPU work can ONLY land on sim nodes
+        session = wm.global_worker().session
+        self.proxy = ClientProxyServer(session, host="127.0.0.1", port=0)
+        port = self.proxy._listener.address[1]
+        env = dict(os.environ)
+        env["RTPU_AUTH_KEY"] = session.auth_key().hex()
+        env.pop("RTPU_SESSION_DIR", None)
+        env["RTPU_RAYLET_ENABLED"] = "1" if raylets else "0"
+        # debug: RTPU_AGENT_WORKER_LOG=1 inherits agent/raylet stderr
+        sink = (None if os.environ.get("RTPU_AGENT_WORKER_LOG")
+                else subprocess.DEVNULL)
+        self.agents = [subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_agent",
+             "--address", f"127.0.0.1:{port}",
+             "--num-cpus", str(node_cpus)],
+            env=env, stdout=sink, stderr=sink,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+            for _ in range(nodes)]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            up = [n for n in state.list_nodes()
+                  if n["labels"].get("agent") == "1" and n["alive"]]
+            attached = (len(state.list_raylets()) if raylets else nodes)
+            if len(up) >= nodes and attached >= nodes:
+                break
+            time.sleep(0.3)
+        else:
+            raise RuntimeError("simulated nodes never registered")
+        print(f"# sim cluster: {nodes} node(s) up "
+              f"(raylets={'on' if raylets else 'off'}); waiting for "
+              f"{nodes * node_cpus} workers", file=sys.stderr, flush=True)
+        self.node_ids = {n["node_id"] for n in up}
+        # wait for the full worker fleet so every phase measures the
+        # same slot count (boot time excluded from the rate)
+        want = nodes * node_cpus
+        live: list = []
+        while time.time() < deadline:
+            live = [w for w in state.list_workers()
+                    if w["node_id"] in self.node_ids
+                    and w["state"] != "dead"]
+            if len(live) >= want:
+                print("# sim cluster: fleet complete",
+                      file=sys.stderr, flush=True)
+                return
+            time.sleep(0.3)
+        raise RuntimeError(
+            f"worker fleet incomplete ({len(live)}/{want})")
+
+    def stop(self):
+        import ray_tpu
+        for a in self.agents:
+            a.terminate()
+        for a in self.agents:
+            try:
+                a.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                a.kill()
+        self.proxy.stop()
+        ray_tpu.shutdown()
+
+
+def many_tasks_sim(n: int, task_ms: float) -> dict:
+    """The acceptance workload: n tasks of ``task_ms`` simulated work
+    through whatever cluster is currently up.  Returns the result row."""
+    import ray_tpu
+
+    work_s = task_ms / 1e3
+
+    @ray_tpu.remote(max_retries=-1)
+    def sim(i):
+        time.sleep(work_s)
+        return i
+
+    # warmup: export the function, fault in the lease chains
+    ray_tpu.get([sim.remote(i) for i in range(8)], timeout=120)
+    t0 = time.perf_counter()
+    out = ray_tpu.get([sim.remote(i) for i in range(n)], timeout=900)
+    dt = time.perf_counter() - t0
+    assert out == list(range(n))
+    return {"tasks": n, "seconds": round(dt, 3),
+            "rate": round(n / dt, 1), "task_ms": task_ms}
+
+
+def _head_settlement_frames() -> dict:
+    """How many per-task vs batched settlement handler invocations the
+    in-process head has served (task_done = one global-lock acquisition
+    per task on the direct path; raylet_done_batch = one per BATCH) —
+    the head-side work the raylet tier amortizes."""
+    from ray_tpu.util import metrics_catalog as mcat
+    out = {}
+    for s in mcat.get("rtpu_gcs_hot_handler_seconds").snapshot():
+        kind = s["tags"].get("kind")
+        if kind in ("task_done", "raylet_done_batch"):
+            out[kind] = s["value"]["count"]
+    return out
+
+
+def run_sim_phase(nodes: int, node_cpus: int, raylets: bool,
+                  task_ms: float, tasks: int) -> dict:
+    cluster = SimCluster(nodes, node_cpus, raylets)
+    try:
+        before = _head_settlement_frames()
+        row = many_tasks_sim(tasks, task_ms)
+        after = _head_settlement_frames()
+    finally:
+        cluster.stop()
+    frames = {k: after.get(k, 0) - before.get(k, 0)
+              for k in after if after.get(k, 0) - before.get(k, 0)}
+    row.update({"mode": "raylet" if raylets else "direct",
+                "nodes": nodes, "node_cpus": node_cpus,
+                "head_settlement_frames": frames})
+    print(json.dumps({"workload": "many_tasks_sim", **row}), flush=True)
+    return row
+
+
+def run_nodes_ab(args) -> dict:
+    """Interleaved raylet-vs-direct × node-count matrix (best-of-reps
+    per cell) — the committed A/B artifact for the scaling claim."""
+    counts = [int(c) for c in args.ab_nodes.split(",")]
+    cells = [(m, c) for c in counts for m in ("raylet", "direct")]
+    best: dict = {}
+    for rep in range(args.reps):
+        for mode, cnt in cells:
+            row = run_sim_phase(cnt, args.node_cpus, mode == "raylet",
+                                args.task_ms, args.tasks * cnt)
+            key = f"{mode}_n{cnt}"
+            if key not in best or row["rate"] > best[key]["rate"]:
+                best[key] = row
+    lo, hi = min(counts), max(counts)
+    summary = {
+        "raylet_scaling": round(best[f"raylet_n{hi}"]["rate"] /
+                                best[f"raylet_n{lo}"]["rate"], 2),
+        "direct_scaling": round(best[f"direct_n{hi}"]["rate"] /
+                                best[f"direct_n{lo}"]["rate"], 2),
+        "raylet_vs_direct_at_1": round(
+            best[f"raylet_n{lo}"]["rate"] /
+            best[f"direct_n{lo}"]["rate"], 2),
+        "ideal_scaling": round(hi / lo, 2),
+    }
+    return {"bench": "release_suite_nodes_ab", "label": args.label,
+            "host": {"cpus": os.cpu_count()},
+            "config": {"node_cpus": args.node_cpus,
+                       "task_ms": args.task_ms,
+                       "tasks_per_node": args.tasks,
+                       "reps": args.reps, "nodes": counts},
+            "cells": best, "summary": summary}
+
+
 WORKLOADS = {
     "many_tasks": many_tasks,
     "many_actors": many_actors,
@@ -275,7 +454,67 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--only", type=str, default=None)
+    # simulated multi-node mode (raylet A/B harness)
+    ap.add_argument("--nodes", type=int, default=0,
+                    help="run many_tasks against N simulated nodes "
+                         "(NodeAgent processes on this host)")
+    ap.add_argument("--nodes-ab", action="store_true",
+                    help="interleaved raylet-vs-direct node-count "
+                         "matrix; emits one artifact")
+    ap.add_argument("--ab-nodes", default="1,4",
+                    help="node counts for --nodes-ab (default 1,4)")
+    ap.add_argument("--node-cpus", type=int, default=2,
+                    help="fake CPUs (= workers) per simulated node")
+    ap.add_argument("--raylets", choices=("on", "off"), default="on",
+                    help="per-node local schedulers on (default) or the "
+                         "legacy direct-GCS worker path")
+    ap.add_argument("--task-ms", type=float, default=25.0,
+                    help="simulated work per task (sleep).  Sized so a "
+                         "single simulated node is WORKER-bound on this "
+                         "class of host — scaling with node count then "
+                         "measures whether the control plane keeps up, "
+                         "which is the claim under test")
+    ap.add_argument("--tasks", type=int, default=200,
+                    help="tasks per simulated node per phase")
+    ap.add_argument("--reps", type=int, default=2,
+                    help="interleaved repetitions per A/B cell")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the result document to PATH")
+    ap.add_argument("--label", default=None,
+                    help="artifact label (e.g. r10, ci)")
+    ap.add_argument("--assert-sane", action="store_true",
+                    help="CI gate: phases completed with nonzero "
+                         "throughput (and, for --nodes-ab, raylet "
+                         "scaling beats flat)")
     args = ap.parse_args()
+
+    if args.nodes_ab:
+        doc = run_nodes_ab(args)
+        print(json.dumps(doc, indent=1))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(doc, f, indent=1)
+        if args.assert_sane:
+            s = doc["summary"]
+            assert s["raylet_scaling"] > 1.5, s
+            assert s["raylet_vs_direct_at_1"] > 0.8, s
+        return
+
+    if args.nodes:
+        row = run_sim_phase(args.nodes, args.node_cpus,
+                            args.raylets == "on", args.task_ms,
+                            args.tasks * args.nodes)
+        doc = {"bench": "release_suite_nodes", "label": args.label,
+               "host": {"cpus": os.cpu_count()}, "row": row}
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(doc, f, indent=1)
+        if args.assert_sane:
+            assert row["rate"] > 0, row
+            # the fleet must actually parallelize the simulated work:
+            # >1 effective worker slot end-to-end
+            assert row["rate"] * row["task_ms"] / 1e3 > 1.0, row
+        return
 
     import ray_tpu
     ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))

@@ -378,9 +378,12 @@ class ActorServer:
         except (OSError, ValueError):
             pass  # caller went away; results are in the GCS regardless
 
-    def _shutdown(self) -> None:
-        # tell the control plane this exit is intentional → no restart
-        self.worker._send_event({"kind": "actor_exit", "actor_id": self.actor_id})
+    def stop_serving(self) -> None:
+        """Stop the server WITHOUT declaring an intentional exit: the
+        ray_tpu.kill path for proc-less (remote/raylet) actor workers —
+        the control plane already recorded its own death reason and
+        restart policy, and an actor_exit event here would wrongly
+        suppress a no_restart=False restart."""
         self._stopped.set()
         try:
             self._listener.close()
@@ -391,6 +394,11 @@ class ActorServer:
         # unblock sibling exec threads
         for _ in range(self.max_concurrency):
             self._queue.put(None)
+
+    def _shutdown(self) -> None:
+        # tell the control plane this exit is intentional → no restart
+        self.worker._send_event({"kind": "actor_exit", "actor_id": self.actor_id})
+        self.stop_serving()
 
 
 def exit_actor() -> None:

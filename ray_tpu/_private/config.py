@@ -136,6 +136,32 @@ _FLAG_DEFS = [
           "pump scan).  0 disables (reference: lease reuse)."),
     _flag("scheduler_spread_threshold", 0.5,
           "Hybrid policy: prefer local until local load exceeds this fraction."),
+    # --- raylet (per-node local scheduler, DESIGN.md §4i) --------------------
+    _flag("raylet_enabled", True,
+          "Promote each NodeAgent into a raylet: a per-node local "
+          "scheduler that claims worker leases from the GCS in bulk, "
+          "dispatches intra-node tasks without a head round-trip, and "
+          "reconciles refcounts/results asynchronously (reference: "
+          "src/ray/raylet NodeManager + LocalTaskManager).  Requires the "
+          "head to speak wire proto >= PROTO_RAYLET; older heads fall "
+          "back to the legacy direct-GCS worker pool automatically."),
+    _flag("raylet_lease_backlog", 16,
+          "Queued lease depth per raylet node: plain-CPU specs granted "
+          "beyond the node's resource fit, queued locally and started "
+          "by same-shape lease handoff or on an idle worker "
+          "(node-scoped generalization of worker_pipeline_depth; "
+          "concurrency stays bounded by the worker pool).  0 disables "
+          "oversubscribed grants."),
+    _flag("raylet_reconcile_interval_s", 0.2,
+          "How often a raylet flushes its netted owner-local refcount "
+          "deltas and scheduler stats to the GCS ledger.  Task results "
+          "are NOT held to this cadence (the done flusher drains "
+          "immediately when idle and batches only under load)."),
+    _flag("raylet_spawn_headroom", 4,
+          "Extra replacement workers a raylet may fork beyond its base "
+          "pool while workers are blocked in get() with leased work "
+          "queued (reference: raylet replacement workers for blocked "
+          "ones; bounds nested-task deadlock avoidance)."),
     _flag("health_check_period_s", 1.0, "Control-plane node health check period."),
     _flag("health_check_timeout_s", 10.0, "Node declared dead after this long w/o heartbeat."),
     # --- tasks / actors ------------------------------------------------------

@@ -77,6 +77,38 @@ class NodeState:
         self.workers: Set[str] = set()
         self.idle_workers: deque = deque()
         self.last_heartbeat = time.monotonic()
+        # --- raylet lease channel (DESIGN.md §4i) ---
+        # A node with a live raylet_conn is scheduled by GRANT: the pump
+        # debits resources on this ledger and ships spec blocks down the
+        # channel; the raylet dispatches locally and reports back in
+        # batches.  leases_out is the ledger of granted-but-unsettled
+        # specs — the unit of reclaim when the channel drops.
+        self.raylet_conn = None          # guarded by: lock
+        self.raylet_conn_lock = threading.Lock()
+        self.raylet_proto = 0            # guarded by: lock
+        self.raylet_epoch = 0            # guarded by: lock
+        self.leases_out: Dict[str, dict] = {}   # guarded by: lock
+        self.raylet_stats: dict = {}     # guarded by: lock
+        self.raylet_reconcile_age = 0.0  # guarded by: lock
+
+    def queued_lease_count(self) -> int:
+        """Unfunded (``_lease_q``) leases outstanding on this node's
+        raylet — the backlog-depth gate (lock held by callers)."""
+        return sum(1 for s in self.leases_out.values()
+                   if s.get("_lease_q"))
+
+    def push_raylet(self, msg: dict) -> bool:
+        """Push one lease frame to the node's raylet (wire-framed at the
+        channel's negotiated version — never legacy pickle)."""
+        from ray_tpu._private import wire
+        with self.raylet_conn_lock:
+            if self.raylet_conn is None:
+                return False
+            try:
+                wire.conn_send(self.raylet_conn, msg, self.raylet_proto)
+                return True
+            except (OSError, ValueError):
+                return False
 
     def load(self) -> float:
         cpu_t = self.resources_total.get("CPU", 0.0)
@@ -607,6 +639,11 @@ class GcsServer:
             if node is None:
                 return
             node.alive = False
+            # raylet node: reclaim the outstanding lease ledger FIRST so
+            # granted work re-queues before the workers are declared dead
+            self._reclaim_raylet_leases_locked(node)
+            with node.raylet_conn_lock:
+                node.raylet_conn = None
             workers = [self.workers[w] for w in list(node.workers)]
         for w in workers:
             if w.proc is not None:
@@ -960,6 +997,17 @@ class GcsServer:
         with self.cv:
             self._pump_locked(force=force)
 
+    def _raylet_backlog_room_locked(self) -> bool:
+        """Lock held.  Any raylet with queued-lease headroom?"""
+        depth = GLOBAL_CONFIG.raylet_lease_backlog
+        if depth <= 0:
+            return False
+        for node in self.nodes.values():
+            if node.alive and node.raylet_conn is not None \
+                    and node.queued_lease_count() < depth:
+                return True
+        return False
+
     # Consecutive unplaceable specs tolerated per scan before giving up
     # until the next pump.  Without a cutoff, a deep backlog makes every
     # pump O(backlog) and the scheduler O(n^2) under pipelined one-way
@@ -1048,10 +1096,13 @@ class GcsServer:
         self._pending_counts[self._spec_class(spec)] += 1
         self.pending_tasks.appendleft(spec)
 
-    def _observe_queue_latency(self, spec: dict) -> None:
+    def _observe_queue_latency(self, spec: dict, tier: str = "gcs") -> None:
         """A spec is leaving the scheduler queue for a worker: record the
         submit->dispatch wait (rtpu_task_queue_seconds).  pop: a retried
-        or resubmitted spec re-enters the queue and re-measures."""
+        or resubmitted spec re-enters the queue and re-measures.
+        ``tier`` names which scheduler tier took the dispatch ("gcs"
+        direct, or "raylet:<node>" for a lease grant) — carried on the
+        sched: span so traces show who placed the task."""
         t = spec.pop("_enqueued_at", None)
         if t is None:
             return
@@ -1072,7 +1123,8 @@ class GcsServer:
             ev = _tracing.span_event(
                 f"sched:{name}", _tracing.SpanContext.from_dict(tc),
                 t0=time.time() - wait, dur=wait, cat="sched",
-                pid="gcs", tid=0, task_id=spec.get("task_id"))
+                pid="gcs", tid=0, task_id=spec.get("task_id"),
+                tier=tier)
             if ev is not None:
                 with self._events_lock:
                     self.events.append(ev)
@@ -1105,7 +1157,10 @@ class GcsServer:
                 n.alive and n.resources_avail.get("TPU", 0) > 0
                 for n in self.nodes.values())
             if not (cpu_ok or tpu_ok):
-                return False
+                # a raylet's queued-lease backlog can still absorb
+                # plain-CPU specs even with zero free resources
+                if not (pc["cpu"] and self._raylet_backlog_room_locked()):
+                    return False
         return self._worker_capacity(
             starting_is_capacity=False, piggyback_is_capacity=True,
             count_pending_actors=True,
@@ -1123,6 +1178,19 @@ class GcsServer:
         for node in self.nodes.values():
             if node.alive and node.idle_workers:
                 return True
+            if node.alive and node.raylet_conn is not None:
+                # raylet nodes schedule by grant: free ledger resources
+                # (or backlog room, when queuing counts as capacity) ARE
+                # dispatch capacity — no head-side idle worker needed
+                if node.resources_avail.get("CPU", 0) > 0:
+                    return True
+                if tpu_headroom and node.resources_avail.get("TPU", 0) > 0:
+                    return True
+                if piggyback_is_capacity \
+                        and GLOBAL_CONFIG.raylet_lease_backlog > 0 \
+                        and node.queued_lease_count() \
+                        < GLOBAL_CONFIG.raylet_lease_backlog:
+                    return True
         for w in self.workers.values():
             if w.blocked or w.state == "dead":
                 continue
@@ -1156,6 +1224,17 @@ class GcsServer:
         if not force and self.pending_tasks and not self._dispatch_capacity():
             self.cv.notify_all()
             return
+        # Lease grants buffered per raylet node for this whole pump and
+        # flushed as ONE lease_grant frame each (bulk claims, §4i) — the
+        # try/finally covers the capacity early-returns below.
+        grants: Dict[str, List[dict]] = {}
+        try:
+            self._pump_scan_locked(force, grants)
+        finally:
+            self._flush_lease_grants_locked(grants)
+
+    def _pump_scan_locked(self, force: bool,
+                          grants: Dict[str, List[dict]]) -> None:
         # The miss budget is for the WHOLE pump (not per pass): a typical
         # capacity event frees room for one task — one dispatch plus a
         # bounded tail of unplaceable specs, not O(backlog) rescans.
@@ -1188,8 +1267,38 @@ class GcsServer:
                 else:
                     node = self._pick_node(spec, req)
                 if node is None:
+                    if self._grant_backlog_locked(spec, req, grants):
+                        # queued lease on a raylet whose running chain it
+                        # can inherit — leaves the head's queue NOW
+                        progressed = True
+                        misses = 0
+                        continue
                     self._push_pending(spec)
                     misses += 1
+                    continue
+                if node.raylet_conn is not None:
+                    # raylet node (§4i): debit the ledger and GRANT; the
+                    # raylet owns intra-node worker assignment.  Buffered
+                    # — one lease_grant frame per node per pump.
+                    if pg_claim is not None:
+                        pg, i = pg_claim
+                        for k, v in req.items():
+                            pg.bundle_avail[i][k] = \
+                                pg.bundle_avail[i].get(k, 0.0) - v
+                        spec["_pg_claim"] = (pg.pg_id, i)
+                    else:
+                        node.acquire(req)
+                    spec["_req"] = req
+                    spec["_node"] = node.node_id
+                    spec["_started_at"] = time.monotonic()
+                    self._observe_queue_latency(
+                        spec, tier=f"raylet:{node.node_id[:8]}")
+                    node.leases_out[spec["task_id"]] = spec
+                    self.running[spec["task_id"]] = (
+                        f"raylet:{node.node_id[:8]}", spec)
+                    grants.setdefault(node.node_id, []).append(spec)
+                    progressed = True
+                    misses = 0
                     continue
                 need_tpu = req.get("TPU", 0) > 0
                 worker = self._idle_worker_on(node, need_tpu)
@@ -1302,6 +1411,83 @@ class GcsServer:
                     self.cv.notify_all()
                     return
             self.cv.notify_all()
+
+    def _grant_backlog_locked(self, spec: dict, req: Dict[str, float],
+                              grants: Dict[str, List[dict]]) -> bool:
+        """Lock held.  No node fits the spec right now: queue it as an
+        unfunded lease (``_lease_q``) on the raylet with the shallowest
+        local queue, bounded by ``raylet_lease_backlog`` per node — the
+        node-scoped generalization of worker_pipeline_depth.  The
+        raylet starts queued leases on idle workers (pool-bounded local
+        CPU oversubscription of the ledger) or by inheriting a
+        finishing same-shape task's claim; the fund/return frames
+        reconcile the accounting either way.  Only prepush-safe
+        plain-CPU specs ride this (same constraints as
+        _take_matching_pending)."""
+        depth = GLOBAL_CONFIG.raylet_lease_backlog
+        if depth <= 0:
+            return False
+        if (self._spec_class(spec) != "cpu"
+                or spec.get("is_actor_creation")
+                or (spec.get("scheduling_strategy") or "DEFAULT") != "DEFAULT"
+                or spec.get("runtime_env")):
+            return False
+        best = None
+        best_q = depth
+        for node in self.nodes.values():
+            if not node.alive or node.raylet_conn is None:
+                continue
+            queued = node.queued_lease_count()
+            if queued < best_q:
+                best, best_q = node, queued
+        if best is None:
+            return False
+        node = best
+        spec["_lease_q"] = True
+        # shape marker ONLY (the raylet matches handoffs / the head
+        # funds on it); never _req — a queued lease holds no funded
+        # claim, and _release_task_resources must no-op on it
+        spec["_lease_shape"] = dict(req)
+        self._observe_queue_latency(
+            spec, tier=f"raylet:{node.node_id[:8]}")
+        node.leases_out[spec["task_id"]] = spec
+        self.running[spec["task_id"]] = (
+            f"raylet:{node.node_id[:8]}", spec)
+        grants.setdefault(node.node_id, []).append(spec)
+        return True
+
+    def _flush_lease_grants_locked(self,
+                                   grants: Dict[str, List[dict]]) -> None:
+        """Lock held.  Ship this pump's grant buffers, one frame per
+        raylet (push rides lock → raylet_conn_lock, a legal DAG edge
+        like worker task pushes).  A push failure means the channel died
+        between pick and flush: undo the ledger and requeue."""
+        if not grants:
+            return
+        from ray_tpu._private import flight_recorder
+        for node_id, specs in grants.items():
+            node = self.nodes.get(node_id)
+            ok = node is not None and node.push_raylet(
+                {"kind": "lease_grant", "rid": None,
+                 "epoch": node.raylet_epoch, "specs": specs})
+            if flight_recorder.enabled():
+                flight_recorder.record(
+                    "lease_grant",
+                    f"{node_id[:8]} n={len(specs)} ok={ok}")
+            if ok:
+                if GLOBAL_CONFIG.metrics_enabled:
+                    mcat.get("rtpu_raylet_leases_total").inc(
+                        len(specs), tags={"event": "granted"})
+                continue
+            for spec in specs:
+                if node is not None:
+                    node.leases_out.pop(spec["task_id"], None)
+                self.running.pop(spec["task_id"], None)
+                self._release_task_resources(spec)
+                spec.pop("_lease_q", None)
+                spec.pop("_lease_shape", None)
+                self._push_pending_left(spec)
+        grants.clear()
 
     def _release_task_resources(self, spec: dict) -> None:
         req = spec.pop("_req", None)
@@ -1647,6 +1833,14 @@ class GcsServer:
                 if kind == "agent_attach":
                     self._attach_agent_conn(msg["node_id"], conn)
                     return  # thread parks until the agent disconnects
+                if kind == "raylet_attach":
+                    # lease channel (DESIGN.md §4i): version-fenced — a
+                    # conn that never negotiated >= PROTO_RAYLET cannot
+                    # carry lease frames (old peers never see them)
+                    if ver < wire.PROTO_RAYLET:
+                        break
+                    self._attach_raylet_conn(msg["node_id"], conn, ver)
+                    return  # thread becomes the lease-channel reader
                 if seen_ver == 0 and ver == 0 \
                         and GLOBAL_CONFIG.proto_min_version > 0:
                     # un-negotiated legacy peer on a version-fenced server.
@@ -1768,6 +1962,412 @@ class GcsServer:
                 self.remove_node_internal(node_id)
             except Exception:  # noqa: BLE001
                 logger.exception("agent node removal failed")
+
+    def _push_worker_ctl(self, w: WorkerState, msg: dict) -> bool:
+        """Push an OOB control frame to a worker, routing via its node's
+        raylet (``worker_ctl``) when the worker's channels attach there
+        instead of here (raylet nodes own their workers' task/ctl conns)."""
+        if w.push_ctl(msg):
+            return True
+        node = self.nodes.get(w.node_id)
+        if node is not None and node.raylet_conn is not None:
+            return node.push_raylet({"kind": "worker_ctl", "rid": None,
+                                     "worker_id": w.worker_id,
+                                     "msg": msg})
+        return False
+
+    # ------------------------------------------------- raylet lease channel
+    def _attach_raylet_conn(self, node_id: str, conn, ver: int) -> None:
+        """Serve one node's raylet lease channel (DESIGN.md §4i).  The
+        conn is bidirectional: the pump pushes ``lease_grant`` blocks
+        down it (push_raylet), and this thread reads the raylet's
+        batched reports.  It is ALSO the node's one liveness path — EOF
+        reclaims every outstanding lease and removes the node."""
+        from ray_tpu._private import flight_recorder, wire
+        with self.cv:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                conn.close()
+                return
+            node.raylet_epoch += 1
+            node.raylet_proto = ver
+            with node.raylet_conn_lock:
+                node.raylet_conn = conn
+            node.last_heartbeat = time.monotonic()
+            self.cv.notify_all()
+        logger.info("raylet attached for node %s (proto v%d)",
+                    node_id[:8], ver)
+        self._pump()
+        detached = False
+        while not self._shutdown:
+            try:
+                msg, _ = wire.conn_recv(conn)
+            except (EOFError, OSError, wire.WireError):
+                break
+            kind = msg.get("kind")
+            if flight_recorder.enabled():
+                flight_recorder.record("raylet_frame",
+                                       f"{kind} node={node_id[:8]}")
+            try:
+                if kind == "raylet_done_batch":
+                    self._on_raylet_done_batch(node_id, msg)
+                elif kind == "raylet_ref_batch":
+                    self._on_raylet_ref_batch(msg)
+                elif kind == "raylet_fwd":
+                    self._on_raylet_fwd(node_id, msg)
+                elif kind == "raylet_worker_died":
+                    self._on_raylet_worker_died(msg)
+                elif kind == "raylet_task_blocked":
+                    self._on_raylet_blocked(node_id, msg, blocked=True)
+                elif kind == "raylet_task_unblocked":
+                    self._on_raylet_blocked(node_id, msg, blocked=False)
+                elif kind == "raylet_heartbeat":
+                    self._on_raylet_heartbeat(node_id, msg)
+                elif kind == "raylet_lease_return":
+                    self._on_raylet_lease_return(node_id, msg)
+                elif kind == "raylet_workers":
+                    self._on_raylet_workers(node_id, msg)
+                elif kind == "raylet_detach":
+                    detached = True
+                    break
+                else:
+                    logger.warning("unknown raylet frame %r", kind)
+            except Exception:  # noqa: BLE001 - one bad report must not
+                # tear down the whole node's lease channel
+                logger.exception("raylet frame failed: %s", kind)
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is not None:
+                with node.raylet_conn_lock:
+                    if node.raylet_conn is conn:
+                        node.raylet_conn = None
+        try:
+            conn.close()
+        except OSError:
+            pass
+        if not self._shutdown:
+            log = logger.info if detached else logger.warning
+            log("raylet for node %s %s; reclaiming leases and removing "
+                "node", node_id[:8],
+                "detached" if detached else "disconnected")
+            try:
+                # remove_node_internal reclaims outstanding leases first
+                self.remove_node_internal(node_id)
+            except Exception:  # noqa: BLE001
+                logger.exception("raylet node removal failed")
+            self._pump()
+
+    def _reclaim_raylet_leases_locked(self, node: NodeState) -> None:
+        """Lock held.  The node's lease channel is gone: queued leases
+        (never started) re-queue free; funded leases may have been
+        mid-execution, so they consume a retry attempt — the same
+        contract as worker death.  Net resources return to zero."""
+        leases, node.leases_out = node.leases_out, {}
+        reclaimed = 0
+        for tid, spec in leases.items():
+            self.running.pop(tid, None)
+            reclaimed += 1
+            if spec.get("is_actor_creation"):
+                a = self.actors.get(spec.get("actor_id"))
+                if a is not None and a.state == A_ALIVE:
+                    continue  # settled via actor_ready; nothing to undo
+                if a is not None:
+                    a.death_reason = "raylet died during actor creation"
+                    # _actor_worker_died releases the creation resources
+                    self._actor_worker_died(a.actor_id)
+                continue
+            self._release_task_resources(spec)
+            if spec.get("cancelled"):
+                continue
+            if spec.pop("_lease_q", None):
+                spec.pop("_lease_shape", None)
+                self._push_pending_left(spec)  # never started: free requeue
+                continue
+            retries = spec.get("max_retries",
+                               GLOBAL_CONFIG.task_default_max_retries)
+            attempts = spec.get("attempt", 0)
+            if retries < 0 or attempts < retries:
+                spec2 = dict(spec)
+                spec2["attempt"] = attempts + 1
+                self._push_pending(spec2)
+            else:
+                self._fail_task(spec, exc.WorkerCrashedError(
+                    f"raylet on node {node.node_id[:8]} died running "
+                    f"{spec.get('name', spec['task_id'])}"))
+        if reclaimed and GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_raylet_leases_total").inc(
+                reclaimed, tags={"event": "reclaimed"})
+
+    def _finish_task_ok_locked(self, spec: dict, results, w_node_id) -> None:
+        """Lock held.  Seal a completed task's returns + lineage — the
+        ONE ok-settlement path, shared by the direct worker channel
+        (_on_task_done) and the raylet done batch."""
+        for oid, res in zip(spec["return_ids"], results):
+            meta = self._get_or_create_meta(oid)
+            if meta.refcount <= 0 and not spec.get("is_reconstruction"):
+                meta.refcount += 1  # owner's initial reference
+            if res["loc"] == "shm":
+                self.store.adopt(oid, res.get("size", 0))
+            self._seal_object(
+                oid, res["loc"], res.get("data"), res.get("size", 0),
+                spec.get("_node") or w_node_id, res.get("contained", []),
+                lineage_task=spec["task_id"])
+        self.lineage[spec["task_id"]] = {
+            k: v for k, v in spec.items() if not k.startswith("_")}
+        self.lineage_order.append(spec["task_id"])
+        if len(self.lineage) > self.lineage_order.maxlen:
+            live = set(self.lineage_order)
+            for tid in [t for t in self.lineage if t not in live]:
+                self.lineage.pop(tid, None)
+        self._release_deps(spec)
+        self._count_task_terminal("ok")
+
+    def _on_raylet_done_batch(self, node_id: str, msg: dict) -> None:
+        """Apply one batch of lease settlements under ONE global-lock
+        acquisition (the raylet-side analog of _drain_ref_ops)."""
+        evs: List[dict] = []
+        for entry in msg.get("entries", ()):
+            if entry.get("events"):
+                evs.extend(entry["events"])
+        if evs:
+            with self._events_lock:
+                self.events.extend(evs)
+        t0 = time.monotonic()
+        done = handoffs = 0
+        with self.cv:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            for entry in msg.get("entries", ()):
+                self._apply_raylet_done_locked(node, entry)
+                done += 1
+                if entry.get("next_task_id"):
+                    handoffs += 1
+            self.cv.notify_all()
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_gcs_hot_handler_seconds").observe(
+                time.monotonic() - t0, tags={"kind": "raylet_done_batch"})
+            mcat.get("rtpu_raylet_leases_total").inc(
+                done, tags={"event": "done"})
+            if handoffs:
+                mcat.get("rtpu_raylet_leases_total").inc(
+                    handoffs, tags={"event": "handoff"})
+        if self.pending_tasks:
+            self._pump()
+
+    def _apply_raylet_done_locked(self, node: NodeState,
+                                  entry: dict) -> None:
+        tid = entry["task_id"]
+        status = entry.get("status")
+        spec = node.leases_out.pop(tid, None)
+        if spec is None:
+            # unknown lease: this head restarted between grant and done
+            # (or a reclaim raced the report).  The return ids in the
+            # entry are authoritative — adopt the results so the value
+            # is not lost; a resubmitted copy double-sealing the same
+            # ids is tolerated by the seal path.
+            if status == "ok":
+                for oid, res in zip(entry.get("return_ids", ()),
+                                    entry.get("results") or ()):
+                    meta = self._get_or_create_meta(oid)
+                    if meta.refcount <= 0:
+                        meta.refcount += 1
+                    if res["loc"] == "shm":
+                        self.store.adopt(oid, res.get("size", 0))
+                    self._seal_object(
+                        oid, res["loc"], res.get("data"),
+                        res.get("size", 0),
+                        node.node_id if res["loc"] == "remote" else None,
+                        res.get("contained", []))
+            return
+        self.running.pop(tid, None)
+        # lease handoff: the raylet already started next_task_id on this
+        # claim (reference: lease reuse) — MOVE it on the ledger instead
+        # of release-then-reacquire
+        nxt = None
+        ntid = entry.get("next_task_id")
+        if ntid is not None:
+            nxt = node.leases_out.get(ntid)
+        if nxt is not None and not nxt.get("cancelled") \
+                and "_req" in spec and "_pg_claim" not in spec \
+                and nxt.pop("_lease_q", None):
+            # move the claim — but NEVER from a placement-group-funded
+            # spec: its claim lives on the PG bundle, not the node
+            # ledger, and a plain inheritor would release against the
+            # wrong pool
+            nxt.pop("_lease_shape", None)
+            nxt["_req"] = spec.pop("_req")
+            nxt["_node"] = spec.pop("_node", None)
+            nxt["_started_at"] = time.monotonic()
+        else:
+            self._release_task_resources(spec)
+        if status == "ok":
+            self._finish_task_ok_locked(spec, entry.get("results") or [],
+                                        node.node_id)
+        elif status == "app_error":
+            retries = spec.get("max_retries", 0) \
+                if spec.get("retry_exceptions") else 0
+            # retries < 0 = infinite (same contract as system retries)
+            if retries and (retries < 0
+                            or spec.get("attempt", 0) < retries):
+                spec2 = dict(spec)
+                spec2["attempt"] = spec.get("attempt", 0) + 1
+                self._push_pending(spec2)
+            else:
+                for oid in spec["return_ids"]:
+                    self._seal_error(oid, entry["error"])
+                self._release_deps(spec)
+                self._count_task_terminal("app_error")
+        elif status == "worker_died":
+            retries = spec.get("max_retries",
+                               GLOBAL_CONFIG.task_default_max_retries)
+            attempts = spec.get("attempt", 0)
+            if spec.get("cancelled"):
+                pass  # cancel raced the death: already settled
+            elif retries < 0 or attempts < retries:
+                spec2 = dict(spec)
+                spec2["attempt"] = attempts + 1
+                self._push_pending(spec2)
+            else:
+                self._fail_task(spec, exc.WorkerCrashedError(
+                    f"worker on node {node.node_id[:8]} died running "
+                    f"{spec.get('name', spec['task_id'])}"))
+
+    def _on_raylet_ref_batch(self, msg: dict) -> None:
+        """Apply a raylet's netted owner-local release deltas through
+        the same single-acquisition batch path as connection-coalesced
+        ref oneways (_drain_ref_ops → _apply_ref_op_locked)."""
+        ops = [(str(k), dict(m)) for k, m in msg.get("ops", ())]
+        n = int(msg.get("netted") or len(ops))
+        self._drain_ref_ops(ops)
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_raylet_ref_ops_total").inc(
+                n, tags={"path": "reconciled"})
+
+    def _on_raylet_fwd(self, node_id: str, msg: dict) -> None:
+        inner = msg.get("msg") or {}
+        self._handle_worker_event(msg.get("worker_id"), inner)
+        if inner.get("kind") == "actor_ready" and not inner.get("reattach"):
+            # settle the creation lease on the SAME thread as the actor
+            # linkage: a raylet death in between must never reclaim (and
+            # re-run) a creation whose actor is already ALIVE/DEAD
+            with self.cv:
+                node = self.nodes.get(node_id)
+                a = self.actors.get(inner.get("actor_id"))
+                if node is not None and a is not None:
+                    tid = a.spec.get("task_id")
+                    node.leases_out.pop(tid, None)
+
+    def _on_raylet_worker_died(self, msg: dict) -> None:
+        with self.cv:
+            w = self.workers.get(msg.get("worker_id"))
+            if w is not None:
+                self._handle_worker_death(w)
+        self._pump()
+
+    def _on_raylet_blocked(self, node_id: str, msg: dict,
+                           blocked: bool) -> None:
+        """A leased task parked in (or returned from) get() on a raylet
+        node: credit/debit the CPU exactly like the direct-worker
+        task_blocked path, keyed by the lease ledger instead of
+        WorkerState.current_task."""
+        with self.cv:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            spec = node.leases_out.get(msg.get("task_id"))
+            if spec is None:
+                return
+            cpu = (spec.get("_req") or {}).get("CPU", 0)
+            if not cpu:
+                return
+            pg_claim = spec.get("_pg_claim")
+            if blocked and not spec.get("_cpu_released"):
+                spec["_cpu_released"] = True
+                if pg_claim is not None:
+                    pg = self.pgs.get(pg_claim[0])
+                    if pg is not None:
+                        avail = pg.bundle_avail[pg_claim[1]]
+                        avail["CPU"] = avail.get("CPU", 0.0) + cpu
+                else:
+                    node.release_res({"CPU": cpu})
+                self.cv.notify_all()
+            elif not blocked and spec.pop("_cpu_released", None):
+                if pg_claim is not None:
+                    pg = self.pgs.get(pg_claim[0])
+                    if pg is not None:
+                        avail = pg.bundle_avail[pg_claim[1]]
+                        avail["CPU"] = avail.get("CPU", 0.0) - cpu
+                else:
+                    node.acquire({"CPU": cpu})
+        if blocked:
+            self._pump()
+
+    def _on_raylet_heartbeat(self, node_id: str, msg: dict) -> None:
+        stats = dict(msg.get("stats") or {})
+        age = float(msg.get("reconcile_age") or 0.0)
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            node.last_heartbeat = time.monotonic()
+            node.raylet_stats = stats
+            node.raylet_reconcile_age = age
+        if GLOBAL_CONFIG.metrics_enabled:
+            sid = node_id[:8]
+            mcat.get("rtpu_raylet_queue_depth").set(
+                float(stats.get("queued", 0)), tags={"node": sid})
+            mcat.get("rtpu_raylet_reconcile_age_seconds").set(
+                age, tags={"node": sid})
+
+    def _on_raylet_lease_return(self, node_id: str, msg: dict) -> None:
+        """A raylet handing back leases it never started (idle shedding
+        / clean shutdown): requeue them with no retry consumed."""
+        returned = 0
+        with self.cv:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            for tid in msg.get("task_ids", ()):
+                spec = node.leases_out.pop(tid, None)
+                if spec is None:
+                    continue
+                self.running.pop(tid, None)
+                self._release_task_resources(spec)
+                spec.pop("_lease_q", None)
+                spec.pop("_lease_shape", None)
+                if not spec.get("cancelled"):
+                    self._push_pending_left(spec)
+                    returned += 1
+            self.cv.notify_all()
+        if returned and GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_raylet_leases_total").inc(
+                returned, tags={"event": "returned"})
+        self._pump()
+
+    def _on_raylet_workers(self, node_id: str, msg: dict) -> None:
+        """Post-head-restart roster re-announce: adopt the raylet's
+        surviving workers onto its NEW node id (their own register_client
+        reconnects may have parked them on the head node)."""
+        with self.cv:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            for went in msg.get("workers", ()):
+                wid = went.get("worker_id")
+                if not wid:
+                    continue
+                w = self.workers.get(wid)
+                if w is None:
+                    w = WorkerState(wid, node_id, went.get("pid", 0))
+                    self.workers[wid] = w
+                else:
+                    old = self.nodes.get(w.node_id)
+                    if old is not None and old is not node:
+                        old.workers.discard(wid)
+                    w.node_id = node_id
+                node.workers.add(wid)
+            self.cv.notify_all()
 
     def _attach_worker_ctl(self, worker_id: str, conn) -> None:
         """Register a worker's out-of-band control connection (cancel /
@@ -2078,29 +2678,13 @@ class GcsServer:
             w.blocked = False
             # store results
             if msg["status"] == "ok":
-                for oid, res in zip(spec["return_ids"], msg["results"]):
-                    meta = self._get_or_create_meta(oid)
-                    if meta.refcount <= 0 and not spec.get("is_reconstruction"):
-                        meta.refcount += 1  # owner's initial reference
-                    if res["loc"] == "shm":
-                        self.store.adopt(oid, res.get("size", 0))
-                    self._seal_object(
-                        oid, res["loc"], res.get("data"), res.get("size", 0),
-                        spec.get("_node") or w.node_id, res.get("contained", []),
-                        lineage_task=spec["task_id"])
-                self.lineage[spec["task_id"]] = {
-                    k: v for k, v in spec.items() if not k.startswith("_")}
-                self.lineage_order.append(spec["task_id"])
-                if len(self.lineage) > self.lineage_order.maxlen:
-                    live = set(self.lineage_order)
-                    for tid in [t for t in self.lineage if t not in live]:
-                        self.lineage.pop(tid, None)
-                self._release_deps(spec)
-                self._count_task_terminal("ok")
+                self._finish_task_ok_locked(spec, msg["results"], w.node_id)
             elif msg["status"] == "app_error":
                 retries = spec.get("max_retries", 0) if spec.get("retry_exceptions") \
                     else 0
-                if retries and spec.get("attempt", 0) < retries:
+                # retries < 0 = infinite (same contract as system retries)
+                if retries and (retries < 0
+                                or spec.get("attempt", 0) < retries):
                     spec2 = dict(spec)
                     spec2["attempt"] = spec.get("attempt", 0) + 1
                     self._push_pending(spec2)
@@ -2195,11 +2779,18 @@ class GcsServer:
             else:
                 spec = w.current_task
                 w.current_task = None
+                if spec is None:
+                    # raylet-dispatched creation: the GCS never tracked a
+                    # current_task — the creation claim lives on the
+                    # actor spec (same dict the lease granted)
+                    spec = a.spec
                 if spec is not None:
                     self._release_task_resources(spec)
                 w.state = "idle"
                 node = self.nodes.get(w.node_id)
-                if node is not None:
+                if node is not None and node.raylet_conn is None:
+                    # raylet workers never enter the head's idle pool —
+                    # the raylet owns their local scheduling
                     node.idle_workers.append(worker_id)
                 a.state = A_DEAD
                 a.death_reason = "creation failed"
@@ -2870,6 +3461,28 @@ class GcsServer:
             entry = self.running.get(tid)
             if entry is not None:
                 wid, spec = entry
+                if wid.startswith("raylet:"):
+                    # leased to a raylet: revoke there.  A queued lease
+                    # never started — settle it here and now; a running
+                    # one gets the in-worker cancel via the raylet.
+                    node = None
+                    for n in self.nodes.values():
+                        if tid in n.leases_out:
+                            node = n
+                            break
+                    spec["cancelled"] = True
+                    if node is not None and spec.get("_lease_q"):
+                        node.leases_out.pop(tid, None)
+                        self.running.pop(tid, None)
+                        self._fail_task(spec, exc.TaskCancelledError(tid))
+                        node.push_raylet({"kind": "lease_revoke",
+                                          "rid": None, "task_ids": [tid]})
+                        self.cv.notify_all()
+                        return {"cancelled": "pending"}
+                    if node is not None:
+                        node.push_raylet({"kind": "lease_revoke",
+                                          "rid": None, "task_ids": [tid]})
+                    return {"cancelled": "signalled"}
                 w = self.workers.get(wid)
                 if msg.get("force"):
                     if w is not None and w.proc is not None:
@@ -2949,7 +3562,7 @@ class GcsServer:
             except OSError:
                 pass
         elif w is not None:
-            w.push_ctl({"kind": "stop_worker"})
+            self._push_worker_ctl(w, {"kind": "stop_worker"})
         with self.cv:
             if a.state in (A_PENDING, A_RESTARTING) and msg.get("no_restart", True):
                 # not yet running anywhere: cancel the pending creation
@@ -3123,7 +3736,29 @@ class GcsServer:
                                      data_proto=int(msg.get("data_proto")
                                                     or 0))
         self._pump()
-        return {"node_id": nid}
+        # session name: same-host raylets drop their flight-recorder
+        # rings into this session's tmpfs dir so `debug dump` sees them
+        return {"node_id": nid, "session": self.session.path.name}
+
+    def _h_raylet_table(self, msg: dict) -> dict:
+        """Per-node local-scheduler state for `ray_tpu status` and
+        `debug dump`: held leases, local queue depth, reconcile age."""
+        with self.lock:
+            rows = []
+            for n in self.nodes.values():
+                if n.raylet_conn is None and not n.raylet_stats:
+                    continue
+                rows.append({
+                    "node_id": n.node_id,
+                    "alive": n.alive,
+                    "attached": n.raylet_conn is not None,
+                    "held_leases": len(n.leases_out),
+                    "queued_leases": n.queued_lease_count(),
+                    "last_reconcile_age_s": round(
+                        n.raylet_reconcile_age, 3),
+                    "stats": dict(n.raylet_stats),
+                })
+            return {"raylets": rows}
 
     def _h_remove_node(self, msg: dict) -> dict:
         self.remove_node_internal(msg["node_id"])
@@ -3445,11 +4080,15 @@ class GcsServer:
         with self.cv:
             self._stack_reqs.append(collected)
             targets = [w for w in self.workers.values()
-                       if w.state in ("idle", "busy", "actor")
-                       and w.task_conn is not None]
+                       if (w.state in ("idle", "busy", "actor")
+                           and w.task_conn is not None)
+                       or (w.state in ("starting", "actor")
+                           and self.nodes.get(w.node_id) is not None
+                           and self.nodes[w.node_id].raylet_conn
+                           is not None)]
         try:
             targets = [w for w in targets
-                       if w.push_ctl({"kind": "dump_stack"})]
+                       if self._push_worker_ctl(w, {"kind": "dump_stack"})]
             deadline = time.time() + float(msg.get("timeout", 3.0))
             with self.cv:
                 while len(collected) < len(targets):
@@ -3472,7 +4111,8 @@ class GcsServer:
         exactly like live ones — no cooperation needed."""
         from ray_tpu._private import flight_recorder
         return {"procs": flight_recorder.collect(
-            self.session.path, tail=int(msg.get("tail", 200)))}
+            self.session.path, tail=int(msg.get("tail", 200))),
+            "raylets": self._h_raylet_table({})["raylets"]}
 
     def _h_ping(self, msg: dict) -> dict:
         return {"pong": True, "time": time.time()}
@@ -3484,6 +4124,10 @@ class GcsServer:
             _INPROC_SERVER = None
         self._shutdown = True
         with self.cv:
+            # tell attached raylets to tear their nodes down cleanly
+            for n in self.nodes.values():
+                if n.raylet_conn is not None:
+                    n.push_raylet({"kind": "raylet_stop", "rid": None})
             procs = [w.proc for w in self.workers.values() if w.proc is not None]
             # proc-less workers (reattached after a head restart) have no
             # pid here to signal — tell them to stop so they don't sit in
@@ -3491,7 +4135,7 @@ class GcsServer:
             for w in self.workers.values():
                 if w.proc is None and w.state not in ("driver", "dead"):
                     try:
-                        w.push_ctl({"kind": "stop_worker"})
+                        self._push_worker_ctl(w, {"kind": "stop_worker"})
                     except Exception:  # noqa: BLE001 - already gone
                         pass
             self.cv.notify_all()

@@ -33,7 +33,8 @@ GCS_LOCK_DAG: Dict[str, Set[str]] = {
     "_persist_lock": {"lock"},   # snapshot writer: capture under the
     #                              global lock, write under persist only
     "lock": {"_waiter_lock", "_kv_lock", "_events_lock",
-             "_peer_delete_lock", "task_conn_lock", "ctl_conn_lock"},
+             "_peer_delete_lock", "task_conn_lock", "ctl_conn_lock",
+             "raylet_conn_lock"},
     "_waiter_lock": set(),
     "_kv_lock": set(),
     "_events_lock": set(),
@@ -41,6 +42,10 @@ GCS_LOCK_DAG: Dict[str, Set[str]] = {
     "_peer_delete_lock": set(),
     "task_conn_lock": set(),
     "ctl_conn_lock": set(),
+    # per-NodeState raylet lease-channel push lock: lease_grant /
+    # lease_revoke pushes ride the scheduler's critical section exactly
+    # like worker task pushes (bounded local-pipe sends, §4c)
+    "raylet_conn_lock": set(),
 }
 
 # Leaf locks whose critical sections must stay O(dict op): calling a
@@ -63,8 +68,11 @@ GCS_CV_ALIASES: Dict[str, str] = {"cv": "lock"}
 WORKER_LOCK_DAG: Dict[str, Set[str]] = {
     "_release_lock": {"_submit_lock"},       # _drain_pending_pins
     # _drain_submits pop→send, and the send may first-dial the shared
-    # oneway channel (rpc_oneway's lazy init) while serialized
-    "_submit_send_lock": {"_submit_lock", "_oneway_init_lock"},
+    # oneway channel (rpc_oneway's lazy init) while serialized; the
+    # raylet release route sits on the same rpc_oneway path (the
+    # submit_batch kind never takes it, but the helper edge must be legal)
+    "_submit_send_lock": {"_submit_lock", "_oneway_init_lock",
+                          "_raylet_ref_lock"},
     "_submit_lock": set(),
     "_local_lock": set(),
     "_actor_chan_lock": set(),
@@ -72,6 +80,9 @@ WORKER_LOCK_DAG: Dict[str, Set[str]] = {
     "_owned_lock": set(),
     "_oneway_init_lock": set(),
     "_task_conn_lock": set(),
+    # local-raylet release routing (one conn, lazily dialed + sent
+    # under this lock; a bounded unix-pipe send by design)
+    "_raylet_ref_lock": set(),
 }
 
 WORKER_NOBLOCK_LOCKS: Set[str] = {
@@ -120,6 +131,21 @@ LLM_ENGINE_LOCK_DAG: Dict[str, Set[str]] = {
 }
 
 LLM_ENGINE_CV_ALIASES: Dict[str, str] = {}
+
+# Raylet (raylet.py, DESIGN.md §4i): ``_lock`` guards the local
+# scheduler tables (queue, slots, done batch, ref nets, stats); worker
+# pushes deliberately ride it through the per-slot conn locks (bounded
+# local-pipe sends, the same §4c argument as GCS task pushes).
+# ``_up_lock`` serializes upstream lease-channel sends and is a leaf:
+# flushers collect under _lock, send under _up_lock, never nested.
+RAYLET_LOCK_DAG: Dict[str, Set[str]] = {
+    "_lock": {"conn_lock", "ctl_conn_lock"},
+    "_up_lock": set(),
+    "conn_lock": set(),
+    "ctl_conn_lock": set(),
+}
+
+RAYLET_CV_ALIASES: Dict[str, str] = {}
 
 
 def reachable(dag: Dict[str, Set[str]]) -> Dict[str, Set[str]]:

@@ -1,13 +1,18 @@
 """NodeAgent: join this host to a remote head as a worker node.
 
-Reference analog: the raylet — the per-host daemon owning that host's
-worker pool (SURVEY.md §2.1).  The agent dials the head's client-proxy
-port (per-session HMAC auth via RTPU_AUTH_KEY), registers a node with this
-host's resources, and maintains a static pool of worker processes that
-connect back through the same tunnel.  The head schedules tasks AND
-actors onto the node like any other; task args/results ride the control
-plane (a remote host cannot mmap the head's /dev/shm — the same transport
-the remote client uses).  Actors here listen on ephemeral TCP ports and
+Reference analog: the raylet's process-management half (SURVEY.md §2.1).
+The agent dials the head's client-proxy port (per-session HMAC auth via
+RTPU_AUTH_KEY), registers a node with this host's resources, and
+maintains a pool of worker processes.  Against a head that speaks
+``wire.PROTO_RAYLET`` it promotes itself into a **raylet**
+(``_private/raylet.py``, DESIGN.md §4i): a per-node local scheduler that
+claims worker leases in bulk, dispatches intra-node tasks without a head
+round-trip, nets owner-local refcount releases, and uses ONE keepalive
+channel (the lease channel's heartbeat) for node liveness.  Against an
+older head — or with ``raylet_enabled=0`` — it falls back byte-identical
+to the legacy mode: workers attach their task conns straight to the GCS
+through the tunnel and a dedicated ``agent_attach`` conn carries
+liveness.  Actors in both modes listen on ephemeral TCP ports and
 advertise ``tcp://<this-host>:<port>`` addresses; callers dial them
 directly, or relay through the head's client proxy when sibling hosts
 aren't mutually reachable.
@@ -61,21 +66,50 @@ class NodeAgent:
             # data_proto advertises this host's data-plane wire ceiling
             # so the head's pooled pull/delete conns skip the per-conn
             # hello (an old head ignores the extra field)
-            resp = self._chan.call(
-                "add_node", resources=res, labels=all_labels, remote=True,
-                data_addr=self._data_plane.advertise_addr,
-                data_proto=wire.DATA_PROTO_MAX)
+            node_info = dict(resources=res, labels=all_labels, remote=True,
+                             data_addr=self._data_plane.advertise_addr,
+                             data_proto=wire.DATA_PROTO_MAX)
+            resp = self._chan.call("add_node", **node_info)
             self.node_id = resp["node_id"]
-            # dedicate this connection to liveness: the head removes the
-            # node when it drops (kill -9 / host crash / partition)
-            self._chan.send_oneway("agent_attach", node_id=self.node_id)
             self._procs: List[subprocess.Popen] = []
+            self._extra_procs: List[subprocess.Popen] = []
             self._stop = threading.Event()
-            # watch the liveness conn from OUR side too: a dropped TCP
-            # conn makes the head remove the node; without this the agent
-            # would keep an orphaned pool running, silently detached
-            threading.Thread(target=self._liveness_watch, daemon=True,
-                             name="agent-liveness").start()
+            self.raylet = None
+            from ray_tpu._private.config import GLOBAL_CONFIG
+            if GLOBAL_CONFIG.raylet_enabled \
+                    and self._chan.version >= wire.PROTO_RAYLET:
+                # Promote to a raylet (DESIGN.md §4i): the add_node conn
+                # becomes the lease channel — grants down, batched
+                # results/refcount reconciliation/heartbeats up.  It is
+                # ALSO the node's one liveness path (keepalive dedup:
+                # no separate agent_attach conn, no _liveness_watch).
+                from ray_tpu._private import flight_recorder, raylet
+                sess = resp.get("session")
+                if sess:
+                    # same-host rings land in the head session's tmpfs
+                    # dir (flight_dir_for keys on the path NAME) so
+                    # `ray_tpu debug dump` collects them; the no-/dev/shm
+                    # fallback then writes under OUR spool dir, not "/"
+                    flight_recorder.maybe_install(
+                        os.path.join(self._spool_dir, str(sess)),
+                        "raylet")
+                self.raylet = raylet.Raylet(
+                    self.head, self.node_id, node_info,
+                    sock_dir=self._spool_dir,
+                    spawn_cb=self._spawn_extra,
+                    on_lost=self.stop,
+                    upstream_conn=self._conn,
+                    upstream_version=self._chan.version)
+            else:
+                # legacy path (old head / raylets disabled): dedicate
+                # this connection to liveness — the head removes the
+                # node when it drops (kill -9 / host crash / partition)
+                self._chan.send_oneway("agent_attach", node_id=self.node_id)
+                # watch the liveness conn from OUR side too: a dropped
+                # TCP conn makes the head remove the node; without this
+                # the agent would keep an orphaned pool running
+                threading.Thread(target=self._liveness_watch, daemon=True,
+                                 name="agent-liveness").start()
             # per-node OOM killer (reference: MemoryMonitor runs inside
             # each raylet): THIS host's pressure, THIS host's pids.
             # Victim policy stays with the head (pick_oom_victim RPC)
@@ -192,6 +226,11 @@ class NodeAgent:
         env["RTPU_ADVERTISE_HOST"] = self._advertise_host()
         env["RTPU_SPOOL_DIR"] = self._spool_dir
         env["RTPU_DATA_ADDR"] = self._data_plane.advertise_addr
+        if self.raylet is not None:
+            # workers attach task/ctl conns to the LOCAL raylet socket
+            # (and route release oneways there for netting) instead of
+            # tunneling every frame to the head
+            env["RTPU_RAYLET_SOCK"] = self.raylet.sock_path
         if tpu:
             # device-holding worker: jax initializes the real platform
             env["RTPU_TPU_WORKER"] = "1"
@@ -208,6 +247,14 @@ class NodeAgent:
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env, stdout=sink, stderr=sink)
 
+    def _spawn_extra(self) -> None:
+        """Raylet callback: fork one replacement worker (pool blocked in
+        get() with leased work queued).  Not respawned on exit — the
+        base pool slots are the durable capacity."""
+        if self._stop.is_set():
+            return
+        self._extra_procs.append(self._spawn())
+
     def run(self) -> None:
         """Maintain the pool until stopped; respawn dead workers with
         exponential backoff (a head outage or startup import error must
@@ -219,6 +266,9 @@ class NodeAgent:
         backoff = [1.0] * len(self._procs)
         while not self._stop.is_set():
             time.sleep(0.5)
+            # reap finished replacement workers (no respawn)
+            self._extra_procs = [p for p in self._extra_procs
+                                 if p.poll() is None]
             for i, p in enumerate(self._procs):
                 if p.poll() is None or self._stop.is_set():
                     continue
@@ -241,21 +291,29 @@ class NodeAgent:
 
     def stop(self) -> None:
         self._stop.set()
-        for p in self._procs:
+        if self.raylet is not None:
+            # clean leave: the raylet flushes its unsettled results and
+            # netted releases, RETURNS unstarted leases, and detaches —
+            # the head reclaims nothing by death-detection and removes
+            # the node itself (no remove_node RPC needed)
+            self.raylet.stop()
+        for p in self._procs + self._extra_procs:
             try:
                 p.terminate()
             except OSError:
                 pass
-        ch = None
-        try:  # fresh conn: the attach conn is dedicated to liveness
-            ch = protocol.RpcChannel(
-                protocol.tunnel_connect(*self.head, "gcs"), negotiate=True)
-            ch.call("remove_node", node_id=self.node_id)
-        except Exception:  # noqa: BLE001 - head may already be gone
-            pass
-        finally:
-            if ch is not None:
-                ch.close()
+        if self.raylet is None:
+            ch = None
+            try:  # fresh conn: the attach conn is dedicated to liveness
+                ch = protocol.RpcChannel(
+                    protocol.tunnel_connect(*self.head, "gcs"),
+                    negotiate=True)
+                ch.call("remove_node", node_id=self.node_id)
+            except Exception:  # noqa: BLE001 - head may already be gone
+                pass
+            finally:
+                if ch is not None:
+                    ch.close()
         try:
             self._conn.close()
         except OSError:

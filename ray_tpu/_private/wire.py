@@ -40,7 +40,8 @@ from typing import Any, Tuple
 
 PROTO_MIN = 1   # framed, pickle codec only
 PROTO_TRACE = 3  # understands the optional TRACE_FIELD on any frame
-PROTO_MAX = 3   # framed, rtmsg codec + pickle fallback + trace field
+PROTO_RAYLET = 4  # speaks the raylet lease kinds (RAYLET_KINDS below)
+PROTO_MAX = 4   # framed, rtmsg + pickle fallback + trace + raylet leases
 _PICKLE_OPCODE = 0x80  # first byte of every pickle protocol>=2 stream
 
 # Optional span-context frame field (Dapper-style wire propagation):
@@ -222,6 +223,47 @@ REF_KINDS = frozenset({
     "release_batch",
     "release_all",
 })
+
+# ------------------------------------------------------- raylet lease plane
+# Per-node local schedulers (``_private/raylet.py``, DESIGN.md §4i;
+# reference analog: ``src/ray/raylet/`` NodeManager + LocalTaskManager).
+# A raylet converts one GCS connection into a bidirectional lease channel
+# with ``raylet_attach`` and from then on the channel carries ONLY these
+# kinds — none of them ever appears on a connection that negotiated
+# < PROTO_RAYLET, so old peers see byte-identical traffic (the PR-4/PR-7
+# hello pattern).  All lease frames are oneways (rid None): the channel
+# is a stream in both directions, never request/response — loss of the
+# channel IS the failure signal (lease reclaim / node removal).
+#
+# Declared here, next to the frame schema, because it is a wire-level
+# contract: tools/rtlint's wire pass asserts every kind has exactly one
+# GCS dispatch arm (downstream set) or raylet dispatch arm (upstream
+# set) plus a producer on the other side.
+# One kind per line (line-anchored waivers, like REF_KINDS).
+
+# GCS -> raylet pushes:
+RAYLET_DOWN_KINDS = frozenset({
+    "lease_grant",     # bulk block of task specs + their resource claims
+    "lease_revoke",    # cancel: drop queued / cancel running specs
+    "worker_ctl",      # forward an OOB ctl frame to a local worker
+    "raylet_stop",     # clean shutdown request (head shutting down)
+})
+# raylet -> GCS reports:
+RAYLET_UP_KINDS = frozenset({
+    "raylet_attach",       # converts the conn (carried at >= PROTO_RAYLET)
+    "raylet_done_batch",   # batched task completions + lease handoffs
+    "raylet_ref_batch",    # netted owner-local refcount deltas (reconcile)
+    "raylet_lease_return", # unstarted leases given back (idle / shutdown)
+    "raylet_fwd",          # verbatim worker event (actor_ready, logs, ...)
+    "raylet_worker_died",  # local worker process death (ledger cleanup)
+    "raylet_task_blocked",   # leased task parked in get(): CPU released
+    "raylet_task_unblocked", # ... and re-acquired
+    "raylet_heartbeat",    # keepalive + local scheduler stats (the ONE
+    #                        liveness path in raylet mode: no agent_attach)
+    "raylet_workers",      # worker roster re-announce after a head restart
+    "raylet_detach",       # clean leave: reclaim leases, remove the node
+})
+RAYLET_KINDS = RAYLET_DOWN_KINDS | RAYLET_UP_KINDS
 
 # ------------------------------------------------------------ bulk frames
 # Data-plane streaming (``_private/data_plane.py``): after a

@@ -255,6 +255,16 @@ class Worker:
         # pooled data-plane connections for peer pulls (dial+HMAC paid
         # once per holder, not once per object); thread-safe internally
         self._data_pool = data_plane.DataPlanePool(dial=self._dial_data)
+        # Raylet attachment (DESIGN.md §4i): spawned workers on a raylet
+        # node dial the LOCAL per-node scheduler for their task/ctl
+        # channels and route release oneways to it for netting, instead
+        # of tunneling every frame to the head.  Absent env (no raylet
+        # advertised — single-process tests, cluster_utils.Cluster,
+        # legacy agents) → direct-GCS, byte-identical to before.
+        self.raylet_sock = (os.environ.get("RTPU_RAYLET_SOCK")
+                            if role == "worker" else None)
+        self._raylet_ref_conn = None
+        self._raylet_ref_lock = threading.Lock()
         self.ctx = _TaskContext()
         self._pid = os.getpid()  # cached: getpid is a real syscall per call
         self._ctl_down = True    # flipped by the ctl thread on attach
@@ -407,8 +417,13 @@ class Worker:
             # reconnecting to a restarted GCS).  _reconnect=False callers
             # (best-effort telemetry) must never drive the heal themselves:
             # a background pool.invalidate() can yank a channel the MAIN
-            # thread's reconnect dance just re-established.
-            if self.is_client or self._stop.is_set() or not _reconnect:
+            # thread's reconnect dance just re-established.  Remote-agent
+            # WORKERS (is_client but role=worker) do heal: on a raylet
+            # node the task conn is local and never notices a head
+            # restart, so the tunneled rpc pool must reconnect on its
+            # own — only interactive CLIENTS surface the break.
+            if (self.is_client and self.role != "worker") \
+                    or self._stop.is_set() or not _reconnect:
                 raise
             self._reconnect_pool()
             return self.pool.call(kind, client_id=self.worker_id, **fields)
@@ -451,6 +466,15 @@ class Worker:
             except Exception:  # noqa: BLE001 - oneway: log like the server
                 logger.exception("local one-way rpc %s failed", kind)
             return
+        if self.raylet_sock is not None and kind in (
+                "release", "release_batch"):
+            # owner-local refcount batch (§4i): the raylet nets these and
+            # reconciles to the GCS ledger asynchronously.  Releases only
+            # — delaying a release is categorically safe (it can only
+            # delay a free); pins keep their direct-channel ordering.
+            if self._send_raylet_ref(kind, fields):
+                return
+            # raylet gone (node tearing down): fall through to direct
         ch = self._oneway_chan
         if ch is None:
             with self._oneway_init_lock:
@@ -464,6 +488,30 @@ class Worker:
         except (OSError, ValueError, ConnectionError):
             self._oneway_chan = None  # re-dial on next use
             raise
+
+    def _send_raylet_ref(self, kind: str, fields: dict) -> bool:
+        """Ship one release oneway to the local raylet's netting buffer.
+        Returns False (caller falls back to the direct channel) when the
+        raylet socket is unreachable."""
+        with self._raylet_ref_lock:
+            conn = self._raylet_ref_conn
+            try:
+                if conn is None:
+                    conn = protocol.connect(self.raylet_sock)
+                    self._raylet_ref_conn = conn  # owned before any send
+                    conn.send({"kind": "ref_chan",
+                               "worker_id": self.worker_id})
+                conn.send({"kind": kind, "client_id": self.worker_id,
+                           **fields})
+                return True
+            except (OSError, ValueError, EOFError):
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                self._raylet_ref_conn = None
+                return False
 
     def _tunnel(self, target: str):
         """Open a proxied connection to a cluster-local unix socket."""
@@ -1525,6 +1573,13 @@ class Worker:
             for ch in self._actor_channels.values():
                 ch.close()
             self._actor_channels.clear()
+        with self._raylet_ref_lock:
+            if self._raylet_ref_conn is not None:
+                try:
+                    self._raylet_ref_conn.close()
+                except OSError:
+                    pass
+                self._raylet_ref_conn = None
         self._data_pool.close_all()
         self.pool.close_all()
 
@@ -1567,6 +1622,14 @@ class Worker:
                 time.sleep(0.5)
         return None
 
+    def _dial_task_endpoint(self):
+        """The push channel's server: the node's local raylet when one
+        advertises (RTPU_RAYLET_SOCK — a unix dial even for otherwise
+        proxied remote workers), the GCS otherwise."""
+        if self.raylet_sock is not None:
+            return protocol.connect(self.raylet_sock)
+        return self.open_conn(self.gcs_path)
+
     def run_worker_loop(self) -> None:
         """Main loop of a spawned worker process.
 
@@ -1577,7 +1640,7 @@ class Worker:
         ``ctl`` connection whose dedicated reader thread stays responsive
         while a task runs; the same kinds are still honored here when they
         arrive on the task conn (ctl-attach race fallback)."""
-        conn = self.open_conn(self.gcs_path)
+        conn = self._dial_task_endpoint()
         conn.send({"kind": "attach_task_conn", "worker_id": self.worker_id})
         with self._task_conn_lock:
             self._task_conn = conn
@@ -1595,6 +1658,14 @@ class Worker:
                     msg = conn.recv()
                 except (EOFError, OSError):
                     if self._stop.is_set():
+                        break
+                    if self.raylet_sock is not None:
+                        # raylet gone = this node is being torn down (a
+                        # dead raylet never restarts in place; a HEAD
+                        # restart doesn't touch this local conn — the
+                        # raylet heals upstream on its own).  Exit; the
+                        # agent's pool loop forks replacements.
+                        self._stop.set()
                         break
                     # head gone: outlive it and reattach (GCS fault
                     # tolerance) — actors keep serving direct calls the
@@ -1655,6 +1726,10 @@ class Worker:
             except (EOFError, OSError):
                 if self._stop.is_set():
                     return
+                if self.raylet_sock is not None:
+                    # node teardown (see run_worker_loop): stop serving
+                    self._stop.set()
+                    return
                 conn = self._reattach_task_conn()
                 if conn is None:
                     self._stop.set()
@@ -1704,6 +1779,14 @@ class Worker:
                               "text": _dump_all_stacks()})
         elif kind == "stop_worker":
             self._stop.set()
+            srv = getattr(self, "_actor_server", None)
+            if srv is not None:
+                # actor worker: the main thread parks in serve_forever —
+                # stop the server (mechanics only; the control plane
+                # already holds the death reason + restart policy) so
+                # the process actually exits and direct callers fail
+                # over to the restarted incarnation
+                srv.stop_serving()
 
     def _open_ctl_conn(self) -> None:
         """Start the out-of-band control channel thread (idempotent).
@@ -1723,7 +1806,7 @@ class Worker:
         while not self._stop.is_set():
             if conn is None:
                 try:
-                    conn = self.open_conn(self.gcs_path)
+                    conn = self._dial_task_endpoint()
                     conn.send({"kind": "attach_worker_ctl",
                                "worker_id": self.worker_id})
                     self._ctl_down = False
@@ -1978,6 +2061,10 @@ class Worker:
             return False
         self._current_spec = None
         server = ActorServer(self, spec, instance)
+        # stop_worker must be able to stop the serve loop too: a
+        # proc-less (remote/raylet) actor worker has no head-side pid
+        # to signal, so ray_tpu.kill reaches it as an OOB ctl frame
+        self._actor_server = server
         # kept for GCS-restart reattach: the actor re-announces itself to
         # a fresh head with the same id + addr (state intact)
         self._actor_announce = {"actor_id": spec["actor_id"],

@@ -53,7 +53,10 @@ def main() -> None:
     proxy = os.environ.get("RTPU_PROXY_ADDR")
     if proxy:
         # remote-node worker (spawned by a NodeAgent on another host):
-        # all connections tunnel to the head; no local session/data plane
+        # RPCs tunnel to the head; no local session/data plane.  On a
+        # raylet node (RTPU_RAYLET_SOCK set) the task/ctl channels and
+        # release oneways instead attach to the LOCAL per-node scheduler
+        # (Worker reads the env; see _dial_task_endpoint / §4i).
         from ray_tpu._private import protocol
         protocol.set_authkey_from_env()
         host, _, port = proxy.partition(":")

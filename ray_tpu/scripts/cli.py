@@ -219,6 +219,13 @@ def cmd_debug(args) -> int:
     from ray_tpu._private import worker as _worker
     resp = _worker.global_worker().rpc("debug_dump", tail=args.tail)
     procs = resp.get("procs", {})
+    for r in resp.get("raylets", []):
+        print(f"----- raylet node {r['node_id'][:8]} "
+              f"{'attached' if r.get('attached') else 'DETACHED'}: "
+              f"held_leases={r.get('held_leases')} "
+              f"queued={r.get('queued_leases')} "
+              f"reconcile_age={r.get('last_reconcile_age_s')}s "
+              f"stats={r.get('stats')}")
     if args.output:
         with open(args.output, "w") as f:
             json.dump(procs, f, indent=2)

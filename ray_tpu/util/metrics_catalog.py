@@ -95,6 +95,34 @@ CATALOG: Dict[str, dict] = {
                     "per batch), 'inline' = per-call handler (in-process "
                     "short circuit / direct RPC)",
         emitted_by="head (GCS)"),
+    # --- raylet lease plane (raylet.py / gcs.py, DESIGN.md §4i) -------------
+    "rtpu_raylet_leases_total": dict(
+        kind="counter", tag_keys=("event",),
+        description="Worker-lease ledger events: 'granted' (specs shipped "
+                    "to a raylet in lease_grant blocks), 'done' (settled "
+                    "by raylet_done_batch), 'handoff' (lease inherited by "
+                    "a queued same-shape task with no head round-trip), "
+                    "'returned' (unstarted leases handed back), "
+                    "'reclaimed' (raylet death/detach reclaim)",
+        emitted_by="head (GCS)"),
+    "rtpu_raylet_ref_ops_total": dict(
+        kind="counter", tag_keys=("path",),
+        description="Owner-local refcount releases applied through raylet "
+                    "reconciliation ('reconciled' = netted worker releases "
+                    "shipped in raylet_ref_batch frames)",
+        emitted_by="head (GCS)"),
+    "rtpu_raylet_queue_depth": dict(
+        kind="gauge", tag_keys=("node",),
+        description="Local scheduler queue depth per raylet node "
+                    "(granted-but-undispatched leases; from "
+                    "raylet_heartbeat)",
+        emitted_by="head (GCS)"),
+    "rtpu_raylet_reconcile_age_seconds": dict(
+        kind="gauge", tag_keys=("node",),
+        description="Seconds since a raylet last reconciled its netted "
+                    "refcount deltas to the GCS ledger (from "
+                    "raylet_heartbeat)",
+        emitted_by="head (GCS)"),
     # --- P2P object plane (data_plane.py) -----------------------------------
     "rtpu_data_pull_seconds": dict(
         kind="histogram", tag_keys=("path",), buckets=LATENCY_BUCKETS,

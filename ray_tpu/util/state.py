@@ -65,6 +65,12 @@ def summarize_objects() -> Dict[str, Any]:
     }
 
 
+def list_raylets() -> List[dict]:
+    """Per-node local-scheduler state (held leases, local queue depth,
+    last-reconcile age) for nodes running a raylet (DESIGN.md §4i)."""
+    return _rpc("raylet_table")["raylets"]
+
+
 def cluster_summary() -> Dict[str, Any]:
     """One-call rollup used by `ray_tpu status`."""
     res = _rpc("cluster_resources")
@@ -75,6 +81,7 @@ def cluster_summary() -> Dict[str, Any]:
         "tasks": summarize_tasks(),
         "actors": summarize_actors(),
         "objects": summarize_objects(),
+        "raylets": list_raylets(),
     }
 
 

@@ -1,0 +1,34 @@
+"""rtlint fixture: POSITIVE for the lock-order rule under the RAYLET
+DAG (lock_watchdog.RAYLET_LOCK_DAG) — every method here acquires raylet
+locks in an order outside it.  Not a test module (no test_ prefix);
+exercised by tests/test_rtlint.py."""
+
+import threading
+
+
+class BadRayletLocks:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._up_lock = threading.Lock()
+
+    def send_under_scheduler_lock(self):
+        # upstream sends must NEVER ride the scheduler's critical
+        # section: collect under _lock, send under _up_lock
+        with self._lock:
+            with self._up_lock:
+                pass
+
+    def scheduler_under_up(self):
+        # ...and the reverse is equally outside the DAG
+        with self._up_lock:
+            with self._lock:
+                pass
+
+    def via_helper(self):
+        # the edge is created through a local helper call
+        with self._lock:
+            self._helper()
+
+    def _helper(self):
+        with self._up_lock:
+            pass

@@ -1,0 +1,26 @@
+"""rtlint fixture: NEGATIVE for the lock-order rule under the RAYLET
+DAG — the collect-under-_lock / send-under-_up_lock discipline the real
+raylet follows, plus the legal slot-push edge."""
+
+import threading
+
+
+class OkRayletLocks:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._up_lock = threading.Lock()
+        self.conn_lock = threading.Lock()
+        self._batch = []
+
+    def collect_then_send(self):
+        with self._lock:
+            batch, self._batch = self._batch, []
+        with self._up_lock:
+            del batch  # stand-in for the upstream conn_send
+
+    def push_under_scheduler(self):
+        # worker pushes ride the scheduler lock via the per-slot conn
+        # lock — a declared DAG edge (bounded local-pipe sends)
+        with self._lock:
+            with self.conn_lock:
+                pass

@@ -179,6 +179,74 @@ def test_chaos_flight_recorder_survives_sigkill(monkeypatch):
             rs.uninstall()
 
 
+def test_chaos_sigkill_raylet_mid_lease_block(monkeypatch):
+    """Chaos × the raylet lease protocol (DESIGN.md §4i) under BOTH
+    runtime oracles: SIGKILL the raylet while it holds a granted lease
+    block.  The GCS must reclaim every outstanding lease (queued ones
+    re-dispatch free, running ones retry), remove the node, and end with
+    zero net resources — the lock watchdog asserts the reclaim path's
+    acquisition order live and the sanitizer asserts no head-side leak
+    at shutdown."""
+    from ray_tpu._private import resource_sanitizer as rs
+    from test_raylet import _start_agent, _wait_raylet_attached
+
+    monkeypatch.setenv("RAY_TPU_RESOURCE_SANITIZER", "1")
+    monkeypatch.setenv("RAY_TPU_LOCK_WATCHDOG", "1")
+    # head keeps one CPU so reclaimed leases have somewhere to land
+    ray_tpu.init(num_cpus=1)
+    proxy = agent = None
+    try:
+        proxy, agent, node_id = _start_agent(num_cpus=2)
+        _wait_raylet_attached()
+
+        @ray_tpu.remote(max_retries=-1)
+        def work(i):
+            time.sleep(0.1)
+            return i * 3
+
+        refs = [work.remote(i) for i in range(24)]
+        # wait until the raylet actually HOLDS a lease block
+        deadline = time.time() + 60 * time_scale()
+        held = 0
+        while time.time() < deadline:
+            rows = state.list_raylets()
+            held = rows[0]["held_leases"] if rows else 0
+            if held > 0:
+                break
+            time.sleep(0.1)
+        assert held > 0, "raylet never held a lease"
+        os.kill(agent.pid, signal.SIGKILL)
+        agent.wait(timeout=15)
+        # every task still completes: queued leases re-dispatched,
+        # running ones retried on the surviving head pool
+        assert ray_tpu.get(refs, timeout=240 * time_scale()) == \
+            [i * 3 for i in range(24)]
+        # the node is gone and the ledger is back to zero net resources
+        deadline = time.time() + 60 * time_scale()
+        while time.time() < deadline:
+            nodes = [n for n in state.list_nodes()
+                     if n["node_id"] == node_id and n["alive"]]
+            res = state._rpc("cluster_resources")
+            balanced = res["total"].get("CPU") == \
+                res["available"].get("CPU")
+            if not nodes and balanced:
+                break
+            time.sleep(0.3)
+        assert not nodes, "dead raylet's node still alive"
+        assert balanced, res
+    finally:
+        try:
+            if agent is not None and agent.poll() is None:
+                agent.kill()
+            if proxy is not None:
+                proxy.stop()
+        finally:
+            try:
+                ray_tpu.shutdown()  # sanitizer: zero net leaked resources
+            finally:
+                rs.uninstall()
+
+
 def test_chaos_kill_leaves_no_net_resources(monkeypatch):
     """Chaos × leak oracle (DESIGN.md §4f): SIGKILLing a worker mid-
     workload must not leak head-side resources — the dead peer's

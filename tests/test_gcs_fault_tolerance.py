@@ -44,6 +44,122 @@ def _spawn_head(session_dir: str = "-") -> subprocess.Popen:
     return proc, line[len("SESSION:"):].strip()
 
 
+def test_head_restart_while_raylet_holds_leases():
+    """GCS fault tolerance × the raylet lease protocol (DESIGN.md §4i):
+    kill -9 the head while a raylet holds a granted lease block.  The
+    raylet must outlive it, rejoin the restarted head (re-add_node +
+    raylet_attach + worker-roster re-announce) and re-report its ledger
+    deltas (unsettled done entries, netted releases) on the new channel;
+    in-flight work completes and fresh work lands on the re-joined node."""
+    import subprocess as sp
+
+    from ray_tpu._private.session import Session
+    from ray_tpu.util import state
+    from ray_tpu.util.client import ClientProxyServer
+
+    head1, session_dir = _spawn_head()
+    proxy = agent = head2 = None
+    try:
+        ray_tpu.init(address=session_dir)
+        root, name = os.path.split(session_dir)
+        session = Session(root=root, name=name)
+        # the proxy lives in THIS process: it survives the head kill and
+        # relays the raylet's re-dials to the restarted head's socket
+        proxy = ClientProxyServer(session, host="127.0.0.1", port=0)
+        port = proxy._listener.address[1]
+        env = dict(os.environ)
+        env["RTPU_AUTH_KEY"] = session.auth_key().hex()
+        env.pop("RTPU_SESSION_DIR", None)
+        agent = sp.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_agent",
+             "--address", f"127.0.0.1:{port}", "--num-cpus", "2"],
+            env=env, cwd="/root/repo")
+
+        def raylet_row(require_attached=True, timeout=60):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                try:
+                    rows = [r for r in state.list_raylets()
+                            if r["attached"] or not require_attached]
+                except ray_tpu.exceptions.RayTpuError:
+                    rows = []
+                if rows:
+                    return rows[0]
+                time.sleep(0.3)
+            raise AssertionError("raylet never attached")
+
+        row1 = raylet_row()
+        node1 = row1["node_id"]
+
+        # retry_exceptions: a task whose put() RPC races the head's
+        # downtime window surfaces a ConnectionError as an app error —
+        # that attempt must retry, not seal
+        @ray_tpu.remote(max_retries=-1, retry_exceptions=True)
+        def work(i):
+            time.sleep(0.4)
+            # a put+drop leaves netted releases in the raylet's buffer
+            # for the post-restart ledger-delta re-report
+            r = ray_tpu.put(i)
+            del r
+            return i * 5
+
+        refs = [work.remote(i) for i in range(10)]
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if raylet_row()["held_leases"] > 0:
+                break
+            time.sleep(0.1)
+        assert raylet_row()["held_leases"] > 0
+
+        os.kill(head1.pid, signal.SIGKILL)
+        head1.wait(timeout=10)
+        time.sleep(0.5)
+        head2, _ = _spawn_head(session_dir)
+
+        # the raylet rejoins under a FRESH node id and re-reports
+        row2 = raylet_row(timeout=90)
+        assert row2["node_id"] != node1, "raylet did not re-join"
+
+        # in-flight work completes across the restart (owner-based
+        # resubmission + the raylet's done re-flush tolerate each other)
+        assert ray_tpu.get(refs, timeout=240) == [i * 5 for i in range(10)]
+
+        # the surviving workers were adopted onto the new node (roster
+        # re-announce), and fresh pinned work runs there
+        from ray_tpu.util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
+        pin = NodeAffinitySchedulingStrategy(row2["node_id"])
+
+        @ray_tpu.remote(scheduling_strategy=pin, max_retries=-1)
+        def where():
+            return os.environ.get("RTPU_RAYLET_SOCK") is not None
+
+        assert ray_tpu.get(where.remote(), timeout=120)
+        # and the re-attached raylet keeps reconciling (heartbeat stats
+        # flow on the new channel)
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline and not ok:
+            s = raylet_row()["stats"]
+            ok = s.get("done", 0) > 0
+            time.sleep(0.3)
+        assert ok, "re-attached raylet never settled a lease"
+    finally:
+        if agent is not None:
+            agent.terminate()
+            try:
+                agent.wait(timeout=30)
+            except sp.TimeoutExpired:
+                agent.kill()
+        if proxy is not None:
+            proxy.stop()
+        for hp in (head1, head2):
+            if hp is not None and hp.poll() is None:
+                hp.kill()
+                hp.wait(timeout=10)
+        ray_tpu.shutdown()
+
+
 def test_gcs_restart_preserves_actors_pgs_and_objects():
     head1, session_dir = _spawn_head()
     try:

@@ -302,6 +302,66 @@ def test_seeded_reply_hole_is_caught():
         _os.unlink(path)
 
 
+# ------------------------------------------------------ raylet coverage
+def test_raylet_lock_order_flags_positive_fixture():
+    """The lock-order pass covers raylet.py with its own DAG: sends
+    under the scheduler lock (and the inversion, and the helper-
+    propagated edge) are findings."""
+    from tools.rtlint.lockorder import raylet_spec
+    found = check_locks(load(FIX / "raylet_lock_bad.py"), raylet_spec())
+    assert _rules(found) == {"lock-order"}
+    assert len(found) >= 3, found
+
+
+def test_raylet_lock_order_silent_on_negative_fixture():
+    from tools.rtlint.lockorder import raylet_spec
+    found = check_locks(load(FIX / "raylet_lock_ok.py"), raylet_spec())
+    assert found == [], found
+
+
+def test_raylet_dag_is_the_watchdog_dag():
+    from ray_tpu._private import lock_watchdog as lw
+    from tools.rtlint.lockorder import raylet_spec
+    spec = raylet_spec()
+    assert spec.dag is lw.RAYLET_LOCK_DAG
+    reach = lw.reachable(lw.RAYLET_LOCK_DAG)
+    for lock, succ in reach.items():
+        assert lock not in succ, f"cycle through {lock}"
+
+
+def test_wire_raylet_kind_without_endpoints_is_caught(tmp_path):
+    """A RAYLET_*_KINDS entry with no dispatch arm / producer in the
+    two lease endpoints is a wire finding (seeded witness: a fake kind
+    in a scratch tree)."""
+    (tmp_path / "wire.py").write_text(
+        'RAYLET_DOWN_KINDS = frozenset({\n    "lease_bogus",\n})\n'
+        'RAYLET_UP_KINDS = frozenset({\n    "raylet_bogus",\n})\n')
+    (tmp_path / "gcs.py").write_text("def nothing():\n    pass\n")
+    (tmp_path / "raylet.py").write_text("def nothing():\n    pass\n")
+    cfg = WireConfig(
+        wire_path=tmp_path / "wire.py", server_paths=[],
+        producer_paths=[], c_paths=[], dedup_path=None,
+        ref_dispatch="_apply_ref_op_locked", extra_handlers={})
+    found = check_wire(cfg)
+    rules = {(f.rule, "bogus" in f.message) for f in found}
+    assert ("wire-no-handler", True) in rules, found
+    assert ("wire-no-producer", True) in rules, found
+
+
+def test_wire_raylet_kinds_covered_on_real_tree():
+    """Every declared lease kind resolves to an arm + producer in the
+    real endpoints (the extension of the wire pass the raylet PR adds)."""
+    from ray_tpu._private import wire as w
+    from tools.rtlint.wirecheck import default_config
+    found = [f for f in check_wire(default_config(ROOT))
+             if "raylet" in f.message]
+    active, _ = filter_waived(found)
+    assert active == [], active
+    # and the declared sets are disjoint halves of one protocol
+    assert not (w.RAYLET_DOWN_KINDS & w.RAYLET_UP_KINDS)
+    assert w.RAYLET_KINDS == w.RAYLET_DOWN_KINDS | w.RAYLET_UP_KINDS
+
+
 def test_list_rules_catalog_matches_passes():
     """--list-rules stays in sync with the pass list, and every rule id
     a pass can emit is in the catalog (fixture corpus as the witness)."""

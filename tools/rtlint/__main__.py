@@ -75,9 +75,10 @@ def run_pass(name: str) -> List[Finding]:
     priv = REPO_ROOT / "ray_tpu" / "_private"
     if name == "locks":
         from tools.rtlint.lockorder import check_locks, gcs_spec, \
-            worker_spec
+            raylet_spec, worker_spec
         out = check_locks(load(priv / "gcs.py"), gcs_spec())
         out += check_locks(load(priv / "worker.py"), worker_spec())
+        out += check_locks(load(priv / "raylet.py"), raylet_spec())
         return out
     if name == "guarded":
         from ray_tpu._private import lock_watchdog as lw
@@ -93,6 +94,9 @@ def run_pass(name: str) -> List[Finding]:
         out += check_guarded(load(priv / "shm_store.py"),
                              set(lw.SHM_STORE_LOCK_DAG),
                              lw.SHM_STORE_CV_ALIASES)
+        out += check_guarded(load(priv / "raylet.py"),
+                             set(lw.RAYLET_LOCK_DAG),
+                             lw.RAYLET_CV_ALIASES)
         llm = REPO_ROOT / "ray_tpu" / "serve" / "llm"
         out += check_guarded(load(llm / "kv_cache.py"),
                              set(lw.LLM_KV_LOCK_DAG),

@@ -46,6 +46,15 @@ def worker_spec() -> LockSpec:
                     lw.WORKER_CV_ALIASES)
 
 
+def raylet_spec() -> LockSpec:
+    from ray_tpu._private import lock_watchdog as lw
+    # push/push_ctl are _Slot methods the raylet invokes on worker
+    # slots while holding its scheduler lock — resolve them
+    # cross-object (same shape as the GCS's WorkerState pushes)
+    return LockSpec(lw.RAYLET_LOCK_DAG, set(),
+                    lw.RAYLET_CV_ALIASES, {"push", "push_ctl"})
+
+
 def check_locks(sf: SourceFile, spec: LockSpec) -> List[Finding]:
     fa = analyze_file(sf, spec.lock_names, spec.cv_aliases,
                       spec.cross_methods)

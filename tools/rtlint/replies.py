@@ -106,6 +106,27 @@ def default_specs() -> List[ServeSpec]:
                   frozenset({"conn"}), frozenset({"kind"}),
                   frozenset({"cancel", "drop_queued", "dump_stack",
                              "stop_worker"})),
+        # raylet lease channels (§4i) are pure oneway streams in both
+        # directions: no arm may ever reply on the conn — loss of the
+        # channel IS the failure signal (lease reclaim / node removal)
+        ServeSpec("ray_tpu/_private/raylet.py", "Raylet._handle_push",
+                  frozenset({"conn"}), frozenset({"kind"}),
+                  frozenset({"lease_grant", "lease_revoke",
+                             "worker_ctl", "raylet_stop"})),
+        ServeSpec("ray_tpu/_private/raylet.py",
+                  "Raylet._on_worker_event",
+                  frozenset({"conn"}), frozenset({"kind"}),
+                  frozenset({"task_done", "task_blocked",
+                             "task_unblocked", "actor_ready"})),
+        ServeSpec("ray_tpu/_private/gcs.py",
+                  "GcsServer._attach_raylet_conn",
+                  frozenset({"conn"}), frozenset({"kind"}),
+                  frozenset({"raylet_done_batch", "raylet_ref_batch",
+                             "raylet_fwd", "raylet_worker_died",
+                             "raylet_task_blocked",
+                             "raylet_task_unblocked",
+                             "raylet_heartbeat", "raylet_lease_return",
+                             "raylet_workers", "raylet_detach"})),
     ]
 
 

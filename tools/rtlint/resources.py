@@ -853,7 +853,7 @@ def default_files(root: Path) -> List[Path]:
     return [priv / n for n in
             ("data_plane.py", "gcs.py", "worker.py", "protocol.py",
              "shm_store.py", "node_agent.py", "actor_server.py",
-             "resource_sanitizer.py")]
+             "resource_sanitizer.py", "raylet.py")]
 
 
 def default_check(root: Path) -> List[Finding]:

@@ -83,7 +83,8 @@ def default_config(root: Path) -> WireConfig:
         },
         trace_scan_paths=[priv / "gcs.py", priv / "actor_server.py",
                           priv / "worker.py", priv / "protocol.py",
-                          priv / "data_plane.py", priv / "node_agent.py"])
+                          priv / "data_plane.py", priv / "node_agent.py",
+                          priv / "raylet.py"])
 
 
 def _frozenset_strs(node) -> Optional[Set[str]]:
@@ -149,6 +150,27 @@ def _compare_arms(tree) -> Set[str]:
                             isinstance(el.value, str):
                         arms.add(el.value)
     return arms
+
+
+def _lease_producers(sf: SourceFile) -> Set[str]:
+    """Literal kinds a lease endpoint SENDS: ``_send_up("x")`` /
+    ``_send_up_safe("x")`` calls and ``{"kind": "x", ...}`` dict
+    literals (push_raylet frames, attach messages)."""
+    kinds: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("_send_up", "_send_up_safe") and \
+                node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            kinds.add(node.args[0].value)
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "kind" \
+                        and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    kinds.add(v.value)
+    return kinds
 
 
 class _Producers:
@@ -343,6 +365,46 @@ def check_wire(cfg: WireConfig) -> List[Finding]:
                     f"reply (dedup) kind {kind!r} is also declared a "
                     f"coalescible REF_KIND — a reply kind must never "
                     f"ride the coalesced ref path"))
+    # --- raylet lease kinds (§4i) -----------------------------------
+    # Up-kinds (raylet -> GCS) need a GCS dispatch arm and a raylet
+    # producer; down-kinds (GCS -> raylet) the reverse.  The producers
+    # live ONLY in the two lease endpoints — the protocol is fenced at
+    # PROTO_RAYLET and nothing else may forge its frames.
+    rdecl = _kind_decls(wire_sf, {"RAYLET_DOWN_KINDS",
+                                  "RAYLET_UP_KINDS"})
+    down = rdecl.get("RAYLET_DOWN_KINDS", {})
+    up = rdecl.get("RAYLET_UP_KINDS", {})
+    if down or up:
+        raylet_p = cfg.wire_path.parent / "raylet.py"
+        gcs_p = cfg.wire_path.parent / "gcs.py"
+        raylet_sf = load(raylet_p) if raylet_p.exists() else None
+        gcs_sf2 = load(gcs_p) if gcs_p.exists() else None
+        raylet_arms = _compare_arms(raylet_sf.tree) if raylet_sf else set()
+        gcs_arms2 = _compare_arms(gcs_sf2.tree) if gcs_sf2 else set()
+        raylet_prod = _lease_producers(raylet_sf) if raylet_sf else set()
+        gcs_prod = _lease_producers(gcs_sf2) if gcs_sf2 else set()
+        for kind, line in sorted(up.items()):
+            if kind not in gcs_arms2:
+                findings.append(Finding(
+                    wire_sf.rel, line, "wire-no-handler",
+                    f"raylet up-kind {kind!r} has no dispatch arm in "
+                    f"gcs.py"))
+            if kind not in raylet_prod:
+                findings.append(Finding(
+                    wire_sf.rel, line, "wire-no-producer",
+                    f"raylet up-kind {kind!r} is never produced by "
+                    f"raylet.py"))
+        for kind, line in sorted(down.items()):
+            if kind not in raylet_arms:
+                findings.append(Finding(
+                    wire_sf.rel, line, "wire-no-handler",
+                    f"raylet down-kind {kind!r} has no dispatch arm in "
+                    f"raylet.py"))
+            if kind not in gcs_prod:
+                findings.append(Finding(
+                    wire_sf.rel, line, "wire-no-producer",
+                    f"raylet down-kind {kind!r} is never produced by "
+                    f"gcs.py"))
     # the coalesced dispatch arms must equal REF_KINDS exactly
     if ref_arms or ref:
         for kind in sorted(set(ref) - ref_arms):
