@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
 	databench-quick servebench-quick llmbench-quick tracebench-quick \
-	releasebench-quick leakcheck
+	releasebench-quick fleetbench-quick leakcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -101,6 +101,17 @@ releasebench-quick:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/release_suite.py --nodes 2 \
 		--node-cpus 2 --tasks 60 --task-ms 10 --assert-sane \
 		--json benchmarks/results/releasebench_ci.json --label ci
+
+# Fleet elasticity smoke (CI): seeded preemption trace over the
+# 100-simulated-node fleet against the real autoscaler bin-packing
+# loop; asserts determinism from the seed, zero stranded demand, zero
+# double-placements, and elastic re-mesh >= 2x the restart-from-
+# checkpoint goodput.  The committed full-scale artifact is
+# benchmarks/results/fleet_bench_r11.json.
+fleetbench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --quick \
+		--assert-sane --json benchmarks/results/fleetbench_ci.json \
+		--label ci
 
 # LLM serving smoke (CI): the continuous-batching engine vs the naive
 # request-level baseline on one seeded diurnal+burst trace; asserts the

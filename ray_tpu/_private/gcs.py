@@ -69,6 +69,14 @@ class NodeState:
         self.resources_avail = dict(resources)
         self.labels = labels or {}
         self.alive = True
+        # lifecycle phase (DESIGN.md §4j): running -> draining (provider
+        # preemption warning via ``node_draining``) -> terminating
+        # (removal in progress).  Placement only targets ``running``
+        # nodes; work already on a draining node keeps running until the
+        # provider kills it.
+        self.phase = "running"               # guarded by: lock
+        self.drain_deadline: Optional[float] = None  # guarded by: lock
+        self.drain_reason = ""               # guarded by: lock
         self.data_addr: Optional[str] = None  # P2P object-plane listener
         self.data_proto = 0  # holder's data-plane wire version (add_node)
         self.is_remote = False   # owned by a NodeAgent on another host:
@@ -115,6 +123,12 @@ class NodeState:
         if cpu_t <= 0:
             return 1.0
         return 1.0 - self.resources_avail.get("CPU", 0.0) / cpu_t
+
+    def schedulable(self) -> bool:
+        """Placement eligibility: alive AND not draining/terminating —
+        a node under a preemption warning keeps its running work but
+        never receives new tasks/leases/bundles (DESIGN.md §4j)."""
+        return self.alive and self.phase == "running"
 
     def fits(self, req: Dict[str, float]) -> bool:
         return all(self.resources_avail.get(k, 0.0) >= v - 1e-9
@@ -327,6 +341,13 @@ class GcsServer:
         self.lineage_order: deque = deque(maxlen=20000)  # guarded by: lock
         # timeline events                        guarded by: _events_lock
         self.events: List[dict] = []
+        # fleet lifecycle feed (DESIGN.md §4j): bounded ring of node
+        # add/drain/remove + elastic re-mesh events, consumed by the
+        # elasticity manager and `ray_tpu status` through the
+        # ``fleet_events`` cursor RPC  guarded by: _events_lock
+        self._fleet_events: deque = deque(maxlen=512)
+        self._fleet_event_seq = 0             # guarded by: _events_lock
+        self._last_remesh: Optional[dict] = None  # guarded by: _events_lock
         self.dead_clients: Set[str] = set()            # guarded by: lock
         # in-flight chunked uploads                      guarded by: lock
         self._staging: Dict[str, dict] = {}
@@ -559,7 +580,7 @@ class GcsServer:
                 # (more re-placements happen lazily in _h_pg_wait as
                 # nodes rejoin)
                 assignment = schedule_bundles(
-                    [n for n in self.nodes.values() if n.alive],
+                    [n for n in self.nodes.values() if n.schedulable()],
                     pg.bundles, pg.strategy)
                 if assignment is not None:
                     for i, node_id in enumerate(assignment):
@@ -630,6 +651,8 @@ class GcsServer:
             node.resources_avail[f"node:{node_id}"] = 1.0
             self.nodes[node_id] = node
             self.cv.notify_all()
+        self._fleet_event("node_added", node_id,
+                          labels=dict(labels or {}))
         return node_id
 
     def remove_node_internal(self, node_id: str) -> None:
@@ -639,6 +662,8 @@ class GcsServer:
             if node is None:
                 return
             node.alive = False
+            was_draining = node.phase == "draining"
+            node.phase = "terminating"
             # raylet node: reclaim the outstanding lease ledger FIRST so
             # granted work re-queues before the workers are declared dead
             self._reclaim_raylet_leases_locked(node)
@@ -660,6 +685,8 @@ class GcsServer:
                     self._mark_object_lost(oid, meta)
             del self.nodes[node_id]
             self.cv.notify_all()
+        self._fleet_event("node_removed", node_id,
+                          was_draining=was_draining)
         self._pump()
 
     # ---------------------------------------------------------------- objects
@@ -840,10 +867,10 @@ class GcsServer:
 
     def _pick_node(self, spec: dict, req: Dict[str, float]) -> Optional[NodeState]:
         strategy = spec.get("scheduling_strategy") or "DEFAULT"
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values() if n.schedulable()]
         if isinstance(strategy, dict) and strategy.get("type") == "node_affinity":
             node = self.nodes.get(strategy["node_id"])
-            if node is not None and node.alive and node.fits(req):
+            if node is not None and node.schedulable() and node.fits(req):
                 return node
             if strategy.get("soft"):
                 strategy = "DEFAULT"
@@ -1003,7 +1030,7 @@ class GcsServer:
         if depth <= 0:
             return False
         for node in self.nodes.values():
-            if node.alive and node.raylet_conn is not None \
+            if node.schedulable() and node.raylet_conn is not None \
                     and node.queued_lease_count() < depth:
                 return True
         return False
@@ -1134,6 +1161,19 @@ class GcsServer:
         self._pending_counts[self._spec_class(spec)] -= 1
         return spec
 
+    def _fleet_event(self, kind: str, node_id: Optional[str] = None,
+                     **detail) -> None:
+        """Append one fleet lifecycle event (node_added / node_draining /
+        node_removed / remesh) to the bounded feed (DESIGN.md §4j).
+        Callable with or without the global lock held — lock ->
+        _events_lock is a legal DAG edge and the feed has its own leaf
+        lock."""
+        with self._events_lock:
+            self._fleet_event_seq += 1
+            self._fleet_events.append({
+                "seq": self._fleet_event_seq, "ts": time.time(),
+                "kind": kind, "node_id": node_id, **detail})
+
     def _dispatch_capacity(self) -> bool:
         """Lock held.  Cheap over-approximation of "could anything dispatch
         right now?" — when False, the scan below is guaranteed fruitless
@@ -1151,10 +1191,10 @@ class GcsServer:
             # > 0, not >= 1: fits() admits fractional requests (0.5-CPU
             # actors), so any sliver of free CPU makes the scan worthwhile
             cpu_ok = pc["cpu"] and any(
-                n.alive and n.resources_avail.get("CPU", 0) > 0
+                n.schedulable() and n.resources_avail.get("CPU", 0) > 0
                 for n in self.nodes.values())
             tpu_ok = pc["tpu"] and any(
-                n.alive and n.resources_avail.get("TPU", 0) > 0
+                n.schedulable() and n.resources_avail.get("TPU", 0) > 0
                 for n in self.nodes.values())
             if not (cpu_ok or tpu_ok):
                 # a raylet's queued-lease backlog can still absorb
@@ -1176,9 +1216,9 @@ class GcsServer:
         depth = GLOBAL_CONFIG.worker_pipeline_depth
         counts: Dict[str, List[int]] = {}
         for node in self.nodes.values():
-            if node.alive and node.idle_workers:
+            if node.schedulable() and node.idle_workers:
                 return True
-            if node.alive and node.raylet_conn is not None:
+            if node.schedulable() and node.raylet_conn is not None:
                 # raylet nodes schedule by grant: free ledger resources
                 # (or backlog room, when queuing counts as capacity) ARE
                 # dispatch capacity — no head-side idle worker needed
@@ -1435,7 +1475,7 @@ class GcsServer:
         best = None
         best_q = depth
         for node in self.nodes.values():
-            if not node.alive or node.raylet_conn is None:
+            if not node.schedulable() or node.raylet_conn is None:
                 continue
             queued = node.queued_lease_count()
             if queued < best_q:
@@ -3668,7 +3708,7 @@ class GcsServer:
         pg = PgState(msg["pg_id"], msg["bundles"], msg["strategy"], msg.get("name", ""))
         with self.cv:
             assignment = schedule_bundles(
-                [n for n in self.nodes.values() if n.alive],
+                [n for n in self.nodes.values() if n.schedulable()],
                 pg.bundles, pg.strategy)
             if assignment is not None:
                 for i, node_id in enumerate(assignment):
@@ -3693,7 +3733,7 @@ class GcsServer:
                     return {"ready": True, "assignment": pg.assignment}
                 # retry scheduling (nodes may have joined)
                 assignment = schedule_bundles(
-                    [n for n in self.nodes.values() if n.alive],
+                    [n for n in self.nodes.values() if n.schedulable()],
                     pg.bundles, pg.strategy)
                 if assignment is not None:
                     for i, node_id in enumerate(assignment):
@@ -3764,6 +3804,95 @@ class GcsServer:
         self.remove_node_internal(msg["node_id"])
         return {}
 
+    # ------------------------------------------------- fleet elasticity (§4j)
+    def _h_node_draining(self, msg: dict) -> dict:
+        """Provider-initiated preemption warning: mark the node draining
+        so placement avoids it, and publish a fleet event the elasticity
+        manager / train backend subscribers react to.  The node is
+        addressed by id, or by a label match (``label={"ray-pod": name}``
+        — the Kubernetes provider only knows pod names)."""
+        deadline_s = float(msg.get("deadline_s") or 0.0)
+        sel = msg.get("label") or {}
+        with self.cv:
+            node = self.nodes.get(msg.get("node_id") or "")
+            if node is None and sel:
+                for n in self.nodes.values():
+                    if all(n.labels.get(k) == v for k, v in sel.items()):
+                        node = n
+                        break
+            if node is None or not node.alive:
+                return {"ok": False, "node_id": None}
+            already = node.phase == "draining"
+            node.phase = "draining"
+            node.drain_reason = str(msg.get("reason") or "preemption")
+            if deadline_s > 0:
+                node.drain_deadline = time.monotonic() + deadline_s
+            self.cv.notify_all()
+        if not already:
+            self._fleet_event("node_draining", node.node_id,
+                              reason=node.drain_reason,
+                              deadline_s=deadline_s)
+            if GLOBAL_CONFIG.metrics_enabled:
+                mcat.get("rtpu_elastic_node_draining_total").inc(
+                    tags={"reason": node.drain_reason})
+        return {"ok": True, "node_id": node.node_id}
+
+    def _h_fleet_events(self, msg: dict) -> dict:
+        """Cursor read of the fleet lifecycle feed: events with
+        seq > ``since`` (bounded ring — a lagging subscriber may miss
+        events and should reconcile against list_nodes)."""
+        since = int(msg.get("since") or 0)
+        with self._events_lock:
+            events = [dict(e) for e in self._fleet_events
+                      if e["seq"] > since]
+            seq = self._fleet_event_seq
+        return {"events": events, "seq": seq}
+
+    def _h_elastic_event(self, msg: dict) -> dict:
+        """The elasticity manager reports a re-mesh (or restart) so
+        `ray_tpu status` / the dashboard can show the last transition
+        without reaching into the manager's process."""
+        rec = {"ts": time.time(),
+               "group": msg.get("group"),
+               "action": msg.get("action"),       # remesh | restart
+               "generation": msg.get("generation"),
+               "world_size": msg.get("world_size"),
+               "detail": msg.get("detail") or {}}
+        with self._events_lock:
+            self._last_remesh = rec
+        self._fleet_event("remesh", None, **{k: v for k, v in rec.items()
+                                             if k != "ts"})
+        return {}
+
+    def _h_fleet_state(self, msg: dict) -> dict:
+        """One-call fleet rollup for `ray_tpu status` / state.py: nodes
+        by lifecycle phase, the current demand backlog, and the last
+        elastic re-mesh event (DESIGN.md §4j)."""
+        demand = self._h_resource_demand({})
+        now = time.monotonic()
+        with self.lock:
+            phases: Dict[str, int] = {}
+            draining = []
+            for n in self.nodes.values():
+                phase = n.phase if n.alive else "terminating"
+                phases[phase] = phases.get(phase, 0) + 1
+                if phase == "draining":
+                    draining.append({
+                        "node_id": n.node_id,
+                        "reason": n.drain_reason,
+                        "deadline_in_s": (
+                            round(n.drain_deadline - now, 3)
+                            if n.drain_deadline else None)})
+        with self._events_lock:
+            last_remesh = dict(self._last_remesh) \
+                if self._last_remesh else None
+            seq = self._fleet_event_seq
+        backlog = demand["task_shapes"] + demand["pg_bundles"]
+        return {"phases": phases, "draining": draining,
+                "demand_backlog": backlog,
+                "demand_backlog_count": len(backlog),
+                "last_remesh": last_remesh, "event_seq": seq}
+
     def _h_pick_oom_victim(self, msg: dict) -> dict:
         """A NodeAgent reports local memory pressure; the head picks the
         newest plain-task worker ON THAT NODE (policy stays central, the
@@ -3818,6 +3947,7 @@ class GcsServer:
         with self.lock:
             return {"nodes": [{
                 "node_id": n.node_id, "alive": n.alive,
+                "phase": n.phase if n.alive else "terminating",
                 "resources_total": n.resources_total,
                 "resources_available": n.resources_avail,
                 "num_workers": len(n.workers), "labels": n.labels,

@@ -147,6 +147,20 @@ RAYLET_LOCK_DAG: Dict[str, Set[str]] = {
 
 RAYLET_CV_ALIASES: Dict[str, str] = {}
 
+# Fleet elasticity (elastic/, DESIGN.md §4j): the event subscriber's
+# ``_cursor_lock`` is a no-block leaf guarding the feed cursor shared
+# between the polling thread and inline poll_once callers; the RPC and
+# subscriber callbacks run strictly outside it.  The manager itself is
+# single-writer by design (transitions happen only on the fit thread)
+# and holds no locks.
+ELASTIC_LOCK_DAG: Dict[str, Set[str]] = {
+    "_cursor_lock": set(),
+}
+
+ELASTIC_NOBLOCK_LOCKS: Set[str] = {"_cursor_lock"}
+
+ELASTIC_CV_ALIASES: Dict[str, str] = {}
+
 
 def reachable(dag: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
     """Transitive closure: lock → every lock legally acquirable under it."""

@@ -74,6 +74,7 @@ class NodeAgent:
             self._procs: List[subprocess.Popen] = []
             self._extra_procs: List[subprocess.Popen] = []
             self._stop = threading.Event()
+            self._draining = False
             self.raylet = None
             from ray_tpu._private.config import GLOBAL_CONFIG
             if GLOBAL_CONFIG.raylet_enabled \
@@ -289,6 +290,42 @@ class NodeAgent:
                 self._procs[i] = self._spawn(tpu=i < self._tpu_slots)
                 spawn_times[i] = time.monotonic()
 
+    def drain(self, reason: str = "preemption",
+              deadline_s: float = 0.0) -> None:
+        """Provider-initiated preemption warning (DESIGN.md §4j): report
+        ``node_draining`` upstream so the head stops placing work here
+        and the elasticity manager can re-mesh the training group away,
+        then stop after the warning window.  Idempotent; SIGTERM with
+        ``RTPU_DRAIN_GRACE_S`` set routes here (the Kubernetes
+        terminationGracePeriod model: TERM = warning, KILL = deadline)."""
+        if self._draining or self._stop.is_set():
+            return
+        self._draining = True
+        logger.warning("draining node %s (%s): stopping in %.0fs",
+                       self.node_id[:8], reason, deadline_s)
+        ch = None
+        try:  # fresh conn: the add_node conn belongs to liveness/raylet
+            ch = protocol.RpcChannel(
+                protocol.tunnel_connect(*self.head, "gcs"),
+                negotiate=True)
+            ch.call("node_draining", node_id=self.node_id,
+                    reason=reason, deadline_s=deadline_s)
+        except Exception:  # noqa: BLE001 - head gone: just stop on time
+            logger.exception("node_draining report failed")
+        finally:
+            if ch is not None:
+                try:
+                    ch.close()
+                except OSError:
+                    pass
+        if deadline_s > 0:
+            t = threading.Timer(deadline_s, self.stop)
+            t.daemon = True
+            t.name = "agent-drain-deadline"
+            t.start()
+        else:
+            self.stop()
+
     def stop(self) -> None:
         self._stop.set()
         if self.raylet is not None:
@@ -397,7 +434,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                       num_cpus=args.num_cpus or None,
                       num_tpus=args.num_tpus,
                       labels=labels or None)
-    signal.signal(signal.SIGTERM, lambda *_: agent.stop())
+    def _on_term(*_):
+        # TERM is the provider's preemption warning when a grace window
+        # is configured (Kubernetes terminationGracePeriod model): the
+        # agent reports node_draining and keeps serving until the
+        # deadline.  No grace -> the old immediate clean leave.  The RPC
+        # runs off-thread: signal handlers must not block on sockets.
+        grace = float(os.environ.get("RTPU_DRAIN_GRACE_S", "0") or 0)
+        if grace > 0:
+            threading.Thread(
+                target=agent.drain,
+                kwargs=dict(reason="sigterm", deadline_s=grace),
+                daemon=True, name="agent-drain").start()
+        else:
+            agent.stop()
+
+    signal.signal(signal.SIGTERM, _on_term)
     try:
         agent.run()
     except KeyboardInterrupt:

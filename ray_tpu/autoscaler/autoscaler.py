@@ -27,10 +27,16 @@ class AutoscalerConfig:
     """
 
     def __init__(self, node_types: Dict[str, dict],
-                 max_workers: int = 100, idle_timeout_s: float = 60.0):
+                 max_workers: int = 100, idle_timeout_s: float = 60.0,
+                 boot_grace_s: float = 300.0):
         self.node_types = node_types
         self.max_workers = max_workers
         self.idle_timeout_s = idle_timeout_s
+        # how long a launched node may stay "booting" (provider lists
+        # it, cluster doesn't) before its capacity stops absorbing
+        # demand — a crashed-before-join agent must not block its own
+        # replacement forever
+        self.boot_grace_s = boot_grace_s
 
 
 class StandardAutoscaler:
@@ -38,13 +44,35 @@ class StandardAutoscaler:
         self.config = config
         self.provider = provider
         self._idle_since: Dict[str, float] = {}
+        self._pending_since: Dict[str, float] = {}
         self._lock = threading.Lock()
+        # injectable clock: the fleet simulator replays hour-long
+        # preemption/demand traces against this same reconcile loop on
+        # simulated time (elastic/fleet_sim.py)
+        self._clock = time.monotonic
 
     # -- inputs --------------------------------------------------------------
     def _demand(self) -> List[Dict[str, float]]:
         from ray_tpu._private import worker as worker_mod
         resp = worker_mod.global_worker().rpc("resource_demand")
         return list(resp["task_shapes"]) + list(resp["pg_bundles"])
+
+    def _node_phases(self) -> Dict[str, str]:
+        """Cluster node lifecycle phases keyed like _node_utilization
+        (node id AND ray-pod label) — draining nodes must neither be
+        scale-down victims (the provider already owns their death) nor
+        count as placement capacity."""
+        from ray_tpu._private import worker as worker_mod
+        nodes = worker_mod.global_worker().rpc("list_nodes")["nodes"]
+        out: Dict[str, str] = {}
+        for n in nodes:
+            phase = n.get("phase", "running" if n["alive"] else
+                          "terminating")
+            out[n["node_id"]] = phase
+            pod = (n.get("labels") or {}).get("ray-pod")
+            if pod:
+                out[pod] = phase
+        return out
 
     def _node_utilization(self) -> Dict[str, bool]:
         """provider-node-id -> is_idle (all resources available == total).
@@ -69,21 +97,50 @@ class StandardAutoscaler:
                 out[pod] = idle
         return out
 
-    def _counts(self) -> Dict[str, int]:
+    def _snapshot(self):
+        """ONE provider listing + tag fetch per reconcile — every
+        consumer below works off this snapshot (a Kubernetes provider
+        pays an API round-trip per call, and update() used to make
+        five of them)."""
+        node_ids = list(self.provider.non_terminated_nodes({}))
+        tags = {nid: self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
+                for nid in node_ids}
         counts: Dict[str, int] = {}
-        for nid in self.provider.non_terminated_nodes({}):
-            t = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
-            counts[t] = counts.get(t, 0) + 1
-        return counts
+        for nid in node_ids:
+            counts[tags[nid]] = counts.get(tags[nid], 0) + 1
+        return node_ids, tags, counts
 
     # -- reconcile -----------------------------------------------------------
     def update(self) -> Dict[str, Any]:
         """One reconcile step; returns a report for logging/tests."""
         with self._lock:
             demand = self._demand()
-            counts = self._counts()
+            node_ids, tags, counts = self._snapshot()
+            phases = self._node_phases()
+            # a draining node's capacity is already forfeit: exclude it
+            # from the packing counts so the replacement launches DURING
+            # the warning window, not after the node dies
+            draining = {nid for nid, ph in phases.items()
+                        if ph == "draining"}
+            if draining:
+                packing_counts = dict(counts)
+                for nid in node_ids:
+                    if nid in draining:
+                        t = tags[nid]
+                        packing_counts[t] = max(
+                            packing_counts.get(t, 0) - 1, 0)
+            else:
+                packing_counts = counts
+            # net BOOTING capacity against demand before packing: a
+            # launched-but-not-yet-joined node (provider lists it, the
+            # cluster doesn't → phase "pending") will absorb its share
+            # of the backlog when it comes up; without this every
+            # reconcile during the boot window re-launches for the same
+            # demand (the churn sim caught the over-launch)
+            demand_to_pack = self._net_pending_capacity(
+                demand, phases, node_ids, tags)
             to_launch = rds.get_nodes_to_launch(
-                self.config.node_types, counts, demand,
+                self.config.node_types, packing_counts, demand_to_pack,
                 max_total_nodes=self.config.max_workers)
             launched = {}
             for t, n in to_launch.items():
@@ -98,20 +155,96 @@ class StandardAutoscaler:
                     {TAG_NODE_KIND: NODE_KIND_WORKER, TAG_NODE_TYPE: t}, n)
                 launched[t] = ids
 
-            terminated = self._scale_down(counts, launched)
+            terminated = self._scale_down(counts, launched, draining,
+                                          node_ids, tags)
             infeasible = rds.infeasible_shapes(self.config.node_types, demand)
+            self._publish_metrics(demand, phases, launched, terminated,
+                                  node_ids)
             return {"demand": demand, "launched": launched,
-                    "terminated": terminated, "infeasible": infeasible}
+                    "terminated": terminated, "infeasible": infeasible,
+                    "draining": sorted(draining)}
+
+    def _net_pending_capacity(self, demand: List[Dict[str, float]],
+                              phases: Dict[str, str],
+                              node_ids: List[str],
+                              tags: Dict[str, str]) -> List[Dict[str, float]]:
+        """Drop the demand shapes that fit onto provider nodes still
+        booting (listed by the provider, not yet joined the cluster).
+        Largest shapes first, mirroring the packer's own order.  A node
+        "booting" longer than ``boot_grace_s`` stops absorbing demand:
+        its agent probably crashed before registering, and a phantom
+        must not block its own replacement forever."""
+        now = self._clock()
+        pending_ids = set()
+        pools: List[Dict[str, float]] = []
+        for nid in node_ids:
+            if phases.get(nid, "pending") != "pending":
+                self._pending_since.pop(nid, None)
+                continue
+            pending_ids.add(nid)
+            since = self._pending_since.setdefault(nid, now)
+            if now - since > self.config.boot_grace_s:
+                continue               # phantom: stop counting it
+            cfg = self.config.node_types.get(tags.get(nid, ""))
+            if cfg:
+                pools.append(dict(cfg["resources"]))
+        # forget nodes the provider no longer lists
+        for nid in list(self._pending_since):
+            if nid not in pending_ids:
+                self._pending_since.pop(nid, None)
+        if not pools:
+            return demand
+        remaining = []
+        for shape in sorted(demand, key=lambda s: -sum(s.values())):
+            for avail in pools:
+                if rds._fits(avail, shape):
+                    rds._consume(avail, shape)
+                    break
+            else:
+                remaining.append(shape)
+        return remaining
+
+    def _publish_metrics(self, demand, phases, launched, terminated,
+                         node_ids) -> None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        if not GLOBAL_CONFIG.metrics_enabled:
+            return
+        from ray_tpu.util import metrics_catalog as mcat
+        mcat.get("rtpu_autoscaler_demand_backlog").set(float(len(demand)))
+        by_phase: Dict[str, int] = {}
+        for nid in node_ids:
+            by_phase[phases.get(nid, "pending")] = \
+                by_phase.get(phases.get(nid, "pending"), 0) + 1
+        for phase in ("pending", "running", "draining"):
+            mcat.get("rtpu_autoscaler_nodes").set(
+                float(by_phase.get(phase, 0)), tags={"phase": phase})
+        n_launched = sum(len(ids) for ids in launched.values())
+        if n_launched:
+            mcat.get("rtpu_autoscaler_decisions_total").inc(
+                n_launched, tags={"action": "launch"})
+        if terminated:
+            mcat.get("rtpu_autoscaler_decisions_total").inc(
+                len(terminated), tags={"action": "terminate"})
 
     def _scale_down(self, counts: Dict[str, int],
-                    launched: Dict[str, list]) -> List[str]:
-        now = time.monotonic()
+                    launched: Dict[str, list],
+                    draining: Optional[set] = None,
+                    node_ids: Optional[List[str]] = None,
+                    tags: Optional[Dict[str, str]] = None) -> List[str]:
+        now = self._clock()
         idle = self._node_utilization()
         just_launched = {nid for ids in launched.values() for nid in ids}
         terminated = []
         terminated_per_type: Dict[str, int] = {}
-        for nid in self.provider.non_terminated_nodes({}):
+        if node_ids is None:
+            node_ids, tags, _ = self._snapshot()
+        for nid in node_ids:
             if nid in just_launched:
+                self._idle_since.pop(nid, None)
+                continue
+            if draining and nid in draining:
+                # the provider owns a draining node's death; reaping it
+                # here would double-terminate and skew the type counts
                 self._idle_since.pop(nid, None)
                 continue
             if not idle.get(nid, False):
@@ -123,7 +256,7 @@ class StandardAutoscaler:
             # resolve the type BEFORE terminating (providers forget
             # terminated nodes) and count kills per type so the
             # min_workers floor holds within one update
-            t = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
+            t = tags.get(nid, "")
             cfg = self.config.node_types.get(t, {})
             live = counts.get(t, 0) + len(launched.get(t, [])) \
                 - terminated_per_type.get(t, 0)

@@ -222,6 +222,14 @@ class KubernetesNodeProvider(NodeProvider):
         env = [
             {"name": "RTPU_NUM_TPUS", "value": str(tpus)},
         ]
+        # preemption warning plumbing (DESIGN.md §4j): with a grace
+        # window configured, the pod's SIGTERM (kubelet eviction / spot
+        # preemption notice) makes the agent report ``node_draining``
+        # and keep serving until the deadline instead of dying silently
+        grace = node_config.get("drain_grace_s",
+                                self.provider_config.get("drain_grace_s"))
+        if grace:
+            env.append({"name": "RTPU_DRAIN_GRACE_S", "value": str(grace)})
         if self.provider_config.get("auth_key_secret"):
             env.append({"name": "RTPU_AUTH_KEY", "valueFrom": {
                 "secretKeyRef": {

@@ -54,6 +54,20 @@ class NodeProvider:
     def internal_ip(self, node_id: str) -> str:
         return "127.0.0.1"
 
+    def drain_node(self, node_id: str, deadline_s: float = 0.0,
+                   reason: str = "preemption") -> None:
+        """Emit a provider-initiated preemption warning for ``node_id``
+        (DESIGN.md §4j): the cluster node turns ``draining`` — no new
+        placement, running work keeps going until ``terminate_node`` —
+        and a ``node_draining`` fleet event reaches subscribers (the
+        elasticity manager re-meshes the training group away during the
+        window).  Base implementation maps the provider node id through
+        the ``ray-pod`` label the pod-based providers stamp; providers
+        whose ids ARE cluster node ids override."""
+        from ray_tpu.elastic import events as fleet
+        fleet.drain_node(label={"ray-pod": node_id},
+                         deadline_s=deadline_s, reason=reason)
+
 
 class FakeMultiNodeProvider(NodeProvider):
     """Logical nodes inside a live cluster (control-plane RPCs).
@@ -102,6 +116,12 @@ class FakeMultiNodeProvider(NodeProvider):
         self._worker().rpc("remove_node", node_id=node_id)
         with self._lock:
             self._nodes.pop(node_id, None)
+
+    def drain_node(self, node_id: str, deadline_s: float = 0.0,
+                   reason: str = "preemption") -> None:
+        # logical-node ids ARE cluster node ids: signal directly
+        self._worker().rpc("node_draining", node_id=node_id,
+                           deadline_s=deadline_s, reason=reason)
 
 
 def __getattr__(name):  # lazy: kube.py pulls in ssl/http only when used

@@ -52,6 +52,16 @@ class Cluster:
         self._nodes.append(node)
         return node
 
+    def drain_node(self, node: NodeHandle, deadline_s: float = 0.0,
+                   reason: str = "preemption") -> None:
+        """Provider-initiated preemption warning (DESIGN.md §4j): the
+        node turns ``draining`` (no new placement; running work keeps
+        going) and subscribers — the elasticity manager first among
+        them — get the window to migrate before ``remove_node``."""
+        w = _worker_mod.global_worker()
+        w.rpc("node_draining", node_id=node.node_id,
+              deadline_s=deadline_s, reason=reason)
+
     def remove_node(self, node: NodeHandle) -> None:
         w = _worker_mod.global_worker()
         w.rpc("remove_node", node_id=node.node_id)

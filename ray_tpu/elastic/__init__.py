@@ -1,0 +1,40 @@
+"""``ray_tpu.elastic`` — slice-granular fleet elasticity (DESIGN.md §4j).
+
+The subsystem ROADMAP open item 5 named, in three coupled pieces:
+
+- **Elasticity manager** (``manager.py``): a head-side controller layered
+  on the autoscaler's demand view and the raylet liveness path.  It
+  subscribes to the GCS fleet-event feed (``node_draining`` preemption
+  warnings, node add/remove), and drives *re-mesh-without-restart*: on a
+  warned preemption the surviving ``jax.distributed`` domain quiesces at
+  a step boundary, every rank leaves the old domain cleanly, survivors
+  re-initialize at the new world size and re-shard optimizer/model state
+  from the last gathered checkpoint — the surviving Python processes
+  never die.  On scale-up a rejoining slice attaches to the running
+  group the same way.  Unwarned (SIGKILL) losses fall back to a
+  full-group restart from the same gathered state.
+
+- **Fleet simulator** (``fleet_sim.py`` + ``traces.py``): an
+  O(100)-simulated-node harness replaying scripted preemption and
+  diurnal-demand traces (seeded, deterministic) against the REAL
+  autoscaler bin-packing loop, with goodput accounting for the elastic
+  vs restart-from-checkpoint recovery policies
+  (``benchmarks/fleet_bench.py`` commits the A/B artifact).
+
+- **Goodput accounting** (``goodput.py``): useful (first-time) train
+  steps per wall-second — the chaos suite asserts goodput, not mere
+  survival.
+"""
+
+from ray_tpu.elastic.events import (FleetEventSubscriber, drain_node,
+                                    fleet_events, fleet_state)
+from ray_tpu.elastic.goodput import GoodputTracker
+from ray_tpu.elastic.manager import (ElasticConfig, ElasticResult,
+                                     ElasticityManager)
+from ray_tpu.elastic.worker_loop import ElasticSpec
+
+__all__ = [
+    "ElasticConfig", "ElasticResult", "ElasticSpec", "ElasticityManager",
+    "FleetEventSubscriber", "GoodputTracker", "drain_node",
+    "fleet_events", "fleet_state",
+]

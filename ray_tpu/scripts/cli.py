@@ -127,6 +127,24 @@ def cmd_status(args) -> int:
     _connect(args.address)
     from ray_tpu.util import state
     s = state.cluster_summary()
+    # fleet header (DESIGN.md §4j): lifecycle phases, demand backlog,
+    # last elastic re-mesh — the at-a-glance elasticity view; the full
+    # JSON (fleet section included) follows for tooling
+    fleet = s.get("fleet") or {}
+    phases = fleet.get("phases") or {}
+    phase_txt = " ".join(f"{k}={v}" for k, v in sorted(phases.items())) \
+        or "none"
+    print(f"fleet: nodes {phase_txt} | demand backlog "
+          f"{fleet.get('demand_backlog_count', 0)}")
+    for d in fleet.get("draining") or []:
+        ttl = d.get("deadline_in_s")
+        print(f"  draining {d['node_id'][:8]} ({d.get('reason')})"
+              + (f" deadline in {ttl:.0f}s" if ttl is not None else ""))
+    lr = fleet.get("last_remesh")
+    if lr:
+        print(f"  last elastic transition: {lr.get('action')} "
+              f"group={lr.get('group')} gen={lr.get('generation')} "
+              f"world={lr.get('world_size')}")
     print(json.dumps(s, indent=2, default=str))
     return 0
 

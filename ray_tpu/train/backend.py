@@ -72,6 +72,12 @@ class JaxConfig(BackendConfig):
     local_device_count: Optional[int] = None
     cpu_collectives: str = "gloo"
     init_timeout_s: float = 120.0
+    # preemption-warning subscription (DESIGN.md §4j): called on the
+    # DRIVER with each ``node_draining`` fleet event while the run is
+    # live — the hook where a training loop arranges an early checkpoint
+    # (or hands control to ray_tpu.elastic, which re-meshes instead of
+    # restarting).  None = not subscribed.
+    drain_handler: Optional[callable] = None
 
     @property
     def backend_cls(self):
@@ -122,7 +128,19 @@ class _JaxBackend(Backend):
             backend_config.cpu_collectives,
             backend_config.init_timeout_s))
 
+    def on_training_start(self, worker_group,
+                          backend_config: JaxConfig) -> None:
+        if backend_config.drain_handler is not None:
+            from ray_tpu.elastic.events import FleetEventSubscriber
+            self._drain_sub = FleetEventSubscriber(
+                backend_config.drain_handler,
+                kinds=("node_draining",)).start()
+
     def on_shutdown(self, worker_group, backend_config: JaxConfig) -> None:
+        sub = getattr(self, "_drain_sub", None)
+        if sub is not None:
+            sub.stop()
+            self._drain_sub = None
         # best-effort: leave the jax.distributed domain so coordinator
         # sockets close before the actors are torn down (a force-killed
         # group skips this — the OS reaps)

@@ -233,6 +233,49 @@ CATALOG: Dict[str, dict] = {
                     "fixed-size and overwrites in place)",
         emitted_by="every process with a flight recorder"),
     # --- train --------------------------------------------------------------
+    # --- fleet elasticity (DESIGN.md §4j) -----------------------------------
+    "rtpu_elastic_node_draining_total": dict(
+        kind="counter", tag_keys=("reason",),
+        description="Provider-initiated preemption warnings received "
+                    "(node_draining events marking a node unschedulable)",
+        emitted_by="head (GCS)"),
+    "rtpu_elastic_remesh_total": dict(
+        kind="counter", tag_keys=("action",),
+        description="Elastic train-group transitions driven by the "
+                    "elasticity manager (remesh = survivors re-form "
+                    "without a cold start; restart = full-group cold "
+                    "start from the last gathered state; join = a "
+                    "restored slice attached to the running group)",
+        emitted_by="driver (elasticity manager)"),
+    "rtpu_elastic_remesh_seconds": dict(
+        kind="histogram", tag_keys=("action",), buckets=LATENCY_BUCKETS,
+        description="Quiesce -> resume wall time of one elastic "
+                    "transition (training paused, processes alive)",
+        emitted_by="driver (elasticity manager)"),
+    "rtpu_elastic_generation": dict(
+        kind="gauge", tag_keys=("group",),
+        description="Current mesh generation of an elastic train group "
+                    "(bumps on every re-mesh/restart/join)",
+        emitted_by="driver (elasticity manager)"),
+    "rtpu_elastic_goodput_steps_per_s": dict(
+        kind="gauge", tag_keys=("group",),
+        description="Useful (first-time) train steps per wall-second "
+                    "across the run so far, re-runs excluded",
+        emitted_by="driver (elasticity manager)"),
+    "rtpu_autoscaler_demand_backlog": dict(
+        kind="gauge", tag_keys=(),
+        description="Unfulfilled resource shapes (tasks + PG bundles) "
+                    "seen by the last autoscaler reconcile pass",
+        emitted_by="driver (autoscaler)"),
+    "rtpu_autoscaler_nodes": dict(
+        kind="gauge", tag_keys=("phase",),
+        description="Provider nodes by lifecycle phase (pending / "
+                    "running / draining) at the last reconcile pass",
+        emitted_by="driver (autoscaler)"),
+    "rtpu_autoscaler_decisions_total": dict(
+        kind="counter", tag_keys=("action",),
+        description="Autoscaler reconcile decisions (launch | terminate)",
+        emitted_by="driver (autoscaler)"),
     "rtpu_train_step_seconds": dict(
         kind="histogram", tag_keys=("rank",), buckets=LATENCY_BUCKETS,
         description="Wall time between consecutive train.report() calls "

@@ -71,6 +71,15 @@ def list_raylets() -> List[dict]:
     return _rpc("raylet_table")["raylets"]
 
 
+def fleet_state() -> Dict[str, Any]:
+    """Fleet elasticity rollup (DESIGN.md §4j): nodes by lifecycle phase
+    (pending / running / draining / terminating), the current demand
+    backlog, and the last elastic re-mesh event."""
+    resp = _rpc("fleet_state")
+    resp.pop("error", None)
+    return resp
+
+
 def cluster_summary() -> Dict[str, Any]:
     """One-call rollup used by `ray_tpu status`."""
     res = _rpc("cluster_resources")
@@ -82,6 +91,7 @@ def cluster_summary() -> Dict[str, Any]:
         "actors": summarize_actors(),
         "objects": summarize_objects(),
         "raylets": list_raylets(),
+        "fleet": fleet_state(),
     }
 
 

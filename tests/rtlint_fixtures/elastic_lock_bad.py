@@ -1,0 +1,28 @@
+"""rtlint fixture: POSITIVE under the ELASTIC DAG
+(lock_watchdog.ELASTIC_LOCK_DAG) — blocking work and guarded-field
+violations around the event subscriber's cursor leaf lock.  Not a test
+module (no test_ prefix); exercised by tests/test_rtlint.py."""
+
+import threading
+
+
+class BadElasticCursor:
+    def __init__(self):
+        self._cursor_lock = threading.Lock()
+        self._since = 0                    # guarded by: _cursor_lock
+
+    def rpc_under_cursor_lock(self, chan):
+        # the feed RPC must never ride the leaf lock (§4d: no blocking
+        # primitives under no-block leaves)
+        with self._cursor_lock:
+            chan.recv()
+
+    def lockless_cursor_write(self, seq):
+        # the cursor is shared with the polling thread — a bare write
+        # races the reader
+        self._since = seq
+
+    def sleep_under_cursor_lock(self):
+        import time
+        with self._cursor_lock:
+            time.sleep(0.1)

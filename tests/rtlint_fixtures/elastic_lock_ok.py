@@ -1,0 +1,20 @@
+"""rtlint fixture: NEGATIVE under the ELASTIC DAG — the discipline
+events.py follows: read the cursor under the leaf lock, run the RPC and
+callbacks outside it, write the advanced cursor back under it."""
+
+import threading
+
+
+class OkElasticCursor:
+    def __init__(self):
+        self._cursor_lock = threading.Lock()
+        self._since = 0                    # guarded by: _cursor_lock
+
+    def poll(self, chan):
+        with self._cursor_lock:
+            since = self._since
+        events, seq = chan.call(since)     # RPC outside the leaf lock
+        with self._cursor_lock:
+            if seq > self._since:
+                self._since = seq
+        return events

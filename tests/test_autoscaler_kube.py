@@ -119,6 +119,16 @@ class FakeKubeApiServer:
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         self.procs[name] = proc
 
+    def preempt(self, name):
+        """Spot preemption warning: SIGTERM the pod's agent (the
+        kubelet's eviction signal).  With RTPU_DRAIN_GRACE_S in the pod
+        env the agent reports ``node_draining`` and keeps serving until
+        the deadline, then leaves cleanly on its own."""
+        with self.lock:
+            proc = self.procs.get(name)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+
     def stop(self):
         for p in self.procs.values():
             p.terminate()
@@ -243,6 +253,113 @@ def test_e2e_scale_up_schedule_scale_down(ray_start_regular):
     finally:
         srv.stop()
         proxy.stop()
+
+
+def test_kube_preemption_drain_lifecycle(ray_start_regular):
+    """The provider emits ``node_draining`` (DESIGN.md §4j) and the pod
+    agent honors the warning window: provider.drain_node maps the pod
+    name to the cluster node via its ray-pod label and flips it to
+    draining; SIGTERM with RTPU_DRAIN_GRACE_S set makes the agent keep
+    serving until the deadline, then leave cleanly — the node is
+    removed without any head-side death detection."""
+    from ray_tpu import elastic
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util.client import ClientProxyServer
+
+    session = worker_mod.global_worker().session
+    proxy = ClientProxyServer(session, host="127.0.0.1", port=0)
+    port = proxy._listener.address[1]
+    os.environ["RTPU_AUTH_KEY"] = session.auth_key().hex()
+    srv = FakeKubeApiServer(spawn_agents=True)
+    try:
+        prov = _provider(srv, head_address=f"127.0.0.1:{port}",
+                         drain_grace_s=2.0)
+        [pod] = prov.create_node({"resources": {"CPU": 1}},
+                                 {"node-kind": "worker",
+                                  "node-type": "kworker"}, 1)
+        # the manifest carries the grace env down to the agent
+        env = {e["name"]: e.get("value")
+               for e in srv.pods[pod]["spec"]["containers"][0]["env"]}
+        assert env.get("RTPU_DRAIN_GRACE_S") == "2.0"
+
+        deadline = time.time() + 90 * time_scale()
+        node = None
+        while time.time() < deadline and node is None:
+            for n in state.list_nodes():
+                if n["alive"] and (n["labels"] or {}).get("ray-pod") == pod:
+                    node = n
+            time.sleep(0.3)
+        assert node is not None, "agent pod never joined"
+
+        seen = []
+        sub = elastic.FleetEventSubscriber(seen.append,
+                                          kinds=("node_draining",))
+        sub.start(from_now=True)
+        try:
+            # provider-initiated warning, addressed by pod name
+            prov.drain_node(pod, deadline_s=30.0, reason="spot")
+            deadline = time.time() + 30 * time_scale()
+            while time.time() < deadline and not seen:
+                time.sleep(0.2)
+            assert seen and seen[0]["node_id"] == node["node_id"]
+            phases = {n["node_id"]: n["phase"] for n in state.list_nodes()}
+            assert phases[node["node_id"]] == "draining"
+
+            # the kubelet's eviction signal: agent self-reports (idempotent
+            # against the provider's earlier warning), serves out the 2s
+            # grace, then leaves cleanly -> node removed WITHOUT delete_pod
+            srv.preempt(pod)
+            deadline = time.time() + 60 * time_scale()
+            while time.time() < deadline:
+                alive = [n for n in state.list_nodes()
+                         if n["node_id"] == node["node_id"] and n["alive"]]
+                if not alive:
+                    break
+                time.sleep(0.3)
+            assert not alive, "drained agent never left the cluster"
+        finally:
+            sub.stop()
+    finally:
+        srv.stop()
+        proxy.stop()
+
+
+def test_bin_packing_under_100_node_churn():
+    """ROADMAP item 5's bin-packing contract at fleet scale: a scripted
+    100-node preemption trace plus a diurnal demand curve drive the
+    REAL ``resource_demand_scheduler.get_nodes_to_launch`` loop (via
+    the fleet simulator's SimAutoscaler) for two sim-hours — no demand
+    may be stranded and no node may be double-placed, deterministically
+    from the seed."""
+    from ray_tpu.elastic.fleet_sim import FleetSimulator
+    from ray_tpu.elastic.traces import (diurnal_demand_trace,
+                                        synthetic_preemption_trace)
+
+    def build():
+        trace = synthetic_preemption_trace(
+            11, duration_s=7200.0, n_slices=100,
+            mean_interval_s=90.0, warning_s=30.0,
+            unwarned_fraction=0.3,
+            outage_every_s=2400.0, outage_len_s=180.0)
+        demand = diurnal_demand_trace(
+            11, duration_s=7200.0, base=30, amplitude=20,
+            period_s=3600.0, burst_rate_per_hour=4.0,
+            burst_extra=10, burst_len_s=300.0)
+        return FleetSimulator(
+            node_types={"slice": {"resources": {"CPU": 8, "TPU": 4},
+                                  "min_workers": 0, "max_workers": 100}},
+            demand_shape={"CPU": 8, "TPU": 4},
+            preemption=trace, demand=demand, job=None,
+            tick_s=5.0, boot_delay_s=45.0, max_workers=100)
+
+    r1 = build().run().to_dict()
+    r2 = build().run().to_dict()
+    assert r1 == r2, "churn run not deterministic from the seed"
+    assert r1["preempted"] >= 40, r1["preempted"]
+    assert r1["launched"] >= 60, r1["launched"]
+    assert r1["max_unfulfilled"] > 0      # churn really backlogged it
+    assert r1["stranded_demand"] == 0, r1
+    assert r1["double_placements"] == 0, r1
 
 
 # ------------------------------------------------------- operator (KubeRay)

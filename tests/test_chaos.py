@@ -247,6 +247,85 @@ def test_chaos_sigkill_raylet_mid_lease_block(monkeypatch):
                 rs.uninstall()
 
 
+def test_chaos_sigkill_slice_mid_train_goodput(monkeypatch):
+    """Chaos × fleet elasticity (DESIGN.md §4j) under BOTH runtime
+    oracles: SIGKILL one slice's worker mid-train — no warning, so the
+    whole ``jax.distributed`` domain is doomed (XLA's coordination
+    service terminates the peers) and the elasticity manager must fall
+    back to a full restart from the last gathered checkpoint.  The
+    assertion is GOODPUT, not survival: useful (first-time) steps land
+    both before AND after the kill, every step reports exactly once,
+    and the cluster ends with zero net leaked resources."""
+    import sys
+
+    import cloudpickle
+
+    from ray_tpu._private import resource_sanitizer as rs
+    from ray_tpu.elastic.manager import ElasticConfig, ElasticityManager
+    from ray_tpu.elastic.worker_loop import ElasticSpec
+    from test_elastic import DecayProgram
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    monkeypatch.setenv("RAY_TPU_RESOURCE_SANITIZER", "1")
+    monkeypatch.setenv("RAY_TPU_LOCK_WATCHDOG", "1")
+    ray_tpu.init(num_cpus=4)
+    try:
+        total = 60
+        spec = ElasticSpec(build=lambda: DecayProgram(step_s=0.1),
+                           total_steps=total, gather_every=1,
+                           local_device_count=2,
+                           init_timeout_s=90 * time_scale())
+        # both workers on the head node (spread=False): the slice under
+        # chaos is the 2-process gloo domain itself
+        mgr = ElasticityManager(spec, ElasticConfig(
+            num_workers=2, min_workers=1, spread=False, poll_s=0.05,
+            quiesce_timeout_s=60 * time_scale(), auto_rejoin=False))
+        killed = [0]
+
+        def killer():
+            deadline = time.time() + 120 * time_scale()
+            while time.time() < deadline and len(mgr._history) < 3:
+                time.sleep(0.2)
+            actors = [w for w in state.list_workers()
+                      if w["state"] == "actor" and w["pid"] != os.getpid()]
+            if actors:
+                os.kill(actors[0]["pid"], signal.SIGKILL)
+                killed[0] = actors[0]["pid"]
+
+        t = threading.Thread(target=killer, daemon=True, name="killer")
+        t.start()
+        res = mgr.fit(timeout_s=360 * time_scale())
+        t.join(timeout=5)
+        assert killed[0], "killer never fired"
+        assert res.error is None, res.error
+        actions = [x["action"] for x in res.transitions]
+        assert "restart" in actions, actions
+        # goodput through the chaos: progress on both sides of the kill,
+        # no step double-counted as useful
+        useful = [h["step"] for h in res.history if h["useful"]]
+        assert len(useful) == len(set(useful)) == total
+        restart_gen = next(x["generation"] for x in res.transitions
+                           if x["action"] == "restart")
+        gens = {h["gen"] for h in res.history}
+        assert gens & set(range(restart_gen)), "no progress before kill"
+        assert restart_gen in gens, "no progress after restart"
+        assert res.goodput["goodput_steps_per_s"] > 0
+        assert res.goodput["pauses"] >= 1
+        # the ledger is balanced: nothing the dead slice held leaked
+        deadline = time.time() + 60 * time_scale()
+        while time.time() < deadline:
+            r = state._rpc("cluster_resources")
+            if r["total"].get("CPU") == r["available"].get("CPU"):
+                break
+            time.sleep(0.3)
+        assert r["total"].get("CPU") == r["available"].get("CPU"), r
+    finally:
+        try:
+            ray_tpu.shutdown()  # sanitizer: zero net leaked resources
+        finally:
+            rs.uninstall()
+
+
 def test_chaos_kill_leaves_no_net_resources(monkeypatch):
     """Chaos × leak oracle (DESIGN.md §4f): SIGKILLing a worker mid-
     workload must not leak head-side resources — the dead peer's

@@ -373,6 +373,48 @@ def test_list_rules_catalog_matches_passes():
     assert emitted <= catalog, emitted - catalog
 
 
+# ----------------------------------------------------- elastic coverage
+def _elastic_spec():
+    from ray_tpu._private import lock_watchdog as lw
+    from tools.rtlint.lockorder import LockSpec
+    return LockSpec(lw.ELASTIC_LOCK_DAG, lw.ELASTIC_NOBLOCK_LOCKS,
+                    lw.ELASTIC_CV_ALIASES, set())
+
+
+def test_elastic_lock_pass_flags_positive_fixture():
+    """The lock/guarded passes cover elastic/ with the ELASTIC DAG:
+    blocking work under the cursor leaf and a lockless write to the
+    guarded cursor are findings."""
+    from ray_tpu._private import lock_watchdog as lw
+    found = check_locks(load(FIX / "elastic_lock_bad.py"),
+                        _elastic_spec())
+    assert "lock-blocking" in _rules(found), found
+    guarded = check_guarded(load(FIX / "elastic_lock_bad.py"),
+                            set(lw.ELASTIC_LOCK_DAG),
+                            lw.ELASTIC_CV_ALIASES)
+    assert any(f.rule == "unguarded" for f in guarded), guarded
+
+
+def test_elastic_lock_pass_silent_on_negative_fixture():
+    from ray_tpu._private import lock_watchdog as lw
+    found = check_locks(load(FIX / "elastic_lock_ok.py"),
+                        _elastic_spec())
+    assert found == [], found
+    guarded = check_guarded(load(FIX / "elastic_lock_ok.py"),
+                            set(lw.ELASTIC_LOCK_DAG),
+                            lw.ELASTIC_CV_ALIASES)
+    assert guarded == [], guarded
+
+
+def test_elastic_modules_in_resource_pass_scope():
+    """The resource-lifecycle pass scans the elastic modules (the
+    manager/worker-loop/events files are in default_files)."""
+    from tools.rtlint.resources import default_files
+    names = {p.name for p in default_files(ROOT)
+             if p.parent.name == "elastic"}
+    assert names == {"events.py", "manager.py", "worker_loop.py"}
+
+
 # ------------------------------------------------- whole-tree invariants
 def test_whole_tree_is_rtlint_clean():
     """The acceptance bar: zero unwaived findings across all seven

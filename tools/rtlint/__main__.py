@@ -74,11 +74,16 @@ RULES: Dict[str, List] = {
 def run_pass(name: str) -> List[Finding]:
     priv = REPO_ROOT / "ray_tpu" / "_private"
     if name == "locks":
-        from tools.rtlint.lockorder import check_locks, gcs_spec, \
-            raylet_spec, worker_spec
+        from ray_tpu._private import lock_watchdog as lw
+        from tools.rtlint.lockorder import LockSpec, check_locks, \
+            gcs_spec, raylet_spec, worker_spec
         out = check_locks(load(priv / "gcs.py"), gcs_spec())
         out += check_locks(load(priv / "worker.py"), worker_spec())
         out += check_locks(load(priv / "raylet.py"), raylet_spec())
+        out += check_locks(
+            load(REPO_ROOT / "ray_tpu" / "elastic" / "events.py"),
+            LockSpec(lw.ELASTIC_LOCK_DAG, lw.ELASTIC_NOBLOCK_LOCKS,
+                     lw.ELASTIC_CV_ALIASES, set()))
         return out
     if name == "guarded":
         from ray_tpu._private import lock_watchdog as lw
@@ -104,6 +109,9 @@ def run_pass(name: str) -> List[Finding]:
         out += check_guarded(load(llm / "engine.py"),
                              set(lw.LLM_ENGINE_LOCK_DAG),
                              lw.LLM_ENGINE_CV_ALIASES)
+        out += check_guarded(
+            load(REPO_ROOT / "ray_tpu" / "elastic" / "events.py"),
+            set(lw.ELASTIC_LOCK_DAG), lw.ELASTIC_CV_ALIASES)
         return out
     if name == "wire":
         from tools.rtlint.wirecheck import check_wire, default_config

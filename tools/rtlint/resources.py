@@ -850,10 +850,13 @@ def check_resources(files: List[SourceFile]) -> List[Finding]:
 
 def default_files(root: Path) -> List[Path]:
     priv = root / "ray_tpu" / "_private"
+    elastic = root / "ray_tpu" / "elastic"
     return [priv / n for n in
             ("data_plane.py", "gcs.py", "worker.py", "protocol.py",
              "shm_store.py", "node_agent.py", "actor_server.py",
-             "resource_sanitizer.py", "raylet.py")]
+             "resource_sanitizer.py", "raylet.py")] + \
+           [elastic / n for n in
+            ("events.py", "manager.py", "worker_loop.py")]
 
 
 def default_check(root: Path) -> List[Finding]:
