@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
 	databench-quick servebench-quick llmbench-quick tracebench-quick \
-	releasebench-quick fleetbench-quick leakcheck
+	releasebench-quick fleetbench-quick obsbench-quick leakcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -111,6 +111,17 @@ releasebench-quick:
 fleetbench-quick:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --quick \
 		--assert-sane --json benchmarks/results/fleetbench_ci.json \
+		--label ci
+
+# Observability-history smoke (CI): serial task RTs with the head TSDB
+# ingesting every snapshot + detectors ticking + live metrics_query
+# traffic vs tsdb_enabled=0, interleaved A/B in one process; asserts
+# <5% overhead on the serial-RT floor and leaves a JSON artifact for
+# the uploader.  The committed full-scale artifact is
+# benchmarks/results/obs_bench_r12.json.
+obsbench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/obs_bench.py --quick \
+		--assert-sane --json benchmarks/results/obsbench_ci.json \
 		--label ci
 
 # LLM serving smoke (CI): the continuous-batching engine vs the naive

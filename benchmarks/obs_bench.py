@@ -1,0 +1,262 @@
+"""Observability-history overhead bench: the head TSDB on vs off.
+
+The §4k tentpole's contract is that ALWAYS-ON history — every
+``__metrics__/`` snapshot ingested into the head's ring buffers, the
+anomaly detectors ticking, live ``metrics_query`` traffic — costs near
+zero on the control-plane hot path.  Measured exactly like trace_bench:
+interleaved A/B in one process on the serial submit+get FLOOR (the
+fastest op is immune to the scheduler noise that swings p50s ±50% on
+shared CI hosts):
+
+- ``off``: ``tsdb_enabled=0`` — snapshots still published (the §4b
+  plane is independent), nothing ingested, no detectors.
+- ``on``:  ``tsdb_enabled=1`` with a 1s export period AND a background
+  query client hammering ``metrics_query`` (rate + quantile + range)
+  every 100ms during the measurement — ingest and query both live.
+
+``--assert-sane`` bounds on-vs-off overhead at <5% (min-of-N floors,
+one full interleaved retry — CI hosts are shared).  The store itself is
+also microbenched directly (ingest samples/s on a fleet-shaped payload,
+instant + range query latency at full rings) for the artifact.
+
+Usage::
+
+    python benchmarks/obs_bench.py --quick --assert-sane \
+        --json benchmarks/results/obsbench_ci.json --label ci
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OVERHEAD_BOUND = 0.05
+
+_OFF_CFG = {"tsdb_enabled": False, "metrics_export_period_s": 1.0}
+_ON_CFG = {"tsdb_enabled": True, "metrics_export_period_s": 1.0,
+           "tsdb_detector_interval_s": 1.0}
+
+_QUERIES = (
+    'sum(rate(rtpu_tasks_total[60s]))',
+    'quantile_over_time(0.99, rtpu_task_exec_seconds[2m])',
+)
+
+
+def _measure_phase(cfg: dict, ops: int, query_load: bool = False) -> dict:
+    """One fresh cluster; serial submit+get floor + p50 in µs."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, _system_config=cfg)
+    stop = threading.Event()
+    qthread = None
+    qcount = [0]
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        for _ in range(10):             # warm the worker + lease cache
+            ray_tpu.get(f.remote(), timeout=60)
+
+        if query_load:
+            # dedicated channel: the hammer must contend with the GCS
+            # like a real `ray_tpu top` process would (its own conn +
+            # server thread), NOT serialize against the measured loop's
+            # client channel
+            from ray_tpu._private import protocol, worker as worker_mod
+            w = worker_mod.global_worker()
+            chan = protocol.RpcChannel(w.open_conn(w.gcs_path),
+                                       negotiate=True)
+
+            def _hammer():
+                i = 0
+                try:
+                    while not stop.is_set():
+                        expr = _QUERIES[i % len(_QUERIES)]
+                        try:
+                            if i % 3 == 2:
+                                chan.call("metrics_query",
+                                          op="query_range",
+                                          expr=_QUERIES[0],
+                                          start=time.time() - 120,
+                                          end=time.time(), step=10)
+                            else:
+                                chan.call("metrics_query", expr=expr)
+                            qcount[0] += 1
+                        except Exception:  # noqa: BLE001 - head gone
+                            return
+                        i += 1
+                        stop.wait(0.1)
+                finally:
+                    chan.close()
+
+            qthread = threading.Thread(target=_hammer, daemon=True,
+                                       name="obsbench-query-load")
+            qthread.start()
+
+        samples: List[float] = []
+        for _ in range(ops):
+            t0 = time.perf_counter()
+            ray_tpu.get(f.remote(), timeout=60)
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return {"floor": samples[0] * 1e6,
+                "p50": samples[len(samples) // 2] * 1e6,
+                "queries": qcount[0]}
+    finally:
+        stop.set()
+        if qthread is not None:
+            qthread.join(timeout=5)
+        ray_tpu.shutdown()
+
+
+def _run_sides(ops: int, repeat: int) -> Dict[str, dict]:
+    best: Dict[str, dict] = {
+        "off": {"floor": float("inf"), "p50": float("inf"), "queries": 0},
+        "on": {"floor": float("inf"), "p50": float("inf"), "queries": 0}}
+    for _ in range(repeat):
+        for side, cfg in (("off", _OFF_CFG), ("on", _ON_CFG)):
+            got = _measure_phase(cfg, ops, query_load=(side == "on"))
+            best[side] = {
+                "floor": min(best[side]["floor"], got["floor"]),
+                "p50": min(best[side]["p50"], got["p50"]),
+                "queries": best[side]["queries"] + got["queries"]}
+    return best
+
+
+def _store_micro(quick: bool) -> dict:
+    """Direct TSDB micro numbers: fleet-shaped ingest throughput and
+    query latency with full raw rings."""
+    from ray_tpu.util.tsdb import TSDB
+
+    workers = 8 if quick else 32
+    metrics_per_worker = 12
+    rounds = 200 if quick else 400
+    clock = [1_000_000.0]
+    db = TSDB(clock=lambda: clock[0])
+
+    def payload(i):
+        snap = {}
+        for m in range(metrics_per_worker):
+            snap[f"rtpu_bench_metric_{m}"] = {
+                "kind": "counter", "description": "",
+                "series": [{"tags": {"k": "v"}, "value": float(i)}]}
+        return {"ts": clock[0], "snapshot": snap}
+
+    payloads = [json.dumps(payload(i)).encode() for i in range(rounds)]
+    t0 = time.perf_counter()
+    n = 0
+    for i, p in enumerate(payloads):
+        clock[0] += 1.0
+        for wk in range(workers):
+            n += db.ingest(f"w{wk}", p)
+    ingest_s = time.perf_counter() - t0
+    lat: List[float] = []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        db.query("sum(rate(rtpu_bench_metric_0[60s]))")
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    t0 = time.perf_counter()
+    db.query_range("sum(rate(rtpu_bench_metric_0[60s]))",
+                   start=clock[0] - 300, end=clock[0], step=5)
+    range_ms = (time.perf_counter() - t0) * 1e3
+    return {"series": db.stats()["series"],
+            "ingest_samples_per_s": round(n / ingest_s),
+            "instant_query_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "range_query_60pt_ms": round(range_ms, 3)}
+
+
+def run(quick: bool = False) -> dict:
+    ops = 120 if quick else 200
+    repeat = 3 if quick else 6
+    # throwaway phase: first-boot one-time costs stay off both sides
+    _measure_phase(_OFF_CFG, max(30, ops // 5))
+    best = _run_sides(ops, repeat)
+    overhead = best["on"]["floor"] / best["off"]["floor"] - 1.0
+    # shared-host hiccups on one side: up to two full interleaved
+    # retries before declaring a regression (floors on this class of
+    # host occasionally swing past the bound in EITHER direction)
+    for _ in range(2):
+        if overhead <= OVERHEAD_BOUND:
+            break
+        again = _run_sides(ops, repeat)
+        for side in best:
+            best[side] = {
+                "floor": min(best[side]["floor"], again[side]["floor"]),
+                "p50": min(best[side]["p50"], again[side]["p50"]),
+                "queries": best[side]["queries"] + again[side]["queries"]}
+        overhead = best["on"]["floor"] / best["off"]["floor"] - 1.0
+    micro = _store_micro(quick)
+    out = {
+        "ops": ops,
+        "off_floor_us": round(best["off"]["floor"], 1),
+        "on_floor_us": round(best["on"]["floor"], 1),
+        "off_p50_us": round(best["off"]["p50"], 1),
+        "on_p50_us": round(best["on"]["p50"], 1),
+        "overhead_frac": round(overhead, 4),
+        "concurrent_queries": best["on"]["queries"],
+        "bound": OVERHEAD_BOUND,
+        "store_micro": micro,
+    }
+    print(f"serial RT floor: off={out['off_floor_us']}us "
+          f"on={out['on_floor_us']}us "
+          f"({100 * out['overhead_frac']:+.2f}%)  "
+          f"[{out['concurrent_queries']} concurrent queries served; "
+          f"p50 off={out['off_p50_us']} on={out['on_p50_us']}]")
+    print(f"store micro: {micro['series']} series, ingest "
+          f"{micro['ingest_samples_per_s']}/s, instant query p50 "
+          f"{micro['instant_query_p50_ms']}ms, 60-pt range "
+          f"{micro['range_query_60pt_ms']}ms")
+    return out
+
+
+def assert_sane(res: dict) -> None:
+    assert res["off_floor_us"] > 0 and res["on_floor_us"] > 0, res
+    assert res["overhead_frac"] < OVERHEAD_BOUND, (
+        f"always-on TSDB ingest+query overhead "
+        f"{100 * res['overhead_frac']:.2f}% exceeds the "
+        f"{100 * OVERHEAD_BOUND:.0f}% bound (floor "
+        f"off={res['off_floor_us']}us on={res['on_floor_us']}us)")
+    assert res["concurrent_queries"] > 0, \
+        "the on-side query load never ran — the A/B measured nothing"
+    assert res["store_micro"]["ingest_samples_per_s"] > 10_000, \
+        f"implausibly slow ingest: {res['store_micro']}"
+    print("obs_bench --assert-sane: OK")
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", default=None, metavar="PATH")
+    ap.add_argument("--label", default=None)
+    ap.add_argument("--assert-sane", action="store_true")
+    args = ap.parse_args(argv)
+    res = run(quick=args.quick)
+    if args.json:
+        doc = {}
+        if os.path.exists(args.json):
+            try:
+                with open(args.json) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = {}
+        doc[args.label or "run"] = res
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"wrote {args.json}")
+    if args.assert_sane:
+        assert_sane(res)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -199,6 +199,32 @@ _FLAG_DEFS = [
           "Background metrics publisher period (jittered per cycle; "
           "clamped to >= 1s so publishing stays off the task hot path)."),
     _flag("timeline_enabled", True, "Record profile events for `ray_tpu timeline`."),
+    _flag("tsdb_enabled", True,
+          "Head-resident metrics time-series store (DESIGN.md §4k): the "
+          "GCS ingests every __metrics__/ snapshot it already receives "
+          "into fixed-memory ring buffers with a downsampling ladder, "
+          "queryable via the metrics_query op / state.metrics_history() "
+          "/ `ray_tpu top` / the dashboard history endpoint, and feeds "
+          "the always-on straggler + SLO burn-rate detectors.  Requires "
+          "metrics_enabled."),
+    _flag("tsdb_max_series", 4096,
+          "Global series bound of the head TSDB (beyond it new series "
+          "are dropped and counted, never grown — fixed memory)."),
+    _flag("tsdb_raw_samples", 360,
+          "Raw-rung ring slots per series (one per received publish; "
+          "~30min of history at the 5s default export period before "
+          "queries fall to the 30s/300s downsampled rungs)."),
+    _flag("tsdb_detector_interval_s", 5.0,
+          "How often the GCS monitor loop runs the TSDB anomaly "
+          "detectors (train straggler skew + SLO burn rate)."),
+    _flag("tsdb_straggler_window_s", 30.0,
+          "Straggler detector sliding window: per-rank mean step time "
+          "(Δsum/Δcount of rtpu_train_step_seconds) is compared to the "
+          "group median over this window."),
+    _flag("tsdb_straggler_ratio", 1.75,
+          "A rank is a straggler when its window-mean step time "
+          "exceeds this multiple of the group median (fires a "
+          "'straggler' fleet event tagged with the rank's node)."),
     _flag("trace_sample_rate", 0.01,
           "Head-based sampling rate for automatically-rooted request "
           "traces (e.g. one Serve HTTP request = one candidate root). "

@@ -361,6 +361,29 @@ class GcsServer:
         # skew > grace would reap a dying worker's final flush instantly)
         # guarded by: _kv_lock
         self._metrics_key_seen: Dict[str, float] = {}
+        # Metrics time-series store (DESIGN.md §4k): every __metrics__/
+        # snapshot the KV plane already receives is ALSO ingested into
+        # head-resident fixed-memory rings (zero new RPCs), queryable
+        # via the metrics_query op and feeding the always-on straggler /
+        # SLO-burn detectors (ticked by the monitor loop, anomalies into
+        # the fleet-event feed).  The TSDB has its own leaf lock
+        # (TSDB_LOCK_DAG) and is never called with a GCS lock held.
+        self._tsdb = None
+        self._detectors: List = []
+        self._last_detector_check = 0.0
+        if GLOBAL_CONFIG.metrics_enabled and GLOBAL_CONFIG.tsdb_enabled:
+            from ray_tpu.util.metrics_catalog import SLO_RULES
+            from ray_tpu.util.tsdb import (SloBurnAlerter,
+                                           StragglerDetector, TSDB)
+            self._tsdb = TSDB(
+                max_series=GLOBAL_CONFIG.tsdb_max_series,
+                raw_slots=GLOBAL_CONFIG.tsdb_raw_samples)
+            self._detectors = [
+                StragglerDetector(
+                    self._tsdb,
+                    window_s=GLOBAL_CONFIG.tsdb_straggler_window_s,
+                    ratio=GLOBAL_CONFIG.tsdb_straggler_ratio),
+                SloBurnAlerter(self._tsdb, SLO_RULES)]
         # reply cache for client-supplied request ids: makes the worker's
         # one post-reconnect retry exactly-once against a still-live GCS
         # (non-idempotent mutations must not double-apply when only the
@@ -1768,6 +1791,15 @@ class GcsServer:
                     self._sweep_dead_metrics()
                 except Exception:  # noqa: BLE001 - telemetry hygiene only
                     logger.exception("metrics snapshot sweep failed")
+            # anomaly detectors over the TSDB (§4k): straggler skew +
+            # SLO burn rate, results into the fleet-event feed
+            if self._detectors and now - self._last_detector_check > \
+                    GLOBAL_CONFIG.tsdb_detector_interval_s:
+                self._last_detector_check = now
+                try:
+                    self._run_detectors()
+                except Exception:  # noqa: BLE001 - telemetry best-effort
+                    logger.exception("anomaly detectors failed")
             # purge chunked uploads abandoned by a dead uploader
             with self.lock:
                 now = time.time()
@@ -3659,6 +3691,16 @@ class GcsServer:
                 # unguarded: a bare-dict update raced the sweep's
                 # iterate+pop)
                 self._metrics_key_seen[msg["key"]] = time.monotonic()
+        if metrics_key and self._tsdb is not None:
+            # history ingest rides the receipt the KV plane already has
+            # (zero new RPCs) — OUTSIDE _kv_lock (json parse + ring
+            # writes belong under the TSDB's own leaf lock, not a
+            # no-block KV critical section); never fails the put
+            try:
+                self._tsdb.ingest(msg["key"].split("/", 1)[1],
+                                  msg["value"])
+            except Exception:  # noqa: BLE001 - telemetry best-effort
+                logger.exception("tsdb ingest failed")
         if not metrics_key:
             # telemetry snapshots are ephemeral by design (re-published
             # every period, reaped when the publisher dies) — every
@@ -3836,6 +3878,53 @@ class GcsServer:
                 mcat.get("rtpu_elastic_node_draining_total").inc(
                     tags={"reason": node.drain_reason})
         return {"ok": True, "node_id": node.node_id}
+
+    def _h_metrics_query(self, msg: dict) -> dict:
+        """Query the head TSDB (DESIGN.md §4k): ``op`` selects instant
+        ``query`` (default), ``query_range`` (sparkline feed), ``series``
+        (metadata listing), or ``stats``.  Runs entirely off the GCS
+        locks — the store has its own leaf lock."""
+        if self._tsdb is None:
+            return {"results": [], "disabled": True}
+        op = msg.get("op", "query")
+        if op == "stats":
+            return {"stats": self._tsdb.stats()}
+        if op == "series":
+            return {"series": self._tsdb.list_series(msg.get("match"))}
+        if op == "query_range":
+            return {"results": self._tsdb.query_range(
+                msg["expr"], start=msg.get("start"), end=msg.get("end"),
+                step=msg.get("step"))}
+        return {"results": self._tsdb.query(msg["expr"],
+                                            at=msg.get("at"))}
+
+    def _run_detectors(self) -> None:
+        """Monitor-loop tick: run the TSDB anomaly detectors and emit
+        what they find into the fleet-event feed (§4j), the flight
+        recorder (§4h), and the anomaly counter.  No GCS lock is held
+        while the detectors read the store; the worker→node map is
+        snapshotted under the global lock FIRST so nothing nests."""
+        found: List[dict] = []
+        for det in self._detectors:
+            found.extend(det.check())
+        if not found:
+            return
+        with self.lock:
+            node_of = {w.worker_id: w.node_id
+                       for w in self.workers.values()}
+        from ray_tpu._private import flight_recorder
+        for ev in found:
+            kind = ev.pop("kind")
+            node_id = node_of.get(ev.get("worker"))
+            self._fleet_event(kind, node_id, **ev)
+            if flight_recorder.enabled():
+                flight_recorder.record(
+                    "anomaly", f"{kind} " + " ".join(
+                        f"{k}={v}" for k, v in sorted(ev.items())))
+            if GLOBAL_CONFIG.metrics_enabled:
+                mcat.get("rtpu_anomaly_events_total").inc(
+                    tags={"kind": kind})
+            logger.warning("anomaly detected: %s %s", kind, ev)
 
     def _h_fleet_events(self, msg: dict) -> dict:
         """Cursor read of the fleet lifecycle feed: events with

@@ -161,6 +161,20 @@ ELASTIC_NOBLOCK_LOCKS: Set[str] = {"_cursor_lock"}
 
 ELASTIC_CV_ALIASES: Dict[str, str] = {}
 
+# Metrics TSDB (util/tsdb.py, DESIGN.md §4k): one no-block leaf lock
+# guards the series table, rings, and ingest counters.  Critical
+# sections are O(dict/ring op); queries copy samples out under it and
+# evaluate outside; the GCS calls ingest/query with NONE of its own
+# locks held (the ingest hook in _h_kv_put runs after _kv_lock is
+# released, the detector tick runs lock-free in the monitor loop).
+TSDB_LOCK_DAG: Dict[str, Set[str]] = {
+    "_lock": set(),
+}
+
+TSDB_NOBLOCK_LOCKS: Set[str] = {"_lock"}
+
+TSDB_CV_ALIASES: Dict[str, str] = {}
+
 
 def reachable(dag: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
     """Transitive closure: lock → every lock legally acquirable under it."""

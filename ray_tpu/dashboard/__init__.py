@@ -12,6 +12,9 @@ Endpoints:
   GET /api/nodes|actors|tasks|objects|workers|placement_groups
   GET /api/timeline        — Chrome trace JSON
   GET /metrics             — Prometheus exposition (cluster-merged)
+  GET /metrics/history     — head-TSDB range query (?series=<expr>
+                             [&window=600][&step=10]; DESIGN.md §4k) —
+                             history + the UI's sparkline feed
 """
 
 from __future__ import annotations
@@ -45,6 +48,40 @@ class _Handler(BaseHTTPRequestHandler):
             if self.path == "/metrics":
                 text = metrics.prometheus_text(metrics.collect_cluster())
                 self._send(200, text.encode(), "text/plain; version=0.0.4")
+            elif self.path.startswith("/metrics/history"):
+                # TSDB range query (DESIGN.md §4k): the UI's sparkline
+                # feed.  ?series=<expr>[&window=600][&step=10] — the
+                # expression is instant-evaluated at each step over the
+                # trailing window.
+                import time as _time
+                from urllib.parse import parse_qs, urlparse
+                qs = parse_qs(urlparse(self.path).query)
+                expr = (qs.get("series") or qs.get("expr") or [None])[0]
+                if not expr:
+                    self._send(400, b"missing ?series=<expr>",
+                               "text/plain")
+                    return
+                try:
+                    window = float((qs.get("window") or ["600"])[0])
+                    step = float((qs.get("step") or [str(max(
+                        window / 60.0, 1.0))])[0])
+                except ValueError:
+                    self._send(400, b"window/step must be numbers",
+                               "text/plain")
+                    return
+                end = _time.time()
+                from ray_tpu.util.tsdb import QueryError
+                try:
+                    rows = state.metrics_history(
+                        expr, start=end - window, end=end, step=step)
+                except QueryError as e:
+                    # only a malformed expression is the CLIENT's fault;
+                    # RPC/head failures fall to the outer 500 handler
+                    self._send(400, f"bad expression: {e}".encode(),
+                               "text/plain")
+                    return
+                self._json({"expr": expr, "window_s": window,
+                            "step_s": step, "results": rows})
             elif self.path == "/api/cluster_summary":
                 self._json(state.cluster_summary())
             elif self.path == "/api/nodes":

@@ -24,6 +24,9 @@ INDEX_HTML = """<!doctype html>
           padding:10px 16px; min-width:130px; }
   .tile .v { font-size:22px; font-weight:600; }
   .tile .l { color:var(--muted); font-size:12px; }
+  .tile svg.spark { display:block; margin-top:4px; }
+  .tile svg.spark polyline { fill:none; stroke:var(--accent);
+                             stroke-width:1.5; }
   nav { display:flex; gap:2px; padding:0 20px; }
   nav button { border:1px solid var(--line); border-bottom:none;
                background:#f1f1f1; padding:7px 14px; cursor:pointer;
@@ -64,9 +67,42 @@ const TABS = {
                      "assignment"],
 };
 let tab = "nodes";
+// header sparklines: tile label -> TSDB expression served by
+// /metrics/history (the head keeps the history; one GET per tile)
+const SPARKS = {
+  "tasks/s": "sum(rate(rtpu_tasks_total[60s]))",
+  "serve req/s": "sum(rate(rtpu_serve_requests_total[60s]))",
+  "train p50, slowest rank (s)":
+    "max(quantile_over_time(0.5, rtpu_train_step_seconds[10m]))",
+};
+let sparkData = {};   // label -> [[ts, v], ...]
 const esc = s => String(s).replace(/[&<>"']/g, c => ({
   "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
 }[c]));
+function sparkline(points) {
+  // inline SVG polyline over the last window; flat/empty history
+  // renders an empty strip (no misleading axis)
+  if (!points || points.length < 2) return "";
+  const vs = points.map(p => p[1]);
+  const [w, h] = [96, 22];
+  const lo = Math.min(...vs), hi = Math.max(...vs);
+  const span = (hi - lo) || 1;
+  const pts = points.map((p, i) =>
+    `${(i / (points.length - 1) * w).toFixed(1)},` +
+    `${(h - 2 - (p[1] - lo) / span * (h - 4)).toFixed(1)}`).join(" ");
+  return `<svg class="spark" width="${w}" height="${h}"
+    viewBox="0 0 ${w} ${h}"><polyline points="${pts}"/></svg>`;
+}
+async function refreshSparks() {
+  for (const [label, expr] of Object.entries(SPARKS)) {
+    try {
+      const r = await (await fetch("/metrics/history?series=" +
+        encodeURIComponent(expr) + "&window=600&step=15")).json();
+      const rows = r.results || [];
+      sparkData[label] = rows.length ? rows[0].points : [];
+    } catch (e) { /* head TSDB disabled: tiles stay sparkline-free */ }
+  }
+}
 const fmt = v => {
   // every API value is attacker-influencable (actor names, labels,
   // error strings) — escape BEFORE any innerHTML interpolation
@@ -90,6 +126,7 @@ async function refresh() {
     const s = await (await fetch("/api/cluster_summary")).json();
     const count = x => (x && typeof x === "object")
       ? Object.values(x).reduce((a, b) => a + (+b || 0), 0) : (x ?? 0);
+    const spark = l => sparkline(sparkData[l]);
     const tiles = [
       ["nodes", count(s.nodes)], ["actors", count(s.actors)],
       ["tasks", count(s.tasks)], ["objects", s.objects.count],
@@ -97,12 +134,17 @@ async function refresh() {
       ["CPU avail", (s.resources_available.CPU??0) + " / " +
                     (s.resources_total.CPU??0)],
     ];
+    // history-backed tiles: shown once the head TSDB has data for them
+    for (const label of Object.keys(SPARKS)) {
+      const pts = sparkData[label] || [];
+      if (pts.length) tiles.push([label, pts[pts.length-1][1].toFixed(1)]);
+    }
     if ((s.resources_total.TPU??0) > 0)
       tiles.push(["TPU avail", (s.resources_available.TPU??0) + " / " +
                                s.resources_total.TPU]);
     document.getElementById("tiles").innerHTML = tiles.map(([l,v]) =>
       `<div class="tile"><div class="v">${v}</div>
-       <div class="l">${l}</div></div>`).join("");
+       <div class="l">${l}</div>${spark(l)}</div>`).join("");
     document.getElementById("session").textContent = s.session || "";
     const rows = await (await fetch("/api/" + tab)).json();
     const cols = TABS[tab];
@@ -119,6 +161,7 @@ async function refresh() {
     document.getElementById("updated").textContent = "refresh failed: " + e;
   }
 }
-renderTabs(); refresh(); setInterval(refresh, 2000);
+renderTabs(); refreshSparks().then(refresh);
+setInterval(refresh, 2000); setInterval(refreshSparks, 15000);
 </script></body></html>
 """

@@ -23,6 +23,8 @@ from typing import List, Optional
 
 def _connect(address: Optional[str]) -> None:
     import ray_tpu
+    if ray_tpu.is_initialized():
+        return  # in-process callers (tests) are already connected
     ray_tpu.init(address=address or "auto")
 
 
@@ -277,6 +279,125 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _top_frame() -> str:
+    """One rendered ``ray_tpu top`` frame from the head TSDB
+    (DESIGN.md §4k): instant queries over the history the GCS already
+    holds — no cluster-wide scrape, one RPC per query."""
+    from ray_tpu._private import worker as _worker
+    from ray_tpu.util import state
+
+    def q(expr):
+        try:
+            return state.metrics_history(expr)
+        except Exception:  # noqa: BLE001 - series not there yet
+            return []
+
+    def total(rows):
+        return sum(r["value"] for r in rows)
+
+    w = _worker.global_worker()
+    lines: List[str] = []
+    try:
+        resp = w.rpc("metrics_query", op="stats")
+    except Exception:  # noqa: BLE001 - older head: no metrics_query op
+        resp = {"disabled": True}
+    stats = resp.get("stats")
+    if stats is None or resp.get("disabled"):
+        return (f"ray_tpu top — {time.strftime('%H:%M:%S')}  "
+                f"(head has no TSDB — older release or tsdb_enabled=0; "
+                f"`ray_tpu metrics` still shows the live snapshot)")
+    lines.append(
+        f"ray_tpu top — {time.strftime('%H:%M:%S')}  "
+        f"tsdb {stats.get('series', 0)} series / "
+        f"{stats.get('samples_total', 0)} samples")
+    lines.append("")
+    task_rate = q('sum(rate(rtpu_tasks_total[60s]))')
+    exec_p99 = q('quantile_over_time(0.99, rtpu_task_exec_seconds[5m])')
+    queue_p99 = q('quantile_over_time(0.99, rtpu_task_queue_seconds[5m])')
+    row = f"tasks     {total(task_rate):8.1f}/s"
+    if exec_p99:
+        row += f"   exec p99 {max(r['value'] for r in exec_p99) * 1e3:.1f}ms"
+    if queue_p99:
+        row += f"   queue p99 {max(r['value'] for r in queue_p99) * 1e3:.1f}ms"
+    lines.append(row)
+    depth = q('sum by (node) (rtpu_raylet_queue_depth)')
+    if depth:
+        lines.append("raylets   " + "  ".join(
+            f"{r['tags'].get('node', '?')[:8]}:q={r['value']:.0f}"
+            for r in depth))
+    steps = q('sum by (rank) '
+              '(increase(rtpu_train_step_seconds[60s]))')
+    if steps:
+        means = {}
+        for r in q('avg by (rank) (avg_over_time('
+                   'rtpu_train_throughput_steps_per_s[60s]))'):
+            means[r["tags"].get("rank", "?")] = r["value"]
+        per_rank = []
+        for r in sorted(steps, key=lambda r: r["tags"].get("rank", "")):
+            rank = r["tags"].get("rank", "?")
+            thr = means.get(rank)
+            per_rank.append(
+                f"r{rank}:{1.0 / thr * 1e3:.0f}ms" if thr
+                else f"r{rank}:{r['value']:.0f} steps")
+        lines.append("train     " + "  ".join(per_rank) + "   (60s)")
+    kv = q('sum by (state) (rtpu_llm_kv_blocks)')
+    if kv:
+        used = total([r for r in kv if r["tags"].get("state") == "used"])
+        free = total([r for r in kv if r["tags"].get("state") == "free"])
+        occ = q('avg(avg_over_time(rtpu_llm_batch_occupancy[60s]))')
+        row = f"llm       kv used {used:.0f} / free {free:.0f}"
+        if occ:
+            row += f"   batch occupancy {total(occ):.2f}"
+        lines.append(row)
+    serve_rate = q('sum(rate(rtpu_serve_requests_total[60s]))')
+    if serve_rate:
+        p99 = q('quantile_over_time(0.99, '
+                'rtpu_serve_request_latency_seconds[5m])')
+        row = f"serve     {total(serve_rate):8.1f} req/s"
+        if p99:
+            row += f"   p99 {max(r['value'] for r in p99) * 1e3:.0f}ms"
+        lines.append(row)
+    goodput = q('sum(rtpu_elastic_goodput_steps_per_s)')
+    if goodput:
+        lines.append(f"goodput   {total(goodput):.2f} useful steps/s")
+    try:
+        events = w.rpc("fleet_events", since=0)["events"]
+    except Exception:  # noqa: BLE001 - older head
+        events = []
+    anomalies = [e for e in events
+                 if e.get("kind") in ("straggler", "slo_burn")][-5:]
+    if anomalies:
+        lines.append("")
+        lines.append("anomalies (fleet-event feed):")
+        for e in anomalies:
+            ts = time.strftime("%H:%M:%S", time.localtime(e["ts"]))
+            detail = " ".join(
+                f"{k}={v}" for k, v in sorted(e.items())
+                if k not in ("kind", "ts", "seq", "node_id"))
+            lines.append(f"  {ts} {e['kind']:<10s} "
+                         f"node={str(e.get('node_id'))[:8]} {detail}")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live refreshing cluster view over the head TSDB (``ray_tpu top``;
+    ``--once`` renders a single frame — tests and pipes)."""
+    _connect(args.address)
+    if args.once:
+        print(_top_frame())
+        return 0
+    try:
+        while True:
+            frame = _top_frame()
+            # clear + home, then the frame — flicker-free enough for a
+            # status view without a curses dependency
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_version(args) -> int:
     import ray_tpu
     print(getattr(ray_tpu, "__version__", "0.1.0-dev"))
@@ -322,6 +443,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="node labels k=v,k2=v2 (e.g. ici_domain=...,"
                          "slice_host=0; also $RTPU_NODE_LABELS)")
     sp.set_defaults(fn=cmd_join)
+
+    sp = sub.add_parser("top", help="live refreshing cluster view over "
+                        "the head metrics TSDB (tasks/s, queue depths, "
+                        "per-rank step times, KV pressure, goodput)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    sp.add_argument("--once", action="store_true",
+                    help="render one frame and exit (tests / pipes)")
+    sp.set_defaults(fn=cmd_top)
 
     for name, fn in (("status", cmd_status), ("timeline", cmd_timeline),
                      ("memory", cmd_memory), ("metrics", cmd_metrics),

@@ -115,18 +115,42 @@ class _JaxBackend(Backend):
             use_dist = (os.environ.get("JAX_PLATFORMS", "") not in
                         ("cpu", "cpu,axon") and world > 1
                         and os.environ.get("RTPU_JAX_DISTRIBUTED") == "1")
-        coord = None
-        if use_dist and world > 1:
-            import socket
-            port = backend_config.coordinator_port or _free_port()
-            coord = f"{socket.gethostbyname(socket.gethostname())}:{port}"
         import ray_tpu
-        ray_tpu.get(worker_group.execute_async(
-            _jax_worker_setup_by_rank, world, coord, self.GROUP,
-            backend_config.init_collective_group,
-            backend_config.local_device_count,
-            backend_config.cpu_collectives,
-            backend_config.init_timeout_s))
+
+        # Bounded retry on a lost port race: _free_port() probes by
+        # bind-and-close, so under full-suite contention another process
+        # can grab the port before the rank-0 coordinator (or a gloo
+        # transport) binds it — the rendezvous then dies with
+        # EADDRINUSE.  A fresh probe on a fresh attempt is all it takes;
+        # anything else (or an explicitly configured port) re-raises
+        # immediately.
+        for attempt in range(3):
+            coord = None
+            if use_dist and world > 1:
+                import socket
+                port = backend_config.coordinator_port or _free_port()
+                coord = (f"{socket.gethostbyname(socket.gethostname())}"
+                         f":{port}")
+            try:
+                ray_tpu.get(worker_group.execute_async(
+                    _jax_worker_setup_by_rank, world, coord, self.GROUP,
+                    backend_config.init_collective_group,
+                    backend_config.local_device_count,
+                    backend_config.cpu_collectives,
+                    backend_config.init_timeout_s))
+                return
+            except Exception as e:  # noqa: BLE001 - filtered below
+                if coord is None or backend_config.coordinator_port \
+                        or attempt == 2 or not _is_addr_in_use(e):
+                    raise
+                # leave whatever half-formed domain exists before the
+                # fresh-port attempt (best-effort; ranks that never
+                # initialized no-op)
+                try:
+                    ray_tpu.get(worker_group.execute_async(
+                        _jax_worker_teardown), timeout=10)
+                except Exception:  # noqa: BLE001 - workers may be dead
+                    pass
 
     def on_training_start(self, worker_group,
                           backend_config: JaxConfig) -> None:
@@ -150,6 +174,14 @@ class _JaxBackend(Backend):
                         timeout=10)
         except Exception:  # noqa: BLE001 - workers may already be dead
             pass
+
+
+def _is_addr_in_use(e: BaseException) -> bool:
+    """Does this (possibly wrapped) error smell like EADDRINUSE from a
+    coordinator / gloo rendezvous bind?"""
+    s = str(e).lower()
+    return ("eaddrinuse" in s or "address already in use" in s
+            or "errno 98" in s)
 
 
 def _jax_worker_teardown():

@@ -212,6 +212,24 @@ CATALOG: Dict[str, dict] = {
                     "prompt tokens prefilled, 'decode' = tokens "
                     "generated by decode iterations",
         emitted_by="llm replica"),
+    # --- head TSDB / anomaly detection (DESIGN.md §4k) ----------------------
+    "rtpu_tsdb_series": dict(
+        kind="gauge", tag_keys=(),
+        description="Time series held by the head-resident metrics "
+                    "TSDB (bounded by tsdb_max_series)",
+        emitted_by="head (GCS)"),
+    "rtpu_tsdb_samples_total": dict(
+        kind="counter", tag_keys=(),
+        description="Samples ingested into the head TSDB from "
+                    "__metrics__/ snapshot receipts",
+        emitted_by="head (GCS)"),
+    "rtpu_anomaly_events_total": dict(
+        kind="counter", tag_keys=("kind",),
+        description="Anomalies emitted into the fleet-event feed by the "
+                    "always-on detectors ('straggler' = per-rank train "
+                    "step-time skew vs the group median; 'slo_burn' = "
+                    "multi-window SLO error-budget burn)",
+        emitted_by="head (GCS)"),
     # --- request tracing / flight recorder ----------------------------------
     "rtpu_trace_spans_total": dict(
         kind="counter", tag_keys=("cat",),
@@ -300,6 +318,34 @@ CATALOG: Dict[str, dict] = {
         description="HBM allocator capacity (PJRT memory_stats)",
         emitted_by="driver collect (device_memory_gauges)"),
 }
+
+
+# --------------------------------------------------------------- SLO rules
+# Burn-rate alerting rules over the latency histograms above, consumed
+# by ``tsdb.SloBurnAlerter`` (always-on, ticked by the GCS monitor
+# loop).  Declared HERE — next to the series they reference — so the
+# rtlint metrics pass (``metric-slo-rule``) can statically prove every
+# rule names a live cataloged histogram whose bucket ladder covers the
+# threshold; a rule over a dead or re-bucketed series fails the build,
+# not the 3am page.
+#
+# Shape: windows = ((long_s, short_s, burn_factor), ...) — an alert
+# fires when the error-budget burn rate (fraction of observations
+# slower than threshold_s, divided by 1 - objective) exceeds
+# burn_factor on BOTH windows (long filters blips, short proves the
+# burn is still live).  Factors follow the SRE-workbook ladder: 14.4x
+# on the fast page window (budget gone in ~2h at that rate).
+SLO_RULES: tuple = (
+    dict(name="llm_ttft", series="rtpu_llm_ttft_seconds",
+         threshold_s=2.5, objective=0.99,
+         windows=((3600.0, 300.0, 14.4), (21600.0, 1800.0, 6.0))),
+    dict(name="llm_tpot", series="rtpu_llm_tpot_seconds",
+         threshold_s=0.25, objective=0.99,
+         windows=((3600.0, 300.0, 14.4), (21600.0, 1800.0, 6.0))),
+    dict(name="serve_latency", series="rtpu_serve_request_latency_seconds",
+         threshold_s=1.0, objective=0.999,
+         windows=((3600.0, 300.0, 14.4),)),
+)
 
 
 # resolved-instance cache: get() runs on hot paths (inside the GCS

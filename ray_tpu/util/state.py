@@ -80,6 +80,33 @@ def fleet_state() -> Dict[str, Any]:
     return resp
 
 
+def metrics_history(expr: str, start: Optional[float] = None,
+                    end: Optional[float] = None,
+                    step: Optional[float] = None,
+                    at: Optional[float] = None) -> List[dict]:
+    """Query the head-resident metrics TSDB (DESIGN.md §4k).
+
+    Instant form (default): ``metrics_history('rate(rtpu_tasks_total'
+    '{state="ok"}[60s])')`` → ``[{"tags": {...}, "value": float}]``,
+    evaluated at ``at`` (default: now).  Range form (any of
+    start/end/step given): the expression evaluated at each step →
+    ``[{"tags": {...}, "points": [[ts, value], ...]}]``.  Supported
+    syntax: label matchers (``=``, ``!=``, ``=~``), ``rate()``,
+    ``increase()``, ``avg/min/max_over_time()``,
+    ``quantile_over_time(q, ...)``, and ``sum/avg/max/min [by (...)]``
+    aggregation — see README § Observability."""
+    if start is not None or end is not None or step is not None:
+        return _rpc("metrics_query", op="query_range", expr=expr,
+                    start=start, end=end, step=step)["results"]
+    return _rpc("metrics_query", expr=expr, at=at)["results"]
+
+
+def metrics_series(match: Optional[str] = None) -> List[dict]:
+    """List the TSDB's series (name, kind, tags, newest-sample age);
+    ``match`` filters with selector syntax (``name{label="v"}``)."""
+    return _rpc("metrics_query", op="series", match=match)["series"]
+
+
 def cluster_summary() -> Dict[str, Any]:
     """One-call rollup used by `ray_tpu status`."""
     res = _rpc("cluster_resources")

@@ -122,16 +122,29 @@ def test_remove_node_pg_reschedule(ray_start_cluster):
     assert pg2.wait(timeout_seconds=10)
 
 
-def test_spread_across_nodes(ray_start_cluster):
+def test_spread_across_nodes(ray_start_cluster, tmp_path):
     cluster = ray_start_cluster
     cluster.add_node(num_cpus=2)
+    barrier = str(tmp_path)
 
+    # De-flaked: a fixed 0.3s sleep let a heavily contended host
+    # serialize the dispatches (each task finishing before the next was
+    # scheduled ties the SPREAD load comparison at 0 and the stable
+    # sort picks the head every time).  A start barrier makes placement
+    # OBSERVED state: all four 1-CPU tasks must run concurrently, which
+    # the 2+2 CPU cluster can only do by using both nodes — if the
+    # scheduler ever stops spreading, the barrier times out and the
+    # node-count assertion fails deterministically.
     @ray_tpu.remote(num_cpus=1)
-    def where():
-        time.sleep(0.3)
+    def where(i, barrier):
+        open(os.path.join(barrier, f"rank{i}"), "w").close()
+        deadline = time.monotonic() + 45
+        while len(os.listdir(barrier)) < 4 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
         return ray_tpu.get_runtime_context().node_id
 
-    refs = [where.options(scheduling_strategy="SPREAD").remote()
-            for _ in range(4)]
-    nodes = set(ray_tpu.get(refs, timeout=60))
+    refs = [where.options(scheduling_strategy="SPREAD").remote(i, barrier)
+            for i in range(4)]
+    nodes = set(ray_tpu.get(refs, timeout=120))
     assert len(nodes) == 2

@@ -390,6 +390,159 @@ def test_serve_builtin_metrics(metrics_cluster):
         serve.shutdown()
 
 
+# ------------------------------------------------- TSDB ingest under churn
+
+def test_tsdb_history_survives_worker_death(metrics_cluster):
+    """Worker churn (DESIGN.md §4k): once a worker dies and the sweep
+    reaps its KV snapshot, the LIVE merge stops showing its series —
+    but the head TSDB keeps the history (that is the whole point:
+    post-mortem "what was rank N doing" questions)."""
+    import os as _os
+    import signal as _signal
+    import time as _time
+
+    from ray_tpu.util import state
+
+    head = ray_tpu._head
+    if head._tsdb is None:
+        pytest.skip("tsdb disabled")
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    # several publish cycles of real worker traffic -> worker-tagged
+    # history in the TSDB
+    for i in range(3):
+        assert ray_tpu.get(work.remote(i)) == i + 1
+        time.sleep(1.2)
+
+    def worker_series(m):
+        return {s["tags"]["worker"]
+                for s in _series(m, "rtpu_task_exec_seconds")
+                if s["tags"].get("name") == "work"}
+
+    merged = _poll_cluster_metrics(lambda m: bool(worker_series(m)),
+                                   30 * time_scale())
+    wids = worker_series(merged)
+    assert wids, sorted(merged)
+
+    def history_rows(wid):
+        # an increase() row exists once the TSDB holds >= 2 snapshots
+        # of the worker's series in the window (value may be 0 if both
+        # executions landed before the first snapshot)
+        return state.metrics_history(
+            f'increase(rtpu_task_exec_seconds{{worker="{wid}"}}[5m])')
+
+    deadline = time.monotonic() + 30 * time_scale()
+    victim, hist = None, []
+    while time.monotonic() < deadline and not hist:
+        for wid in sorted(wids):
+            hist = history_rows(wid)
+            if hist:
+                victim = wid
+                break
+        time.sleep(0.5)
+    assert victim is not None, "no worker history in the TSDB"
+
+    # SIGKILL the publisher and reap its snapshot the way the sweep
+    # would after the grace window (backdated receipt, §4b)
+    pid = next(w["pid"] for w in state.list_workers()
+               if w["worker_id"] == victim)
+    _os.kill(pid, _signal.SIGKILL)
+    deadline = time.monotonic() + 30 * time_scale()
+    while time.monotonic() < deadline:
+        if all(w["state"] == "dead" or w["worker_id"] != victim
+               for w in state.list_workers()):
+            break
+        time.sleep(0.2)
+    with head._kv_lock:
+        key = f"__metrics__/{victim}"
+        if key in head._metrics_key_seen:
+            head._metrics_key_seen[key] = \
+                _time.monotonic() - metrics_lib.DEAD_SNAPSHOT_GRACE_S - 60
+    head._sweep_dead_metrics()
+
+    # live plane: snapshot gone, merge no longer carries the worker
+    w = ray_tpu._private.worker.global_worker()
+    assert key not in w.rpc("kv_keys", prefix="__metrics__/")["keys"]
+    assert victim not in worker_series(metrics_lib.collect_cluster())
+    # history plane: the dead worker's series is still queryable
+    assert history_rows(victim), "history vanished with the snapshot"
+    assert any(s["tags"].get("worker") == victim
+               for s in state.metrics_series("rtpu_task_exec_seconds"))
+
+
+# ------------------------------------------------- straggler chaos detection
+
+def test_straggler_detector_chaos_both_oracles(monkeypatch):
+    """An injected slow rank trips the straggler detector within one
+    detection window, under BOTH runtime oracles (lock watchdog +
+    resource sanitizer): four actor 'ranks' report train step times
+    through the normal per-process publishers, rank 3 runs 4x slow, and
+    the head's monitor-loop detector emits a ``straggler`` fleet event
+    tagged with the slow rank's node."""
+    monkeypatch.setenv("RAY_TPU_LOCK_WATCHDOG", "1")
+    monkeypatch.setenv("RAY_TPU_RESOURCE_SANITIZER", "1")
+    window_s = 12.0
+    ray_tpu.init(num_cpus=4, _system_config={
+        "metrics_export_period_s": 1.0,
+        "tsdb_detector_interval_s": 1.0,
+        "tsdb_straggler_window_s": window_s})
+    try:
+        head = ray_tpu._head
+        if head._tsdb is None:
+            pytest.skip("tsdb disabled")
+
+        @ray_tpu.remote
+        class Rank:
+            def __init__(self, rank):
+                self.rank = rank
+
+            def steps(self, n, step_s):
+                from ray_tpu.util import metrics_catalog as mc
+                h = mc.get("rtpu_train_step_seconds")
+                for _ in range(n):
+                    h.observe(step_s, tags={"rank": str(self.rank)})
+                return n
+
+        ranks = [Rank.remote(r) for r in range(4)]
+        t_end = time.monotonic() + 30 * time_scale()
+        found = None
+        w = ray_tpu._private.worker.global_worker()
+        while time.monotonic() < t_end and found is None:
+            # steady stream of step reports: rank 3 is the 4x straggler
+            ray_tpu.get([r.steps.remote(3, 0.4 if i == 3 else 0.1)
+                         for i, r in enumerate(ranks)])
+            time.sleep(0.5)
+            events = w.rpc("fleet_events", since=0)["events"]
+            stragglers = [e for e in events if e["kind"] == "straggler"]
+            if stragglers:
+                found = stragglers[0]
+        assert found is not None, "no straggler event within the budget"
+        assert found["rank"] == "3"
+        assert found["skew_ratio"] >= 1.75
+        # tagged with the slow rank's node so the elasticity manager
+        # can act on it
+        assert found["node_id"] is not None
+        from ray_tpu.util import state as state_mod
+        live_nodes = {n["node_id"] for n in state_mod.list_nodes()}
+        assert found["node_id"] in live_nodes
+        # the healthy ranks never fired
+        assert all(e["rank"] == "3" for e in stragglers)
+        # and the anomaly counter ticked on the head
+        snap = metrics_lib.registry_snapshot()
+        anom = snap.get("rtpu_anomaly_events_total", {}).get("series", [])
+        assert sum(s["value"] for s in anom
+                   if s["tags"].get("kind") == "straggler") >= 1
+    finally:
+        ray_tpu.shutdown()
+        with GLOBAL_CONFIG._lock:
+            for k in ("metrics_export_period_s", "tsdb_detector_interval_s",
+                      "tsdb_straggler_window_s"):
+                GLOBAL_CONFIG._overrides.pop(k, None)
+
+
 # ----------------------------------------------------------------- train plane
 
 def test_train_step_metrics(metrics_cluster, tmp_path):

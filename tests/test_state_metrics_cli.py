@@ -104,6 +104,93 @@ def test_metrics_cluster_publish(ray_start_regular):
     assert "driver_gauge" in merged and "worker_counter" in merged
 
 
+# ------------------------------------------------------------- TSDB history
+
+def test_metrics_history_and_top_cli(capsys):
+    """`ray_tpu top` renders LIVE data from a real cluster: worker
+    publishers feed the head TSDB, state.metrics_history() answers
+    windowed queries over it, and one `top --once` frame shows the
+    task-rate row computed from that history."""
+    from conftest import time_scale
+
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"metrics_export_period_s": 1.0})
+    try:
+        @ray_tpu.remote
+        def tick(x):
+            return x + 1
+
+        # spread the work over several publish cycles so the counter
+        # history actually grows inside the TSDB window
+        rate_rows = []
+        deadline = time.monotonic() + 45 * time_scale()
+        while time.monotonic() < deadline:
+            ray_tpu.get([tick.remote(i) for i in range(4)])
+            rate_rows = state.metrics_history(
+                'sum(rate(rtpu_tasks_total[60s]))')
+            if rate_rows and rate_rows[0]["value"] > 0:
+                break
+            time.sleep(1.0)
+        assert rate_rows and rate_rows[0]["value"] > 0, rate_rows
+
+        # range form: the sparkline feed has timestamped points (steps
+        # that predate the history are simply absent, not zero-filled)
+        end = time.time()
+        rng = state.metrics_history('sum(rate(rtpu_tasks_total[60s]))',
+                                    start=end - 60, end=end, step=5)
+        assert rng and rng[0]["points"]
+        assert all(len(p) == 2 and end - 65 <= p[0] <= end + 5
+                   for p in rng[0]["points"])
+
+        # series listing carries the worker tag injected at ingest
+        series = state.metrics_series("rtpu_tasks_total")
+        assert series and all(s["tags"].get("worker") for s in series)
+
+        from ray_tpu.scripts import cli
+        rc = cli.main(["top", "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ray_tpu top" in out and "tsdb" in out
+        tasks_line = next(ln for ln in out.splitlines()
+                          if ln.startswith("tasks"))
+        assert float(tasks_line.split("/s")[0].split()[-1]) > 0
+    finally:
+        ray_tpu.shutdown()
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        with GLOBAL_CONFIG._lock:
+            GLOBAL_CONFIG._overrides.pop("metrics_export_period_s", None)
+
+
+def test_dashboard_history_endpoint(ray_start_regular):
+    """/metrics/history serves TSDB range queries as JSON (the UI's
+    sparkline feed); bad input answers 400, not 500."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+    srv = start_dashboard(port=0)
+    try:
+        port = srv.server_address[1]
+        expr = urllib.parse.quote("sum(rate(rtpu_tasks_total[60s]))")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics/history?series={expr}"
+                f"&window=120&step=15", timeout=30) as r:
+            doc = json.loads(r.read())
+        assert doc["expr"] == "sum(rate(rtpu_tasks_total[60s]))"
+        assert doc["window_s"] == 120.0 and "results" in doc
+        for bad in ("/metrics/history",
+                    "/metrics/history?series=rate(broken",
+                    "/metrics/history?series=x&window=nan2",
+                    "/metrics/history?series=x&step=0"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{bad}", timeout=30)
+            assert ei.value.code == 400
+    finally:
+        stop_dashboard()
+
+
 # ----------------------------------------------------------------- timeline
 
 def test_timeline_chrome_trace(ray_start_regular, tmp_path):

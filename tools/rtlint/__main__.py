@@ -46,6 +46,9 @@ RULES: Dict[str, List] = {
     "metrics": [
         ("metric-undeclared", "no rtpu_* use outside the catalog"),
         ("metric-dead", "no declared-but-never-referenced series"),
+        ("metric-slo-rule", "every SLO_RULES entry names a live "
+                            "cataloged histogram whose buckets cover "
+                            "its threshold"),
     ],
     "resources": [
         ("resource-leak", "acquired sockets/fds/files/mmaps/threads/"
@@ -84,6 +87,10 @@ def run_pass(name: str) -> List[Finding]:
             load(REPO_ROOT / "ray_tpu" / "elastic" / "events.py"),
             LockSpec(lw.ELASTIC_LOCK_DAG, lw.ELASTIC_NOBLOCK_LOCKS,
                      lw.ELASTIC_CV_ALIASES, set()))
+        out += check_locks(
+            load(REPO_ROOT / "ray_tpu" / "util" / "tsdb.py"),
+            LockSpec(lw.TSDB_LOCK_DAG, lw.TSDB_NOBLOCK_LOCKS,
+                     lw.TSDB_CV_ALIASES, set()))
         return out
     if name == "guarded":
         from ray_tpu._private import lock_watchdog as lw
@@ -112,6 +119,9 @@ def run_pass(name: str) -> List[Finding]:
         out += check_guarded(
             load(REPO_ROOT / "ray_tpu" / "elastic" / "events.py"),
             set(lw.ELASTIC_LOCK_DAG), lw.ELASTIC_CV_ALIASES)
+        out += check_guarded(
+            load(REPO_ROOT / "ray_tpu" / "util" / "tsdb.py"),
+            set(lw.TSDB_LOCK_DAG), lw.TSDB_CV_ALIASES)
         return out
     if name == "wire":
         from tools.rtlint.wirecheck import check_wire, default_config

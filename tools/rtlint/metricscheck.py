@@ -90,11 +90,82 @@ def _catalog_decl_lines(catalog_path: Path) -> Dict[str, int]:
     return out
 
 
+def check_slo_rules(catalog: Dict[str, dict], rules,
+                    catalog_path: Path) -> List[Finding]:
+    """``metric-slo-rule``: every SLO_RULES entry must reference a live
+    cataloged HISTOGRAM whose bucket ladder covers its threshold — the
+    burn-rate alerter computes bad-request fractions from bucket deltas,
+    so a rule over a missing/re-kinded series or a threshold above every
+    finite bucket bound would silently never fire (or always lie)."""
+    findings: List[Finding] = []
+    cat_rel = str(catalog_path.relative_to(REPO_ROOT)) \
+        if catalog_path.is_relative_to(REPO_ROOT) else str(catalog_path)
+    rule_lines = _slo_rule_lines(catalog_path)
+    for rule in rules:
+        name = rule.get("name", "?")
+        line = rule_lines.get(name, 1)
+        series = rule.get("series")
+        spec = catalog.get(series)
+        if spec is None:
+            findings.append(Finding(
+                cat_rel, line, "metric-slo-rule",
+                f"SLO rule {name!r} references {series!r}, which is not "
+                f"declared in CATALOG"))
+            continue
+        if spec["kind"] != "histogram":
+            findings.append(Finding(
+                cat_rel, line, "metric-slo-rule",
+                f"SLO rule {name!r}: {series} is a {spec['kind']}, but "
+                f"burn rates need a histogram's bucket deltas"))
+            continue
+        from ray_tpu.util.metrics import DEFAULT_BUCKETS
+        buckets = spec.get("buckets", DEFAULT_BUCKETS)
+        thr = rule.get("threshold_s", 0.0)
+        if not (0 < thr <= max(buckets)):
+            findings.append(Finding(
+                cat_rel, line, "metric-slo-rule",
+                f"SLO rule {name!r}: threshold {thr}s is outside "
+                f"{series}'s bucket ladder (max finite bound "
+                f"{max(buckets)}s) — every observation would count as "
+                f"within SLO"))
+        for w in rule.get("windows", ()):
+            if not (len(w) == 3 and w[0] > w[1] > 0 and w[2] > 0):
+                findings.append(Finding(
+                    cat_rel, line, "metric-slo-rule",
+                    f"SLO rule {name!r}: window tuple {w!r} must be "
+                    f"(long_s > short_s > 0, factor > 0)"))
+        if not (0.0 < rule.get("objective", 0.0) < 1.0):
+            findings.append(Finding(
+                cat_rel, line, "metric-slo-rule",
+                f"SLO rule {name!r}: objective must be in (0, 1)"))
+    return findings
+
+
+def _slo_rule_lines(catalog_path: Path) -> Dict[str, int]:
+    """Line of each ``name=...`` rule dict inside SLO_RULES."""
+    try:
+        tree = ast.parse(catalog_path.read_text())
+    except (OSError, SyntaxError):
+        return {}
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SLO_RULES"
+                for t in node.targets):
+            for call in ast.walk(node.value):
+                if isinstance(call, ast.Call):
+                    for kw in call.keywords:
+                        if kw.arg == "name" and \
+                                isinstance(kw.value, ast.Constant):
+                            out[kw.value.value] = call.lineno
+    return out
+
+
 def default_check() -> List[Finding]:
     import sys
     if str(REPO_ROOT) not in sys.path:
         sys.path.insert(0, str(REPO_ROOT))
-    from ray_tpu.util.metrics_catalog import CATALOG
-    return check_metrics(
-        CATALOG, [REPO_ROOT / "ray_tpu"],
-        REPO_ROOT / "ray_tpu" / "util" / "metrics_catalog.py")
+    from ray_tpu.util.metrics_catalog import CATALOG, SLO_RULES
+    catalog_path = REPO_ROOT / "ray_tpu" / "util" / "metrics_catalog.py"
+    return check_metrics(CATALOG, [REPO_ROOT / "ray_tpu"], catalog_path) \
+        + check_slo_rules(CATALOG, SLO_RULES, catalog_path)
