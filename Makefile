@@ -4,7 +4,8 @@ PY ?= python
 
 .PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
 	databench-quick servebench-quick llmbench-quick tracebench-quick \
-	releasebench-quick fleetbench-quick obsbench-quick leakcheck
+	releasebench-quick fleetbench-quick obsbench-quick \
+	failoverbench-quick leakcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -122,6 +123,17 @@ fleetbench-quick:
 obsbench-quick:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/obs_bench.py --quick \
 		--assert-sane --json benchmarks/results/obsbench_ci.json \
+		--label ci
+
+# Head-failover smoke (CI): SIGKILL the primary GCS with a warm
+# standby attached and tasks in flight; asserts ZERO lost tasks on
+# every trial and sub-second promote-to-first-settled-task (best of
+# <=3 trials — shared runners jitter), JSON artifact for the uploader.
+# The committed full-scale artifact is
+# benchmarks/results/failover_bench_r13.json.
+failoverbench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/failover_bench.py --quick \
+		--assert-sane --json benchmarks/results/failoverbench_ci.json \
 		--label ci
 
 # LLM serving smoke (CI): the continuous-batching engine vs the naive

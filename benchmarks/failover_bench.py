@@ -1,0 +1,238 @@
+"""Head-failover bench (DESIGN.md §4l): SIGKILL the primary GCS with a
+warm standby attached and tasks in flight, and measure the promotion.
+
+What one trial does:
+
+  1. spawn a head subprocess + a standby subprocess
+     (``python -m ray_tpu._private.replication``) over its session;
+  2. drive a task stream from THIS process (the driver) — every task
+     ``max_retries=-1`` + ``retry_exceptions`` so owner-based
+     resubmission owns the failover, exactly like a production client;
+  3. SIGKILL the head mid-stream; the standby auto-promotes (stream
+     EOF + dead-endpoint probe), re-binds ``gcs.sock``, and the
+     driver/workers re-attach through their bounded-backoff reconnects;
+  4. collect every result and the standby's promote-timings artifact.
+
+Reported metrics:
+
+  - ``promote_s``            detect -> serving (inside StandbyHead.promote:
+                             snapshot write + WAL-tail replay + GcsServer
+                             boot + listener re-bind)
+  - ``detect_s``             SIGKILL -> promote start (stream-EOF latency)
+  - ``promote_to_settle_s``  promote START -> the first task RESULT the
+                             driver observes against the promoted ledger —
+                             the headline number (the acceptance bar is
+                             sub-second on the quick trace)
+  - ``kill_to_settle_s``     SIGKILL -> first settled task (end to end)
+  - ``lost``                 tasks submitted but never completed, or
+                             completed with a wrong result (MUST be 0)
+
+``--assert-sane`` allows up to 3 trials and passes when one meets the
+latency bar (shared CI hosts jitter scheduler wakeups by hundreds of
+ms); ``lost == 0`` must hold on EVERY trial — correctness never gets a
+retry.
+
+Usage:
+  python benchmarks/failover_bench.py --quick --assert-sane \
+      --json benchmarks/results/failoverbench_ci.json --label ci
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_HEAD_SCRIPT = r"""
+import signal, sys, time
+import ray_tpu
+from ray_tpu._private import worker as wm
+ray_tpu.init(num_cpus=2)
+print("SESSION:" + str(wm.global_worker().session.path), flush=True)
+signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
+while True:
+    time.sleep(3600)
+"""
+
+
+def _spawn_head():
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _HEAD_SCRIPT],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    line = proc.stdout.readline()
+    assert line.startswith("SESSION:"), f"head failed: {line!r}"
+    return proc, line.split("SESSION:", 1)[1].strip()
+
+
+def _spawn_standby(session, timings):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.replication",
+         "--session", session, "--num-cpus", "2",
+         "--timings", timings],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    line = proc.stdout.readline()
+    assert "STANDBY_READY" in line, f"standby failed: {line!r}"
+    # arm on the first snapshot sync — a kill before it has nothing
+    # to promote from
+    line = proc.stdout.readline()
+    assert "STANDBY_SYNCED" in line, f"standby never synced: {line!r}"
+    return proc
+
+
+def run_trial(n_tasks: int, task_ms: float) -> dict:
+    import ray_tpu
+
+    head, session = _spawn_head()
+    timings = os.path.join(session, "failover_timings.json")
+    standby = _spawn_standby(session, timings)
+    try:
+        ray_tpu.init(address=session)
+
+        @ray_tpu.remote(max_retries=-1, retry_exceptions=True)
+        def work(i, ms):
+            time.sleep(ms / 1e3)
+            return i * 13
+
+        # warm phase: the pool is up and settling results before the kill
+        warm = [work.remote(i, task_ms) for i in range(4)]
+        assert ray_tpu.get(warm, timeout=120) == [i * 13 for i in range(4)]
+
+        refs = {i: work.remote(i, task_ms) for i in range(n_tasks)}
+        time.sleep(max(0.15, task_ms / 1e3))  # tasks genuinely in flight
+
+        t_kill = time.time()
+        os.kill(head.pid, signal.SIGKILL)
+        head.wait(timeout=10)
+
+        # The settle probe: submitted AFTER the kill, so it can only
+        # ever settle against the promoted ledger — its completion is
+        # "first settled task" without the ambiguity of in-flight tasks
+        # whose results were already client-cached pre-kill.
+        first_settle = float("inf")
+        for attempt in range(3):
+            probe = work.remote(10_000 + attempt, 1.0)
+            try:
+                assert ray_tpu.get(probe, timeout=180) == \
+                    (10_000 + attempt) * 13
+                first_settle = time.time()
+                break
+            except Exception:  # noqa: BLE001 - probe raced the window
+                continue
+
+        # drain the in-flight stream: zero lost is the contract
+        done_at: dict = {}
+        for i, r in refs.items():
+            try:
+                done_at[i] = ray_tpu.get(r, timeout=180)
+            except Exception:  # noqa: BLE001 - counted as lost below
+                pass
+
+        deadline = time.time() + 30
+        while not os.path.exists(timings) and time.time() < deadline:
+            time.sleep(0.05)
+        rec = json.load(open(timings))
+        promote_start = rec["ts"] - rec["promote_s"]
+
+        lost = [i for i in refs
+                if i not in done_at or done_at[i] != i * 13]
+        settled = first_settle != float("inf")
+        return {
+            "n_tasks": n_tasks,
+            "task_ms": task_ms,
+            # every failed settle probe counts as a lost task too —
+            # and keeps inf out of the JSON (json.dump emits invalid
+            # "Infinity" literals)
+            "lost": len(lost) + (0 if settled else 1),
+            "promote_s": round(rec["promote_s"], 4),
+            "detect_s": round(promote_start - t_kill, 4),
+            "promote_to_settle_s": (round(first_settle - promote_start,
+                                          4) if settled else None),
+            "kill_to_settle_s": (round(first_settle - t_kill, 4)
+                                 if settled else None),
+            "wal_seq_at_promote": rec["wal_seq"],
+        }
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            for p in (standby, head):
+                if p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=20)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait(timeout=10)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="CI scale: fewer, shorter tasks")
+    ap.add_argument("--tasks", type=int, default=0)
+    ap.add_argument("--task-ms", type=float, default=0.0)
+    ap.add_argument("--assert-sane", action="store_true",
+                    help="fail unless zero tasks lost (every trial) "
+                         "and promote-to-first-settled < 1s (best of "
+                         "<= 3 trials)")
+    ap.add_argument("--json", default=None, metavar="PATH")
+    ap.add_argument("--label", default="")
+    args = ap.parse_args()
+
+    n_tasks = args.tasks or (12 if args.quick else 32)
+    task_ms = args.task_ms or (30.0 if args.quick else 100.0)
+    max_trials = 3 if args.assert_sane else 1
+
+    trials = []
+    for trial in range(max_trials):
+        res = run_trial(n_tasks, task_ms)
+        trials.append(res)
+        print(f"trial {trial}: {json.dumps(res)}", flush=True)
+        if res["lost"]:
+            break  # correctness failure: retries don't apply
+        if not args.assert_sane or (res["promote_to_settle_s"] < 1.0
+                                    and res["promote_s"] < 1.0):
+            break
+
+    best = min(trials,
+               key=lambda r: (r["promote_to_settle_s"]
+                              if r["promote_to_settle_s"] is not None
+                              else 1e9))
+    out_doc = {
+        "bench": "failover_bench",
+        "label": args.label,
+        "quick": bool(args.quick),
+        "params": {"tasks": n_tasks, "task_ms": task_ms},
+        "trials": trials,
+        "best": best,
+    }
+    print(json.dumps(out_doc, indent=1))
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(out_doc, f, indent=1)
+
+    if args.assert_sane:
+        assert all(r["lost"] == 0 for r in trials), \
+            f"tasks lost across the failover: {trials}"
+        assert best["promote_to_settle_s"] < 1.0, \
+            (f"promote-to-first-settled {best['promote_to_settle_s']}s "
+             f">= 1s on every trial: {trials}")
+        assert best["promote_s"] < 1.0, best
+        print("failover_bench: sane "
+              f"(promote {best['promote_s'] * 1e3:.0f}ms, "
+              f"promote->settle {best['promote_to_settle_s'] * 1e3:.0f}"
+              "ms, 0 lost)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,32 @@ _FLAG_DEFS = [
           "How long workers and drivers retry reconnecting to a dead GCS "
           "socket before giving up (reference: raylets reconnecting to a "
           "restarted GCS)."),
+    _flag("gcs_reconnect_deadline_s", 5.0,
+          "Per-dial bounded jittered backoff when the GCS endpoint is "
+          "DEAD (connection refused / socket file missing) — a head "
+          "failover window surfaces as latency instead of "
+          "ConnectionRefusedError.  0 fails fast (seed behavior)."),
+    _flag("gcs_wal", True,
+          "Write-ahead log of durable ledger mutations (fsynced in "
+          "drain batches) under <session>/gcs_state, replayed on top "
+          "of the newest snapshot at head restart and streamed to "
+          "attached warm standbys (DESIGN.md §4l).  Requires "
+          "gcs_snapshot."),
+    _flag("gcs_wal_fsync", True,
+          "fsync each WAL drain batch (group commit).  Disabling "
+          "trades the host-crash guarantee for lower write latency; "
+          "process-crash durability is unaffected."),
+    _flag("gcs_repl_heartbeat_s", 0.2,
+          "Replication heartbeat / epoch-fence poll period on the "
+          "primary's replication drain thread."),
+    _flag("gcs_repl_tsdb_interval_s", 2.0,
+          "How often the primary ships head-TSDB ring deltas to "
+          "attached standbys (history handoff; telemetry-grade, "
+          "best-effort)."),
+    _flag("gcs_standby_timeout_s", 1.0,
+          "A standby promotes after this long without any replication "
+          "frame (heartbeats arrive every gcs_repl_heartbeat_s), or "
+          "immediately on stream EOF with the endpoint verified dead."),
     _flag("gcs_restore_grace_s", 8.0,
           "After a restored-head start, how long restored actors may wait "
           "for their surviving worker process to reattach before the "

@@ -35,7 +35,6 @@ nest inside each other and never acquire the global lock.
 from __future__ import annotations
 
 import os
-import pickle
 import subprocess
 import sys
 import threading
@@ -248,6 +247,17 @@ class PgState:
 # Worker.rpc short-circuits to it; see the note in GcsServer.__init__.
 _INPROC_SERVER: Optional["GcsServer"] = None
 
+# RPC kinds a FENCED head (a higher ledger epoch was claimed by a
+# promoted standby — DESIGN.md §4l) still answers: pure reads that help
+# an operator inspect the fenced process.  Everything else drops the
+# connection so the caller's reconnect path re-dials the promoted head.
+_FENCED_OK_KINDS = frozenset({
+    "ping", "debug_dump", "timeline", "kv_get", "kv_mget", "kv_keys",
+    "peek_meta", "pg_table", "list_nodes", "list_actors", "list_tasks",
+    "list_objects", "list_workers", "cluster_resources", "store_stats",
+    "metrics_query", "fleet_state", "fleet_events", "raylet_table",
+    "resource_demand"})
+
 
 class GcsServer:
     def __init__(self, session: Session, head_resources: Dict[str, float]):
@@ -371,6 +381,17 @@ class GcsServer:
         self._tsdb = None
         self._detectors: List = []
         self._last_detector_check = 0.0
+        # Ledger replication (DESIGN.md §4l): WAL + warm-standby hub,
+        # created below once the durable tables are restored.  The
+        # attribute exists from here so every _repl_record call site is
+        # safe during __init__.  ``_fenced`` is flipped (only ever
+        # False->True, by the hub's drain thread) when a HIGHER ledger
+        # epoch appears in the session dir — a promoted standby owns
+        # the ledger now; this head must drop mutating conns so their
+        # clients re-dial the new endpoint.
+        self._repl_hub = None
+        self._fenced = False
+        self.ledger_epoch = 0
         if GLOBAL_CONFIG.metrics_enabled and GLOBAL_CONFIG.tsdb_enabled:
             from ray_tpu.util.metrics_catalog import SLO_RULES
             from ray_tpu.util.tsdb import (SloBurnAlerter,
@@ -444,15 +465,35 @@ class GcsServer:
         # (_persist_lock is created with the other lock domains above so
         # the watchdog wrap covers it)
         self._persist_event = threading.Event()
+        self._prev_snapshot_wal_seq = 0  # guarded by: _persist_lock
         self._restored_at: Optional[float] = None
-        if GLOBAL_CONFIG.gcs_snapshot and self._snapshot_path.exists():
+        if GLOBAL_CONFIG.gcs_snapshot:
             try:
-                self._restore_durable()
-                self._restored_at = time.monotonic()
+                if self._restore_durable():
+                    self._restored_at = time.monotonic()
             except Exception:  # noqa: BLE001 - corrupt snapshot: fresh start
                 logger.exception("failed to restore GCS snapshot; "
                                  "starting fresh")
         if GLOBAL_CONFIG.gcs_snapshot:
+            # Claim the next ledger epoch (fsynced): any still-alive
+            # older head observes the bump at its fence poll and stops
+            # mutating — the split-brain guard (DESIGN.md §4l).
+            from ray_tpu._private import replication
+            self.ledger_epoch = replication.claim_epoch(session.path)
+            if GLOBAL_CONFIG.gcs_wal:
+                # WAL + warm-standby replication hub: handler threads
+                # record durable mutations (O(1) buffer append); the
+                # hub's drain thread owns fsync, streaming, rotation,
+                # and the epoch-fence poll.
+                tsdb_cb = None
+                if self._tsdb is not None:
+                    tsdb_cb = self._tsdb.export_since
+                self._repl_hub = replication.ReplicationHub(
+                    session.path, self.ledger_epoch,
+                    snapshot_cb=self._capture_durable_state,
+                    tsdb_export_cb=tsdb_cb,
+                    on_fenced=self._on_fenced,
+                    fsync=GLOBAL_CONFIG.gcs_wal_fsync)
             threading.Thread(target=self._persist_loop, name="gcs-persist",
                              daemon=True).start()
 
@@ -497,62 +538,132 @@ class GcsServer:
                 continue
             time.sleep(0.05)  # coalesce bursts of mutations
             self._persist_event.clear()
+            if self._fenced:
+                # a promoted standby owns the ledger: this head must
+                # never clobber the new head's snapshot generations
+                continue
             try:
                 self._write_snapshot()
             except Exception:  # noqa: BLE001 - keep serving; retry next tick
                 logger.exception("GCS snapshot write failed")
                 self._persist_event.set()
 
+    def _on_fenced(self, seen_epoch: int) -> None:
+        """Hub drain thread: a higher ledger epoch appeared in the
+        session dir — refuse mutations from here on (see _serve_conn /
+        local_call; mutating conns are dropped so clients re-dial the
+        promoted head's re-bound socket)."""
+        self._fenced = True
+
+    def _repl_record(self, *op) -> None:
+        """Record one durable ledger mutation into the replication WAL
+        (no-op without the hub; O(1) buffer append — legal under any
+        GCS lock, see REPL_LOCK_DAG)."""
+        hub = self._repl_hub
+        if hub is not None:
+            hub.record(*op)
+
+    def _repl_actor_locked(self, a: "ActorState") -> None:
+        """Lock held.  Record an actor's durable projection after any
+        FSM transition — the same shape the snapshot captures (DEAD
+        actors are absent from snapshots, so DEAD records a delete,
+        which also keeps the standby's tables == the capture)."""
+        if self._repl_hub is None:
+            return
+        if a.state == A_DEAD:
+            self._repl_hub.record("actor", a.actor_id, None)
+        else:
+            self._repl_hub.record(
+                "actor", a.actor_id,
+                {"spec": {k: v for k, v in a.spec.items()
+                          if not k.startswith("_")},
+                 "state": a.state, "restarts_left": a.restarts_left,
+                 "incarnation": a.incarnation})
+
+    def _capture_durable_state(self) -> dict:
+        """Capture the durable tables under lock + _kv_lock (reference:
+        the GCS tables Redis persists — actors, PGs, KV, function
+        exports).  The WAL position is read INSIDE the critical section:
+        every record with seq <= wal_seq is reflected in the captured
+        tables, and replaying any later (or overlapping) record on top
+        is idempotent — the snapshot+WAL equivalence contract the
+        standby and restart paths both lean on."""
+        with self.lock, self._kv_lock:
+            state = {
+                # __metrics__/ snapshots are ephemeral telemetry: a
+                # restored head must not resurrect dead workers'
+                # series, and busy-cluster snapshots must not grow by
+                # one metrics payload per worker
+                # empty namespaces pruned: apply_op prunes a namespace
+                # when its last key is deleted (and a metrics-only one
+                # would capture as {}), so the capture must too or the
+                # snapshot+WAL == capture equivalence oracle diverges
+                "kv": {ns: flt for ns, t in self.kv.items()
+                       if (flt := {k: v for k, v in t.items()
+                                   if not is_metrics_key(k)})},
+                "functions": dict(self.functions),
+                "named_actors": dict(self.named_actors),
+                "actors": {
+                    aid: {"spec": {k: v for k, v in a.spec.items()
+                                   if not k.startswith("_")},
+                          "state": a.state,
+                          "restarts_left": a.restarts_left,
+                          "incarnation": a.incarnation}
+                    for aid, a in self.actors.items()
+                    if a.state != A_DEAD},
+                "pgs": {pid: {"bundles": p.bundles,
+                              "strategy": p.strategy, "name": p.name}
+                        for pid, p in self.pgs.items()
+                        if p.state != "removed"},
+                "shm_objects": {
+                    oid: m.size for oid, m in self.objects.items()
+                    if m.loc == "shm" and m.state == READY},
+                "driver_ids": set(self.driver_ids),
+                "ledger_epoch": self.ledger_epoch,
+                "wal_seq": (self._repl_hub.seq()
+                            if self._repl_hub is not None else 0),
+            }
+        return state
+
     def _write_snapshot(self) -> None:
         """Capture + write under one ordering lock so a slow writer can
-        never clobber a newer snapshot with stale state (reference: the
-        GCS tables Redis persists — actors, PGs, KV, function exports)."""
+        never clobber a newer snapshot with stale state.  The write is
+        crash-safe (fsync tmp + dir, previous generation kept — see
+        replication.write_snapshot_file) and rotates the WAL: records
+        covered by this snapshot are no longer needed for replay."""
+        from ray_tpu._private import replication
         with self._persist_lock:
-            with self.lock, self._kv_lock:
-                state = {
-                    # __metrics__/ snapshots are ephemeral telemetry: a
-                    # restored head must not resurrect dead workers'
-                    # series, and busy-cluster snapshots must not grow by
-                    # one metrics payload per worker
-                    "kv": {ns: {k: v for k, v in t.items()
-                                if not is_metrics_key(k)}
-                           for ns, t in self.kv.items()},
-                    "functions": dict(self.functions),
-                    "named_actors": dict(self.named_actors),
-                    "actors": {
-                        aid: {"spec": {k: v for k, v in a.spec.items()
-                                       if not k.startswith("_")},
-                              "state": a.state,
-                              "restarts_left": a.restarts_left,
-                              "incarnation": a.incarnation}
-                        for aid, a in self.actors.items()
-                        if a.state != A_DEAD},
-                    "pgs": {pid: {"bundles": p.bundles,
-                                  "strategy": p.strategy, "name": p.name}
-                            for pid, p in self.pgs.items()
-                            if p.state != "removed"},
-                    "shm_objects": {
-                        oid: m.size for oid, m in self.objects.items()
-                        if m.loc == "shm" and m.state == READY},
-                    "driver_ids": set(self.driver_ids),
-                }
-            tmp = self._snapshot_path.with_suffix(".tmp")
-            tmp.parent.mkdir(parents=True, exist_ok=True)
-            tmp.write_bytes(pickle.dumps(state))
-            os.replace(tmp, self._snapshot_path)
+            state = self._capture_durable_state()
+            replication.write_snapshot_file(self._snapshot_path, state)
+            # Rotate the WAL one GENERATION behind: segments are only
+            # deleted once covered by the PREVIOUS snapshot too, so the
+            # .prev fallback (torn-newest restore) always finds the WAL
+            # tail that bridges it forward.
+            covered, self._prev_snapshot_wal_seq = \
+                self._prev_snapshot_wal_seq, state["wal_seq"]
+        if self._repl_hub is not None:
+            self._repl_hub.rotate(covered)
 
-    def _restore_durable(self) -> None:
-        """Rebuild durable tables from the snapshot.  Actors come back
-        RESTARTING: their processes may still be alive (workers outlive
-        the head and reconnect — see worker.run_worker_loop); if one
-        doesn't reattach within gcs_restore_grace_s the normal restart
-        path (max_restarts) takes over.
+    def _restore_durable(self) -> bool:
+        """Rebuild durable tables from the newest consistent durable
+        state: the newest readable snapshot generation (a torn newest
+        falls back to the previous one) plus the fsynced WAL tail
+        replayed on top (replication.load_durable_state).  Returns True
+        when anything was restored.  Actors come back RESTARTING: their
+        processes may still be alive (workers outlive the head and
+        reconnect — see worker.run_worker_loop); if one doesn't
+        reattach within gcs_restore_grace_s the normal restart path
+        (max_restarts) takes over.
 
         Everything is parsed into temporaries FIRST, then applied — a
         malformed/old-format snapshot must fail before mutating any
         table, or restored actors would sit RESTARTING forever with no
         grace timer running."""
-        state = pickle.loads(self._snapshot_path.read_bytes())
+        from ray_tpu._private import replication
+        state = replication.load_durable_state(
+            self.session.path, snapshot_path=self._snapshot_path)
+        if state is None:
+            return False
         restored_actors = []
         for aid, rec in state["actors"].items():
             a = ActorState(rec["spec"])
@@ -620,6 +731,7 @@ class GcsServer:
                 meta.loc = "shm"
                 meta.size = size
                 self._publish_sealed_locked(oid, READY, "shm", None, size)
+        return True
 
     def _restore_grace_check(self) -> None:
         """After the reattach grace window, push restored actors whose
@@ -757,6 +869,7 @@ class GcsServer:
         if loc == "shm":
             # segment survives a head crash; keep the snapshot's shm index
             # current so a restarted head re-adopts it (just sets an event)
+            self._repl_record("shm", oid, size)
             self._persist_durable()
         if meta.refcount <= 0:
             # Sealed with zero refs — e.g. an actor result whose caller
@@ -780,6 +893,10 @@ class GcsServer:
 
     def _mark_object_lost(self, oid: str, meta: ObjMeta) -> None:
         self._sealed.pop(oid, None)  # no longer readable without the lock
+        if meta.loc == "shm":
+            # no longer a restorable segment: drop it from the durable
+            # shm index so a promoted/restarted head won't re-adopt it
+            self._repl_record("shm", oid, None)
         if meta.lineage_task and meta.lineage_task in self.lineage:
             meta.state = PENDING
             meta.has_producer = True  # the reconstruction below is the
@@ -820,6 +937,7 @@ class GcsServer:
                 self._decref(c)
             if meta.loc in ("shm", "spilled"):
                 self.store.delete_object(oid)
+                self._repl_record("shm", oid, None)
             elif meta.loc == "slab" and self.slab is not None:
                 self.slab.delete(oid)
             elif meta.loc == "remote":
@@ -1597,6 +1715,8 @@ class GcsServer:
                 a.spec["_creation_error"] = dep_meta.data
                 if a.name:
                     self.named_actors.pop((a.namespace, a.name), None)
+                    self._repl_record("named", a.namespace, a.name, None)
+                self._repl_actor_locked(a)
         self._release_deps(spec)
 
     def _fail_task(self, spec: dict, err: BaseException) -> None:
@@ -1703,6 +1823,8 @@ class GcsServer:
             a.death_reason = a.death_reason or "worker died"
             if a.name:
                 self.named_actors.pop((a.namespace, a.name), None)
+                self._repl_record("named", a.namespace, a.name, None)
+        self._repl_actor_locked(a)
         # restarts_left / liveness changed: keep the snapshot current so a
         # head restart doesn't resurrect a dead actor or reset its budget
         # (just sets the writer thread's event; safe under cv)
@@ -1913,6 +2035,25 @@ class GcsServer:
                         break
                     self._attach_raylet_conn(msg["node_id"], conn, ver)
                     return  # thread becomes the lease-channel reader
+                if kind == "repl_attach":
+                    # warm-standby replication stream (DESIGN.md §4l):
+                    # version-fenced like the lease channel; the hub's
+                    # drain thread owns the conn from here (snapshot
+                    # bootstrap + WAL streaming + heartbeats)
+                    if ver < wire.PROTO_REPL or self._repl_hub is None:
+                        break
+                    self._repl_hub.adopt_standby(conn)
+                    conn = None  # ownership transferred to the hub's
+                    return       # drain thread; finally must not close
+                if self._fenced and kind not in _FENCED_OK_KINDS:
+                    # a promoted standby owns the ledger (higher epoch
+                    # seen): drop the conn instead of erroring the call
+                    # — the client's reconnect path re-dials gcs.sock,
+                    # which the new head re-bound (DESIGN.md §4l)
+                    logger.warning("fenced head dropping %s conn "
+                                   "(client %s)", kind,
+                                   str(client_id)[:8])
+                    break
                 if seen_ver == 0 and ver == 0 \
                         and GLOBAL_CONFIG.proto_min_version > 0:
                     # un-negotiated legacy peer on a version-fenced server.
@@ -1983,7 +2124,8 @@ class GcsServer:
             except Exception:  # noqa: BLE001 - shutdown path
                 logger.exception("final ref-op drain failed")
             try:
-                conn.close()
+                if conn is not None:  # None: handed off to the repl hub
+                    conn.close()
             except OSError:
                 pass
 
@@ -2077,6 +2219,15 @@ class GcsServer:
             except (EOFError, OSError, wire.WireError):
                 break
             kind = msg.get("kind")
+            if self._fenced:
+                # a promoted standby owns the ledger: raylet reports
+                # mutate actor/lease/object state, so the fence must
+                # cover this channel too — drop it; the raylet's
+                # upstream-EOF path re-dials gcs.sock, which the new
+                # head re-bound (DESIGN.md §4l)
+                logger.warning("fenced head dropping raylet channel "
+                               "(node %s, frame %s)", node_id[:8], kind)
+                break
             if flight_recorder.enabled():
                 flight_recorder.record("raylet_frame",
                                        f"{kind} node={node_id[:8]}")
@@ -2827,6 +2978,7 @@ class GcsServer:
                         node.acquire(req)
                         a.spec["_req"] = req
                         a.spec["_node"] = w.node_id
+                self._repl_actor_locked(a)
                 self.cv.notify_all()
                 return
             self.running.pop(a.spec["task_id"], None)
@@ -2848,6 +3000,7 @@ class GcsServer:
                     # reference default-actor semantics: 1 CPU for
                     # creation scheduling, 0 held while alive
                     self._release_task_resources(a.spec)
+                self._repl_actor_locked(a)
             else:
                 spec = w.current_task
                 w.current_task = None
@@ -2869,6 +3022,8 @@ class GcsServer:
                 a.spec["_creation_error"] = msg.get("error")
                 if a.name:
                     self.named_actors.pop((a.namespace, a.name), None)
+                    self._repl_record("named", a.namespace, a.name, None)
+                self._repl_actor_locked(a)
             self.cv.notify_all()
         self._pump()
 
@@ -2891,6 +3046,12 @@ class GcsServer:
         to break mid-reply."""
         if self._shutdown:
             raise ConnectionError("GCS is shut down")
+        if self._fenced and kind not in _FENCED_OK_KINDS:
+            # same contract as the socket path's conn drop: the caller's
+            # reconnect machinery re-dials and reaches the promoted head
+            raise ConnectionError(
+                "GCS fenced: a newer ledger epoch was claimed by a "
+                "promoted standby")
         resp = self._dispatch(kind, msg)
         return {"error": None, **(resp or {})}
 
@@ -2942,6 +3103,7 @@ class GcsServer:
                 w.state = "driver"
                 self.workers[wid] = w
                 self.driver_ids.add(wid)
+                self._repl_record("driver", wid)
             self.cv.notify_all()
             return {"node_id": w.node_id, "head_node_id": self.head_node_id,
                     "epoch": self.epoch,
@@ -3354,6 +3516,7 @@ class GcsServer:
                 meta = self.objects.pop(oid, None)
                 if meta is not None and meta.loc in ("shm", "spilled"):
                     self.store.delete_object(oid)
+                    self._repl_record("shm", oid, None)
                 elif meta is not None and meta.loc == "slab" \
                         and self.slab is not None:
                     self.slab.delete(oid)
@@ -3583,6 +3746,10 @@ class GcsServer:
                 self.named_actors[key] = a.actor_id
             self.actors[a.actor_id] = a
             self._push_pending(spec)
+            if a.name:
+                self._repl_record("named", a.namespace, a.name,
+                                  a.actor_id)
+            self._repl_actor_locked(a)
         self._persist_durable()
         self._pump()
         return {"actor_id": a.actor_id, "existing": False}
@@ -3627,6 +3794,7 @@ class GcsServer:
                 a.spec["_killed"] = True
                 a.restarts_left = 0
             a.death_reason = "ray_tpu.kill"
+            self._repl_actor_locked(a)  # restart budget zeroed
             w = self.workers.get(a.worker_id) if a.worker_id else None
         if w is not None and w.proc is not None:
             try:
@@ -3644,6 +3812,8 @@ class GcsServer:
                 a.state = A_DEAD
                 if a.name:
                     self.named_actors.pop((a.namespace, a.name), None)
+                    self._repl_record("named", a.namespace, a.name, None)
+                self._repl_actor_locked(a)
             self.cv.notify_all()
         self._persist_durable()
         return {}
@@ -3653,6 +3823,8 @@ class GcsServer:
         with self.lock:
             new = msg["fn_id"] not in self.functions
             self.functions.setdefault(msg["fn_id"], msg["blob"])
+            if new:
+                self._repl_record("fn", msg["fn_id"], msg["blob"])
         if new:
             self._persist_durable()
         return {}
@@ -3686,6 +3858,14 @@ class GcsServer:
             existed = msg["key"] in ns
             if not (msg.get("overwrite", True) is False and existed):
                 ns[msg["key"]] = msg["value"]
+                if not metrics_key:
+                    # WAL capture inside the critical section so two
+                    # racing puts of one key record in table order
+                    # (O(1) buffer append; metrics keys are ephemeral
+                    # and excluded from the durable set)
+                    self._repl_record("kv",
+                                      msg.get("namespace", "default"),
+                                      msg["key"], msg["value"])
             if metrics_key:
                 # receipt index shares _kv_lock with the sweep (rtlint
                 # unguarded: a bare-dict update raced the sweep's
@@ -3719,6 +3899,9 @@ class GcsServer:
             existed = self.kv[msg.get("namespace", "default")].pop(msg["key"], None)
             if existed is not None and metrics_key:
                 self._metrics_key_seen.pop(msg["key"], None)
+            elif existed is not None:
+                self._repl_record("kv", msg.get("namespace", "default"),
+                                  msg["key"], None)
         if existed is not None and not metrics_key:
             # same ephemeral-telemetry exemption as _h_kv_put: metrics
             # keys are excluded from the snapshot, so reaping one must
@@ -3758,6 +3941,9 @@ class GcsServer:
                     pg.assignment[i] = node_id
                 pg.state = READY
             self.pgs[pg.pg_id] = pg
+            self._repl_record("pg", pg.pg_id,
+                              {"bundles": pg.bundles,
+                               "strategy": pg.strategy, "name": pg.name})
             self.cv.notify_all()
         self._persist_durable()
         return {"state": pg.state}
@@ -3797,6 +3983,8 @@ class GcsServer:
                     node = self.nodes.get(node_id)
                     if node is not None:
                         node.release_res(pg.bundles[i])
+            if pg is not None:
+                self._repl_record("pg", msg["pg_id"], None)
             self.cv.notify_all()
         self._persist_durable()
         self._pump()
@@ -4374,6 +4562,10 @@ class GcsServer:
         except OSError:
             pass
         self._data_pool.close_all()
+        if self._repl_hub is not None:
+            # discharge the WAL fd and every standby conn (the runtime
+            # resource oracle asserts this below)
+            self._repl_hub.close()
         self.store.shutdown()
         if self.slab is not None:
             self.slab.close()

@@ -161,6 +161,24 @@ ELASTIC_NOBLOCK_LOCKS: Set[str] = {"_cursor_lock"}
 
 ELASTIC_CV_ALIASES: Dict[str, str] = {}
 
+# GCS replication (replication.py, DESIGN.md §4l): both classes keep
+# ONE no-block leaf lock.  The hub's ``_lock`` guards the WAL seq
+# counter, the record buffer, and the standby adoption queue — GCS
+# handler threads append under it in O(1) while holding GCS locks (the
+# cross-domain edge mirrors lock -> _events_lock); every file write,
+# fsync, and standby send happens on the single drain thread with no
+# lock held.  The standby's ``_lock`` guards the applied tables +
+# stream cursor; the stream recv and the promote file I/O run outside
+# it (snapshot_state copies the tables out under it).
+REPL_LOCK_DAG: Dict[str, Set[str]] = {
+    "_lock": set(),
+    "_promote_lock": {"_lock"},  # promote copies the tables under _lock
+}
+
+REPL_NOBLOCK_LOCKS: Set[str] = {"_lock"}
+
+REPL_CV_ALIASES: Dict[str, str] = {}
+
 # Metrics TSDB (util/tsdb.py, DESIGN.md §4k): one no-block leaf lock
 # guards the series table, rings, and ingest counters.  Critical
 # sections are O(dict/ring op); queries copy samples out under it and

@@ -104,6 +104,51 @@ def connect(path: str) -> Connection:
             delay = min(0.2, delay * 2)
 
 
+def backoff_delays(cap: float = 0.25, base: float = 0.02):
+    """Jittered exponential backoff delays (generator, never ends —
+    the CALLER owns the deadline).  Jitter keeps a fleet of dialers
+    hitting a recovering endpoint spread out instead of synchronized."""
+    import random
+    delay = base
+    while True:
+        yield delay * random.uniform(0.5, 1.5)
+        delay = min(cap, delay * 2)
+
+
+def connect_retry(path: str, deadline_s: float | None = None,
+                  connect_fn=None) -> Connection:
+    """GCS dial that treats a DEAD endpoint as a failover window, not an
+    error: bounded jittered backoff on ConnectionRefusedError (stale
+    socket file — the old head died) and FileNotFoundError (the
+    promoted head hasn't re-bound the path yet), on top of connect()'s
+    EAGAIN handling.  ``deadline_s`` defaults to the
+    ``gcs_reconnect_deadline_s`` config; 0 fails fast (seed behavior).
+    Also retries a ConnectionError raised by ``connect_fn`` itself when
+    it mentions the proxy (a tunneled dial whose gcs.sock target is
+    mid-failover)."""
+    if deadline_s is None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        deadline_s = GLOBAL_CONFIG.gcs_reconnect_deadline_s
+    fn = connect_fn or (lambda: connect(path))
+    deadline = time.monotonic() + max(0.0, deadline_s)
+    for delay in backoff_delays():
+        try:
+            return fn()
+        except (ConnectionRefusedError, FileNotFoundError,
+                ConnectionResetError) as e:
+            if time.monotonic() + delay > deadline:
+                raise
+            _ = e
+        except ConnectionError as e:
+            # tunneled dials surface a dead gcs.sock as the proxy's
+            # error reply; anything else (auth, version fence) is final
+            if "client proxy" not in str(e) \
+                    or time.monotonic() + delay > deadline:
+                raise
+        time.sleep(delay)
+    raise ConnectionError("unreachable")  # pragma: no cover
+
+
 def make_tcp_listener(host: str, port: int) -> Listener:
     """TCP listener for the client proxy (reference: Ray Client's gRPC
     endpoint ray://host:10001)."""

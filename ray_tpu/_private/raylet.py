@@ -238,6 +238,9 @@ class Raylet:
         deadline = time.monotonic() + GLOBAL_CONFIG.gcs_reconnect_timeout_s
         logger.warning("lost lease channel to head; rejoining for up "
                        "to %.0fs", GLOBAL_CONFIG.gcs_reconnect_timeout_s)
+        # jittered backoff (protocol.backoff_delays): a fleet of raylets
+        # re-joining a promoted standby must not dial in lockstep
+        delays = protocol.backoff_delays(cap=0.5, base=0.05)
         while not self._stop.is_set() and time.monotonic() < deadline:
             conn = None
             try:
@@ -276,7 +279,7 @@ class Raylet:
                         conn.close()
                     except OSError:
                         pass
-                if self._stop.wait(0.5):
+                if self._stop.wait(next(delays)):
                     return False
         if not self._stop.is_set():
             logger.error("could not rejoin head; shutting down node")

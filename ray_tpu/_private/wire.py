@@ -41,7 +41,8 @@ from typing import Any, Tuple
 PROTO_MIN = 1   # framed, pickle codec only
 PROTO_TRACE = 3  # understands the optional TRACE_FIELD on any frame
 PROTO_RAYLET = 4  # speaks the raylet lease kinds (RAYLET_KINDS below)
-PROTO_MAX = 4   # framed, rtmsg + pickle fallback + trace + raylet leases
+PROTO_REPL = 5  # speaks the GCS replication kinds (REPL_KINDS below)
+PROTO_MAX = 5   # framed, rtmsg + pickle + trace + raylet + replication
 _PICKLE_OPCODE = 0x80  # first byte of every pickle protocol>=2 stream
 
 # Optional span-context frame field (Dapper-style wire propagation):
@@ -264,6 +265,30 @@ RAYLET_UP_KINDS = frozenset({
     "raylet_detach",       # clean leave: reclaim leases, remove the node
 })
 RAYLET_KINDS = RAYLET_DOWN_KINDS | RAYLET_UP_KINDS
+
+# -------------------------------------------------- GCS replication plane
+# Ledger replication to a warm standby head (``_private/replication.py``,
+# DESIGN.md §4l; reference analog: GCS fault tolerance via Redis-backed
+# table persistence).  A standby converts one GCS connection into a
+# one-way replication stream with ``repl_attach`` — version-fenced at
+# PROTO_REPL exactly like the raylet lease channel, so no older peer
+# ever sees these kinds.  Every frame is a oneway (rid None): the
+# stream's loss IS the failure signal (the standby probes the endpoint
+# and promotes).  One kind per line (line-anchored waivers, like
+# REF_KINDS); tools/rtlint's wire pass asserts arm + producer per kind.
+
+# standby -> GCS:
+REPL_UP_KINDS = frozenset({
+    "repl_attach",     # converts the conn into the replication stream
+})
+# GCS -> standby pushes:
+REPL_DOWN_KINDS = frozenset({
+    "repl_snapshot",   # full durable-state bootstrap (+ wal position)
+    "repl_wal",        # batch of ledger WAL records, seq-ordered
+    "repl_heartbeat",  # liveness + current epoch/seq
+    "repl_tsdb",       # head TSDB raw-ring deltas (history handoff)
+})
+REPL_KINDS = REPL_DOWN_KINDS | REPL_UP_KINDS
 
 # ------------------------------------------------------------ bulk frames
 # Data-plane streaming (``_private/data_plane.py``): after a

@@ -142,6 +142,10 @@ class Worker:
         self.node_id = node_id
         self.proxy_addr = proxy_addr
         self.is_client = proxy_addr is not None
+        # GCS dials ride a bounded jittered backoff on dead-endpoint
+        # errors (protocol.connect_retry): a head failover window
+        # (standby promoting, socket re-binding — DESIGN.md §4l)
+        # surfaces as dial latency, not ConnectionRefusedError.
         if self.is_client:
             # remote-client mode (reference: Ray Client, SURVEY.md §2.3):
             # every connection tunnels through the TCP proxy; no local
@@ -149,11 +153,14 @@ class Worker:
             self.gcs_path = "gcs"
             self.pool = protocol.RpcPool(
                 self.gcs_path, on_new=self._on_new_channel,
-                connect_fn=lambda: self._tunnel("gcs"))
+                connect_fn=lambda: protocol.connect_retry(
+                    self.gcs_path,
+                    connect_fn=lambda: self._tunnel("gcs")))
         else:
             self.gcs_path = session.socket_path("gcs.sock")
-            self.pool = protocol.RpcPool(self.gcs_path,
-                                         on_new=self._on_new_channel)
+            self.pool = protocol.RpcPool(
+                self.gcs_path, on_new=self._on_new_channel,
+                connect_fn=lambda: protocol.connect_retry(self.gcs_path))
         self._put_seq = _counter()
         self._ret_seq = _counter()
         self._task_seq = _counter()
@@ -387,8 +394,11 @@ class Worker:
             return None
         from ray_tpu._private import gcs as gcs_mod
         srv = gcs_mod._INPROC_SERVER
-        if srv is not None and not srv._shutdown \
+        if srv is not None and not srv._shutdown and not srv._fenced \
                 and srv.rpc_path == self.gcs_path:
+            # _fenced: a promoted standby claimed the ledger (§4l) —
+            # fall through to the socket path, which re-dials gcs.sock
+            # and lands on the NEW head's re-bound listener
             return srv
         return None
 
@@ -538,6 +548,9 @@ class Worker:
             return self._tunnel(addr)
         if tcp is not None:
             return protocol.connect_addr(addr, timeout=3.0)
+        if addr == self.gcs_path:
+            # head socket: cover the failover re-bind window (§4l)
+            return protocol.connect_retry(addr)
         return protocol.connect_addr(addr)
 
     def _dial_data(self, addr: str):

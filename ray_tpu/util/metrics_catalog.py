@@ -223,6 +223,19 @@ CATALOG: Dict[str, dict] = {
         description="Samples ingested into the head TSDB from "
                     "__metrics__/ snapshot receipts",
         emitted_by="head (GCS)"),
+    # --- GCS replication / head fault tolerance (DESIGN.md §4l) -------------
+    "rtpu_gcs_wal_records_total": dict(
+        kind="counter", tag_keys=(),
+        description="Durable ledger mutations appended to the GCS "
+                    "write-ahead log (fsynced in drain batches, "
+                    "streamed to attached warm standbys)",
+        emitted_by="head (GCS)"),
+    "rtpu_gcs_repl_standbys": dict(
+        kind="gauge", tag_keys=(),
+        description="Warm standby heads currently attached to the "
+                    "replication stream (0 = a head failure falls back "
+                    "to snapshot+WAL restart over the session dir)",
+        emitted_by="head (GCS)"),
     "rtpu_anomaly_events_total": dict(
         kind="counter", tag_keys=("kind",),
         description="Anomalies emitted into the fleet-event feed by the "

@@ -474,9 +474,13 @@ class TSDB:
             snapshot = payload["snapshot"]
         except Exception:  # noqa: BLE001 - corrupt snapshot: skip whole
             return 0
-        ts = self._clock() if now is None else now
         stored = 0
         with self._lock:
+            # stamped INSIDE the lock: export_since's cursor is a single
+            # global high-water mark, so store order must match timestamp
+            # order — a sample stamped before the lock could land BEHIND
+            # an already-exported newest and be skipped forever
+            ts = self._clock() if now is None else now
             for name, m in snapshot.items():
                 kind = m.get("kind", "untyped")
                 for s in m.get("series", ()):
@@ -543,6 +547,70 @@ class TSDB:
             mcat.get("rtpu_tsdb_samples_total").inc(stored)
         except Exception:  # noqa: BLE001 - telemetry best-effort
             pass
+
+    # --------------------------------------------------- replication export
+    def export_since(self, since_ts: float) -> Tuple[List[dict], float]:
+        """Raw-ring samples strictly newer than ``since_ts``, per
+        series — the GCS replication hub ships these deltas to warm
+        standbys (DESIGN.md §4l) so the head's metric history survives
+        a failover.  Returns ``(dump, newest_ts)``; feed ``newest_ts``
+        back as the next cursor.  Copies out under the leaf lock; cost
+        scales with NEW samples, not store size, once the cursor
+        advances."""
+        out: List[dict] = []
+        newest = since_ts
+        with self._lock:
+            for ser in self._series.values():
+                if ser.last_ts <= since_ts:
+                    continue
+                samples = [(ts, v) for ts, v in
+                           ser.rings[0].samples(since_ts, ser.last_ts)
+                           if ts > since_ts]
+                if not samples:
+                    continue
+                newest = max(newest, samples[-1][0])
+                out.append({"name": ser.name, "kind": ser.kind,
+                            "tags": dict(ser.tags),
+                            "boundaries": ser.boundaries,
+                            "samples": samples})
+        return out, newest
+
+    def seed(self, dump: Iterable[dict]) -> int:
+        """Inverse of :meth:`export_since`: adopt exported samples into
+        this store (a promoted standby inheriting the dead primary's
+        history).  Samples route through ``Series.add`` so every ladder
+        rung populates; per-series monotonicity (``ts > last_ts``)
+        makes overlapping deltas idempotent; ``max_series`` is honored
+        exactly like ingest."""
+        added = 0
+        with self._lock:
+            for rec in dump:
+                try:
+                    name = rec["name"]
+                    tags = dict(rec["tags"])
+                    samples = rec.get("samples") or ()
+                except Exception:  # noqa: BLE001 - one malformed record
+                    continue
+                key = (name, tuple(sorted(tags.items())))
+                ser = self._series.get(key)
+                if ser is None:
+                    if len(self._series) >= self.max_series:
+                        self._dropped_series += 1
+                        continue
+                    bounds = rec.get("boundaries")
+                    ser = Series(name, rec.get("kind", "untyped"), tags,
+                                 tuple(bounds) if bounds else None,
+                                 self.raw_slots)
+                    self._series[key] = ser
+                    self._by_name.setdefault(name, []).append(ser)
+                for ts, val in samples:
+                    ts = float(ts)
+                    if ts > ser.last_ts:
+                        ser.add(ts, tuple(val)
+                                if isinstance(val, list) else val)
+                        added += 1
+            self._samples_total += added
+        return added
 
     # -------------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:

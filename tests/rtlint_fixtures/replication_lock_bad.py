@@ -1,0 +1,40 @@
+"""rtlint fixture: POSITIVE under the REPL DAG
+(lock_watchdog.REPL_LOCK_DAG) — blocking work under the hub's no-block
+leaf, a reversed _lock -> _promote_lock edge, and a lockless write to a
+guarded field.  Not a test module (no test_ prefix); exercised by
+tests/test_rtlint.py."""
+
+import threading
+
+
+class BadReplicationHub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._promote_lock = threading.Lock()
+        self._seq = 0                        # guarded by: _lock
+        self._buf = []                       # guarded by: _lock
+
+    def fsync_under_buffer_lock(self, fd):
+        # WAL I/O belongs on the drain thread with no lock held: an
+        # fsync under the record-buffer leaf would stall every GCS
+        # handler thread mid-mutation (§4d: no blocking under leaves)
+        import os
+        with self._lock:
+            os.fsync(fd)
+
+    def send_under_buffer_lock(self, conn, msg):
+        with self._lock:
+            conn.send(msg)
+
+    def promote_inside_buffer_lock(self):
+        # the documented edge is _promote_lock -> _lock (promote copies
+        # the tables out); the reverse inverts the DAG
+        with self._lock:
+            with self._promote_lock:
+                return list(self._buf)
+
+    def lockless_seq_bump(self):
+        # the WAL position is shared with every handler thread — a bare
+        # increment races the drain
+        self._seq += 1
+        return self._seq

@@ -1,0 +1,33 @@
+"""rtlint fixture: NEGATIVE under the REPL DAG — the discipline
+replication.py follows: O(1) buffer appends under the leaf, all file
+I/O and sends on the drain side with no lock held, and promote taking
+_promote_lock before copying the tables out under _lock."""
+
+import threading
+
+
+class OkReplicationHub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._promote_lock = threading.Lock()
+        self._seq = 0                        # guarded by: _lock
+        self._buf = []                       # guarded by: _lock
+
+    def record(self, op):
+        with self._lock:
+            self._seq += 1
+            self._buf.append((self._seq, op))
+
+    def drain(self, fd, conn, msg):
+        import os
+        with self._lock:
+            batch, self._buf = self._buf, []
+        # I/O strictly outside the leaf lock
+        os.fsync(fd)
+        conn.send(msg)
+        return batch
+
+    def promote(self):
+        with self._promote_lock:
+            with self._lock:
+                return list(self._buf)

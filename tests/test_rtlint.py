@@ -467,3 +467,102 @@ def test_seeded_reorder_is_caught():
         assert "_waiter_lock" in found[0].message
     finally:
         os.unlink(path)
+
+
+# ------------------------------------------------- replication coverage
+def _repl_spec():
+    from ray_tpu._private import lock_watchdog as lw
+    from tools.rtlint.lockorder import LockSpec
+    return LockSpec(lw.REPL_LOCK_DAG, lw.REPL_NOBLOCK_LOCKS,
+                    lw.REPL_CV_ALIASES, set())
+
+
+def test_replication_lock_pass_flags_positive_fixture():
+    """The lock/guarded passes cover replication.py with the REPL DAG:
+    blocking I/O under the hub's record-buffer leaf, the inverted
+    _lock -> _promote_lock edge, and a lockless write to the guarded
+    seq counter are findings."""
+    from ray_tpu._private import lock_watchdog as lw
+    found = check_locks(load(FIX / "replication_lock_bad.py"),
+                        _repl_spec())
+    rules = _rules(found)
+    assert "lock-blocking" in rules, found
+    assert "lock-order" in rules, found
+    guarded = check_guarded(load(FIX / "replication_lock_bad.py"),
+                            set(lw.REPL_LOCK_DAG),
+                            lw.REPL_CV_ALIASES)
+    assert any(f.rule == "unguarded" for f in guarded), guarded
+
+
+def test_replication_lock_pass_silent_on_negative_fixture():
+    from ray_tpu._private import lock_watchdog as lw
+    found = check_locks(load(FIX / "replication_lock_ok.py"),
+                        _repl_spec())
+    assert found == [], found
+    guarded = check_guarded(load(FIX / "replication_lock_ok.py"),
+                            set(lw.REPL_LOCK_DAG),
+                            lw.REPL_CV_ALIASES)
+    assert guarded == [], guarded
+
+
+def test_replication_dag_is_the_watchdog_dag_and_acyclic():
+    from ray_tpu._private import lock_watchdog as lw
+    spec = _repl_spec()
+    assert spec.dag is lw.REPL_LOCK_DAG
+    reach = lw.reachable(lw.REPL_LOCK_DAG)
+    for lock, succ in reach.items():
+        assert lock not in succ, f"cycle through {lock}"
+
+
+def test_replication_module_in_resource_pass_scope():
+    """The resource-lifecycle pass scans replication.py (the WAL fd,
+    the standby stream conn, and adopted standby conns all carry
+    discharge obligations)."""
+    from tools.rtlint.resources import default_files
+    names = {p.name for p in default_files(ROOT)}
+    assert "replication.py" in names
+
+
+def test_replication_wire_kinds_checked():
+    """The wire pass proves every REPL_* kind has its endpoint arm and
+    producer — and catches a seeded kind with neither."""
+    import os as _os
+    import tempfile
+
+    from tools.rtlint.wirecheck import check_wire, default_config
+
+    cfg = default_config(ROOT)
+    real = [f for f in check_wire(cfg) if "repl_" in f.message]
+    assert real == [], real  # the real tree's REPL kinds all check out
+    wire_src = (ROOT / "ray_tpu" / "_private" / "wire.py").read_text()
+    assert '"repl_phantom"' not in wire_src
+    seeded = wire_src.replace(
+        '    "repl_snapshot",',
+        '    "repl_snapshot",\n    "repl_phantom",')
+    tmpdir = tempfile.mkdtemp()
+    try:
+        # a minimal tree: the seeded wire.py next to the REAL gcs.py /
+        # replication.py so only the phantom kind lacks arm+producer
+        priv = _os.path.join(tmpdir, "ray_tpu", "_private")
+        _os.makedirs(priv)
+        with open(_os.path.join(priv, "wire.py"), "w") as f:
+            f.write(seeded)
+        for name in ("gcs.py", "replication.py"):
+            src = (ROOT / "ray_tpu" / "_private" / name).read_text()
+            with open(_os.path.join(priv, name), "w") as f:
+                f.write(src)
+        cfg2 = cfg._replace(
+            wire_path=Path(priv) / "wire.py",
+            server_paths=[Path(priv) / "gcs.py"],
+            producer_paths=[Path(priv) / "gcs.py",
+                            Path(priv) / "replication.py"],
+            c_paths=[], dedup_path=None, extra_handlers={},
+            trace_scan_paths=[])
+        found = check_wire(cfg2)
+        phantom = [f for f in found if "repl_phantom" in f.message]
+        rules = {f.rule for f in phantom}
+        assert "wire-no-handler" in rules, found
+        assert "wire-no-producer" in rules, found
+    finally:
+        import shutil
+        shutil.rmtree(tmpdir)

@@ -91,6 +91,10 @@ def run_pass(name: str) -> List[Finding]:
             load(REPO_ROOT / "ray_tpu" / "util" / "tsdb.py"),
             LockSpec(lw.TSDB_LOCK_DAG, lw.TSDB_NOBLOCK_LOCKS,
                      lw.TSDB_CV_ALIASES, set()))
+        out += check_locks(
+            load(priv / "replication.py"),
+            LockSpec(lw.REPL_LOCK_DAG, lw.REPL_NOBLOCK_LOCKS,
+                     lw.REPL_CV_ALIASES, set()))
         return out
     if name == "guarded":
         from ray_tpu._private import lock_watchdog as lw
@@ -122,6 +126,8 @@ def run_pass(name: str) -> List[Finding]:
         out += check_guarded(
             load(REPO_ROOT / "ray_tpu" / "util" / "tsdb.py"),
             set(lw.TSDB_LOCK_DAG), lw.TSDB_CV_ALIASES)
+        out += check_guarded(load(priv / "replication.py"),
+                             set(lw.REPL_LOCK_DAG), lw.REPL_CV_ALIASES)
         return out
     if name == "wire":
         from tools.rtlint.wirecheck import check_wire, default_config

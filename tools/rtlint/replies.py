@@ -127,6 +127,14 @@ def default_specs() -> List[ServeSpec]:
                              "raylet_task_unblocked",
                              "raylet_heartbeat", "raylet_lease_return",
                              "raylet_workers", "raylet_detach"})),
+        # the standby's replication stream (§4l) is a pure one-way
+        # push consumer: no arm may ever reply on the conn — loss of
+        # the stream IS the failure signal (probe + promote)
+        ServeSpec("ray_tpu/_private/replication.py",
+                  "StandbyHead._stream_loop",
+                  frozenset({"conn"}), frozenset({"kind"}),
+                  frozenset({"repl_snapshot", "repl_wal",
+                             "repl_heartbeat", "repl_tsdb"})),
     ]
 
 

@@ -854,7 +854,7 @@ def default_files(root: Path) -> List[Path]:
     return [priv / n for n in
             ("data_plane.py", "gcs.py", "worker.py", "protocol.py",
              "shm_store.py", "node_agent.py", "actor_server.py",
-             "resource_sanitizer.py", "raylet.py")] + \
+             "resource_sanitizer.py", "raylet.py", "replication.py")] + \
            [elastic / n for n in
             ("events.py", "manager.py", "worker_loop.py")]
 

@@ -405,6 +405,45 @@ def check_wire(cfg: WireConfig) -> List[Finding]:
                     wire_sf.rel, line, "wire-no-producer",
                     f"raylet down-kind {kind!r} is never produced by "
                     f"gcs.py"))
+    # --- GCS replication kinds (§4l) --------------------------------
+    # Up-kinds (standby -> GCS) need a GCS dispatch arm and a
+    # replication.py producer; down-kinds (GCS -> standby) need a
+    # replication.py dispatch arm and a replication.py producer (the
+    # hub builds every frame).  Fenced at PROTO_REPL, so nothing else
+    # may forge them.
+    pdecl = _kind_decls(wire_sf, {"REPL_DOWN_KINDS", "REPL_UP_KINDS"})
+    rdown = pdecl.get("REPL_DOWN_KINDS", {})
+    rup = pdecl.get("REPL_UP_KINDS", {})
+    if rdown or rup:
+        repl_p = cfg.wire_path.parent / "replication.py"
+        gcs_p = cfg.wire_path.parent / "gcs.py"
+        repl_sf = load(repl_p) if repl_p.exists() else None
+        gcs_sf3 = load(gcs_p) if gcs_p.exists() else None
+        repl_arms = _compare_arms(repl_sf.tree) if repl_sf else set()
+        gcs_arms3 = _compare_arms(gcs_sf3.tree) if gcs_sf3 else set()
+        repl_prod = _lease_producers(repl_sf) if repl_sf else set()
+        for kind, line in sorted(rup.items()):
+            if kind not in gcs_arms3:
+                findings.append(Finding(
+                    wire_sf.rel, line, "wire-no-handler",
+                    f"replication up-kind {kind!r} has no dispatch arm "
+                    f"in gcs.py"))
+            if kind not in repl_prod:
+                findings.append(Finding(
+                    wire_sf.rel, line, "wire-no-producer",
+                    f"replication up-kind {kind!r} is never produced "
+                    f"by replication.py"))
+        for kind, line in sorted(rdown.items()):
+            if kind not in repl_arms:
+                findings.append(Finding(
+                    wire_sf.rel, line, "wire-no-handler",
+                    f"replication down-kind {kind!r} has no dispatch "
+                    f"arm in replication.py"))
+            if kind not in repl_prod:
+                findings.append(Finding(
+                    wire_sf.rel, line, "wire-no-producer",
+                    f"replication down-kind {kind!r} is never produced "
+                    f"by replication.py"))
     # the coalesced dispatch arms must equal REF_KINDS exactly
     if ref_arms or ref:
         for kind in sorted(set(ref) - ref_arms):
