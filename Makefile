@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
 	databench-quick servebench-quick llmbench-quick tracebench-quick \
 	releasebench-quick fleetbench-quick obsbench-quick \
-	failoverbench-quick leakcheck
+	failoverbench-quick trainbench-quick leakcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -134,6 +134,18 @@ obsbench-quick:
 failoverbench-quick:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/failover_bench.py --quick \
 		--assert-sane --json benchmarks/results/failoverbench_ci.json \
+		--label ci
+
+# Overlap-scheduled train-step smoke (CI): interleaved A/B of the
+# decomposed-collective-matmul + sequence-parallel step vs the
+# un-overlapped GSPMD step on the same (data, seq, tensor) mesh;
+# asserts loss-trajectory parity and (where device traces exist) that
+# the overlapped step exposes no more collective time than the
+# baseline.  The committed full-scale artifact is
+# benchmarks/results/overlap_bench_r14.json.
+trainbench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/train_bench.py --quick \
+		--assert-sane --json benchmarks/results/trainbench_ci.json \
 		--label ci
 
 # LLM serving smoke (CI): the continuous-batching engine vs the naive

@@ -115,9 +115,27 @@ def _delivered_matmul_tflops(jax, jnp) -> dict:
 # XLA device traces carry the HLO op of their root: collectives are
 # all-reduce/all-gather/reduce-scatter/collective-permute (+ the jax
 # spellings psum/ppermute); everything else on a compute lane counts as
-# compute.
-_COLLECTIVE_PAT = ("all-reduce", "all-gather", "reduce-scatter",
-                   "all-to-all", "collective-permute", "ppermute", "psum")
+# compute.  Ordered: first substring hit names the op KIND so exposed
+# time is attributable per collective family, not just visible in
+# aggregate ("collective-permute" before "permute"-free fallbacks;
+# "reduce-scatter" before "all-reduce" would also match "reduce").
+_COLLECTIVE_KINDS = (
+    ("reduce-scatter", "reduce_scatter"),
+    ("all-reduce", "psum"),
+    ("psum", "psum"),
+    ("all-gather", "all_gather"),
+    ("collective-permute", "ppermute"),
+    ("ppermute", "ppermute"),
+    ("all-to-all", "all_to_all"),
+)
+_COLLECTIVE_PAT = tuple(p for p, _ in _COLLECTIVE_KINDS)
+
+
+def _collective_kind(name: str):
+    for pat, kind in _COLLECTIVE_KINDS:
+        if pat in name:
+            return kind
+    return None
 
 
 def _merged_busy_us(intervals) -> float:
@@ -166,6 +184,7 @@ def _overlap_breakdown(jax, step_once, steps: int = 3):
         except Exception:  # noqa: BLE001 - profiler unavailable
             return None
         coll, comp = [], []
+        by_kind: dict = {}
         for raw in profile_event_lists(out_dir):
             dev_pids = {
                 e.get("pid") for e in raw
@@ -180,8 +199,10 @@ def _overlap_breakdown(jax, step_once, steps: int = 3):
                 if not dur:
                     continue
                 iv = (float(e["ts"]), dur)
-                if any(p in name for p in _COLLECTIVE_PAT):
+                kind = _collective_kind(name)
+                if kind is not None:
                     coll.append(iv)
+                    by_kind.setdefault(kind, []).append(iv)
                 else:
                     comp.append(iv)
         if not coll and not comp:
@@ -191,12 +212,28 @@ def _overlap_breakdown(jax, step_once, steps: int = 3):
         both_us = _merged_busy_us(coll + comp)
         overlapped_us = max(0.0, coll_us + comp_us - both_us)
         exposed_us = coll_us - overlapped_us
+
+        # Per-kind exposed time: the kind's busy minus its overlap with
+        # COMPUTE (not with other collectives — two collectives hiding
+        # behind each other are both still exposed).  Regressions become
+        # attributable to the op family that regressed, not just visible
+        # in the aggregate.
+        def _exposed(kind_ivs):
+            k_us = _merged_busy_us(kind_ivs)
+            hidden = max(0.0, k_us + comp_us
+                         - _merged_busy_us(kind_ivs + comp))
+            return k_us - hidden
+
+        exposed_by_kind = {
+            k: round(_exposed(ivs) / steps / 1e3, 3)
+            for k, ivs in sorted(by_kind.items())}
         return {
             "steps": steps,
             "compute_ms_per_step": round(comp_us / steps / 1e3, 3),
             "collective_ms_per_step": round(coll_us / steps / 1e3, 3),
             "exposed_collective_ms_per_step":
                 round(exposed_us / steps / 1e3, 3),
+            "exposed_ms_by_kind_per_step": exposed_by_kind,
             "overlap_frac":
                 round(overlapped_us / coll_us, 4) if coll_us else None,
         }
@@ -219,12 +256,13 @@ def main() -> None:
     if on_tpu:
         import dataclasses
         # flash (Pallas, block=512 via pick_block_size) beats XLA dense by
-        # ~35% at this config on v5e.  remat_policy="attn_qkv" pins the
-        # flash out/lse residuals + the qkv projection across the remat
-        # boundary — the backward re-runs neither the attention kernel nor
-        # the qkv matmul (r3/r4 device-trace work; full decomposition in
-        # benchmarks/results/step_breakdown_r04.md).
-        cfg = dataclasses.replace(gpt2.gpt2_small(), attn_impl="flash",
+        # ~35% at this config on v5e — and is now the model DEFAULT on
+        # TPU (attn_impl="auto"), not a bench-only override.
+        # remat_policy="attn_qkv" pins the flash out/lse residuals + the
+        # qkv projection across the remat boundary — the backward re-runs
+        # neither the attention kernel nor the qkv matmul (r3/r4
+        # device-trace work; benchmarks/results/step_breakdown_r04.md).
+        cfg = dataclasses.replace(gpt2.gpt2_small(),
                                   remat_policy="attn_qkv")
         batch, seq, steps = 32, 1024, 20
     else:  # CI smoke: tiny model so the bench contract stays testable
@@ -326,8 +364,7 @@ def _run_xl(jax, np, gpt2, mesh_lib, spmd, MeshConfig, dev,
             peak: float) -> dict:
     import dataclasses
     import jax.numpy as jnp
-    cfg = dataclasses.replace(gpt2.gpt2_xl(), attn_impl="flash",
-                              remat_policy="attn",
+    cfg = dataclasses.replace(gpt2.gpt2_xl(), remat_policy="attn",
                               param_dtype=jnp.bfloat16)
     batch, seq, steps = 8, 1024, 8
     mc = MeshConfig(data=1).resolved(1)

@@ -20,6 +20,7 @@ Design notes (TPU-first, not a torch translation):
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from dataclasses import dataclass, field
 from functools import partial
@@ -51,7 +52,17 @@ class GPT2Config:
     # measured v5e b32/s1024: the biggest recompute in the step; requires
     # attn_impl="flash".  Ignored when remat=False.
     remat_policy: str = "full"  # full | dots | attn
-    attn_impl: str = "dense"   # dense | flash | blockwise | ring | ulysses
+    # "auto" (default) resolves per backend: the Pallas flash kernel on
+    # TPU — the overlap-scheduled train step's default, no longer a
+    # bench-only config — and XLA dense elsewhere (interpret-mode Pallas
+    # on CPU is a debugging tool, not a default).
+    attn_impl: str = "auto"    # auto | dense | flash | blockwise | ring | ulysses
+    # Decomposed collective matmuls (ops/collective_matmul.py): "auto"
+    # routes the qkv/attn-out/MLP projections through chunked
+    # ppermute-ring all-gather-matmul / matmul-reduce-scatter whenever
+    # the ambient mesh has a model axis (seq or tensor > 1) and the
+    # shapes divide; "off" keeps GSPMD's serialized collective legs.
+    collective_matmul: str = "auto"  # auto | off
     # >0: compute the LM-head matmul + cross entropy in this many sequence
     # chunks under jax.checkpoint, so the (B, T, vocab) f32 logits never
     # materialize (peak activation drops by ~B*T*V*4/chunks bytes; the
@@ -175,7 +186,24 @@ def dense_causal_attention(q, k, v, cfg: GPT2Config) -> jax.Array:
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def resolved_attn_impl(cfg: GPT2Config) -> str:
+    """Concrete attention impl for ``attn_impl='auto'``: the Pallas flash
+    kernel on TPU, XLA dense elsewhere."""
+    if cfg.attn_impl == "auto":
+        return "flash" if jax.default_backend() == "tpu" else "dense"
+    return cfg.attn_impl
+
+
+def _flash_tiles(seq_len: int) -> bool:
+    """Whether the flash kernel's best block tiles ``seq_len`` — the
+    same gate ``flash_attention_for_model`` uses for its dense
+    fallback (an odd serving bucket must not crash the trace)."""
+    from ray_tpu.ops.flash_attention import pick_block_size
+    return seq_len % pick_block_size(seq_len) == 0
+
+
 def _resolve_attn(cfg: GPT2Config) -> AttnImpl:
+    cfg = dataclasses.replace(cfg, attn_impl=resolved_attn_impl(cfg))
     if cfg.attn_impl == "dense":
         return dense_causal_attention
     if cfg.attn_impl == "flash":
@@ -226,6 +254,131 @@ def _block(x: jax.Array, lp: Params, cfg: GPT2Config,
     return out
 
 
+# ---------------------------------------------------- overlap-scheduled path
+def _manual_parallel_axes(cfg: GPT2Config, mesh, seq_len: int):
+    """(sp, tp) when the decomposed/manual region should run, else None.
+
+    The manual region is the overlap-scheduled block: residual stream
+    sequence-sharded over (seq × tensor) between attention and MLP
+    (Korthikanti et al. 2022 — norms/residual adds never replicate
+    work), with the boundary all-gather / reduce-scatter legs folded
+    into the projection matmuls as ppermute rings
+    (ops/collective_matmul.py) so they hide behind compute.
+
+    A mesh with ``seq > 1`` REQUIRES this path (the axis has no GSPMD
+    fallback semantics) — incompatible shapes raise.  ``tensor``-only
+    meshes fall back to GSPMD's serialized collectives when the shapes
+    don't divide (heads not divisible by tp), preserving the old
+    behavior for exotic head counts.
+    """
+    if cfg.collective_matmul == "off" or mesh is None:
+        return None
+    from ray_tpu.ops.collective_matmul import model_parallel_sizes
+    shape = dict(mesh.shape)
+    sp, tp = model_parallel_sizes(mesh)
+    if sp * tp == 1:
+        return None
+    from ray_tpu._private.jax_compat import shard_map_available
+    impl = resolved_attn_impl(cfg)
+    ok = (shard_map_available()
+          and shape.get("context", 1) == 1
+          and shape.get("pipeline", 1) == 1
+          and impl not in ("ring", "ulysses")
+          and cfg.n_head % tp == 0
+          and cfg.n_embd % tp == 0 and (4 * cfg.n_embd) % tp == 0
+          and seq_len % (sp * tp) == 0)
+    if not ok:
+        if sp > 1:
+            raise ValueError(
+                f"mesh has seq={sp} but the sequence-parallel region "
+                f"cannot run: needs shard_map, context=pipeline=1, a "
+                f"non-ring/ulysses attn_impl (have {impl!r}), heads/"
+                f"embed divisible by tensor={tp}, and seq_len "
+                f"({seq_len}) divisible by seq*tensor ({sp * tp})")
+        return None
+    return sp, tp
+
+
+def _block_manual(x: jax.Array, lp: Params, *, cfg: GPT2Config,
+                  attn_name: str, sp: int, tp: int) -> jax.Array:
+    """Per-shard transformer block (inside shard_map over the mesh).
+
+    ``x``: (B_local, T_local, E) with T_local = T / (sp·tp) — the
+    sequence-parallel residual stream.  Layer norms and residual adds
+    run on local tokens only; the four projections are decomposed
+    collective matmuls over the ``tensor`` ring (all-gather-matmul in,
+    matmul-reduce-scatter out) so their collective legs overlap their
+    own partial products; attention runs on the T/sp sequence chunk —
+    the Pallas flash kernel (or dense) at full T when sp == 1, the
+    ppermute KV ring over the ``seq`` axis when sp > 1 (ring attention
+    IS flash attention's online-softmax update walked around the ring,
+    so the seq axis composes with the flash block layout instead of
+    fighting it)."""
+    from ray_tpu.ops import collective_matmul as cm
+    B, Tl, E = x.shape
+    H, D = cfg.n_head, cfg.head_dim
+    Hl = H // tp
+
+    h = _layer_norm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"])
+    wqkv = lp["attn_qkv"]["kernel"].astype(cfg.dtype).reshape(E, 3 * Hl * D)
+    qkv = cm.all_gather_matmul(h, wqkv, "tensor", tp)     # (B, T/sp, 3E/tp)
+    qkv = qkv + lp["attn_qkv"]["bias"].astype(cfg.dtype).reshape(-1)
+    Ts = Tl * tp                                          # = T / sp
+    qkv = qkv.reshape(B, Ts, 3, Hl, D)
+    from jax.ad_checkpoint import checkpoint_name
+    qkv = checkpoint_name(qkv, "attn_qkv")
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if sp > 1:
+        from ray_tpu.ops.ring_attention import ring_attention
+        a = ring_attention(q, k, v, axis_name="seq", axis_size=sp,
+                           causal=True)
+    elif attn_name == "flash" and _flash_tiles(Ts):
+        from ray_tpu.ops.flash_attention import flash_attention
+        a = flash_attention(q, k, v, True)
+    elif attn_name == "blockwise":
+        from ray_tpu.ops.attention import blockwise_attention
+        a = blockwise_attention(q, k, v, causal=True)
+    else:
+        from ray_tpu.ops.attention import dense_attention
+        a = dense_attention(q, k, v, causal=True)
+    wout = lp["attn_out"]["kernel"].astype(cfg.dtype).reshape(Hl * D, E)
+    aout = cm.matmul_reduce_scatter(a.reshape(B, Ts, Hl * D), wout,
+                                    "tensor", tp)         # (B, Tl, E)
+    # biases ride AFTER the reduce-scatter: inside it they would be
+    # summed tp times
+    x = x + aout + lp["attn_out"]["bias"].astype(cfg.dtype)
+
+    h = _layer_norm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"])
+    m = cm.all_gather_matmul(
+        h, lp["mlp_in"]["kernel"].astype(cfg.dtype), "tensor", tp)
+    m = jax.nn.gelu(m + lp["mlp_in"]["bias"].astype(cfg.dtype),
+                    approximate=True)
+    mo = cm.matmul_reduce_scatter(
+        m, lp["mlp_out"]["kernel"].astype(cfg.dtype), "tensor", tp)
+    return x + mo + lp["mlp_out"]["bias"].astype(cfg.dtype)
+
+
+def _manual_block_specs(cfg: GPT2Config):
+    """shard_map in_specs for one layer's params in the manual region.
+
+    Only ``tensor`` appears: fsdp-sharded dims are declared replicated,
+    so GSPMD inserts the ZeRO-3 all-gather at the region boundary (and
+    its transpose reduce-scatters the grads) — weight resharding stays
+    GSPMD's job, activation collectives are ours."""
+    from jax.sharding import PartitionSpec as P
+    del cfg
+    ln = {"scale": P(None), "bias": P(None)}
+    return {
+        "ln_1": dict(ln),
+        "attn_qkv": {"kernel": P(None, None, "tensor"),
+                     "bias": P(None, "tensor")},
+        "attn_out": {"kernel": P("tensor", None), "bias": P(None)},
+        "ln_2": dict(ln),
+        "mlp_in": {"kernel": P(None, "tensor"), "bias": P("tensor")},
+        "mlp_out": {"kernel": P("tensor", None), "bias": P(None)},
+    }
+
+
 def forward_hidden(params: Params, tokens: jax.Array,
                    cfg: GPT2Config) -> jax.Array:
     """tokens (B, T) int32 → final-LN hidden states (B, T, E) in cfg.dtype."""
@@ -237,19 +390,48 @@ def forward_hidden(params: Params, tokens: jax.Array,
     # shard_map (where chunk offsets come from lax.axis_index).
     x = x + params["wpe"].astype(cfg.dtype)[jnp.arange(T)]
 
-    block = partial(_block, cfg=cfg, attn=attn)
+    from ray_tpu.parallel import mesh as mesh_lib
+    amb_mesh = mesh_lib.get_ambient_mesh()
+    manual = _manual_parallel_axes(cfg, amb_mesh, T)
+    if manual is not None:
+        # Overlap-scheduled region: shard_map over the whole mesh, the
+        # residual stream sequence-sharded over (seq × tensor), every
+        # projection a decomposed collective matmul.  x enters/leaves
+        # per-shard as (B_local, T/(sp·tp), E).
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ray_tpu._private.jax_compat import shard_map
+        sp, tp = manual
+        xspec = P(("data", "fsdp"), ("seq", "tensor"), None)
+        block = shard_map(
+            partial(_block_manual, cfg=cfg,
+                    attn_name=resolved_attn_impl(cfg), sp=sp, tp=tp),
+            mesh=amb_mesh, in_specs=(xspec, _manual_block_specs(cfg)),
+            out_specs=xspec, check_vma=False)
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(amb_mesh,
+                             mesh_lib.activation_spec("batch", "seq",
+                                                      "embed")))
+    else:
+        block = partial(_block, cfg=cfg, attn=attn)
     if cfg.remat:
         if cfg.remat_policy == "dots":
             block = jax.checkpoint(
                 block,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
         elif cfg.remat_policy in ("attn", "attn_qkv"):
-            if cfg.attn_impl != "flash":
-                # the saved names are tagged only inside the flash vjp;
-                # with any other impl this policy would silently behave
-                # as full remat
+            # the saved names are tagged only inside the flash vjp; with
+            # any other impl — or a shape where the flash hook falls
+            # back to dense, or the seq>1 KV ring — this policy would
+            # silently behave as full remat
+            sp = 1 if manual is None else manual[0]
+            flash_runs = (resolved_attn_impl(cfg) == "flash"
+                          and sp == 1 and _flash_tiles(T // sp))
+            if not flash_runs:
                 raise ValueError(
-                    "remat_policy='attn' requires attn_impl='flash'")
+                    "remat_policy='attn' requires attn_impl='flash' "
+                    "with a flash-tileable sequence length and no "
+                    "seq-axis KV ring (the policy's saved names exist "
+                    "only inside the flash kernel's vjp)")
             # "attn": save the flash out + compact lse residuals so the
             # backward never re-runs the attention kernel (cheap: ~52MB
             # per GPT-2-small layer at b32/s1024).  "attn_qkv" also pins

@@ -45,10 +45,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     o0 = jnp.zeros((B, H, T, D), jnp.float32)
     m0 = jnp.full((B, H, T), jnp.finfo(jnp.float32).min)
     l0 = jnp.zeros((B, H, T), jnp.float32)
-    perm = [(d, (d + 1) % axis_size) for d in range(axis_size)]
 
-    def body(carry, step):
-        o, m, l, kc, vc = carry
+    def body(step, carry, kv):
+        o, m, l = carry
+        kc, vc = kv
         # Step s processes chunk (me - s) mod n: step 0 is the diagonal
         # block, which always has a valid key for every row (causal q>=k
         # includes self) — the flash_update masking contract.
@@ -58,16 +58,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             mask = causal_mask(q_pos, k_pos)[None, None]
         else:
             mask = None
-        o, m, l = flash_update(o, m, l, q, kc, vc, mask, scale)
-        # Rotate so next step this device holds the previous chunk.  The
-        # last rotation is skipped only in exact arithmetic; keeping it
-        # uniform lets XLA software-pipeline transfer s+1 under compute s.
-        kc = lax.ppermute(kc, axis_name, perm)
-        vc = lax.ppermute(vc, axis_name, perm)
-        return (o, m, l, kc, vc), None
+        return flash_update(o, m, l, q, kc, vc, mask, scale)
 
-    (o, _, l, _, _), _ = lax.scan(
-        body, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    # ring_scan issues each rotation BEFORE the update consuming the
+    # resident chunk, so XLA pipelines transfer s+1 under compute s (the
+    # same double-buffer schedule ops/collective_matmul.py rides).
+    from ray_tpu.ops.collective_matmul import ring_scan
+    o, _, l = ring_scan(body, (o0, m0, l0), (k, v),
+                        axis_name=axis_name, axis_size=axis_size)
     return flash_finalize(o, l, q.dtype)
 
 

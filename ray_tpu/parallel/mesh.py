@@ -16,10 +16,21 @@ axis     shards                                       collective traffic
 data     batch (pure DP)                              grad allreduce
 fsdp     batch + parameter shards (ZeRO-3 style)      allgather/reducescatter
 pipeline transformer layer blocks (PP stages)         ppermute activations
-context  sequence dimension (SP/CP, ring attention)   ppermute KV blocks
+context  sequence dimension (CP, ring attention)      ppermute KV blocks
+seq      sequence dimension BETWEEN blocks (SP:       allgather/reducescatter
+         norms/residuals/dropout shard over tokens)   fused into matmul rings
 tensor   hidden/heads (Megatron TP)                   allreduce activations
 expert   MoE experts (EP)                             all-to-all tokens
 ======== ============================================ =====================
+
+``seq`` vs ``context``: ``context`` shards the sequence *through*
+attention (ring/Ulysses rotate KV so no device ever sees full T);
+``seq`` shards the sequence in the regions *between* attention and MLP
+(Korthikanti et al. 2022) — layer norms, residual adds and the
+optimizer-visible activations live on T/seq tokens per device, and the
+boundary all-gather/reduce-scatter legs are folded into the adjacent
+projection matmuls by ``ray_tpu.ops.collective_matmul`` so they hide
+behind partial-product compute instead of serializing the step.
 """
 
 from __future__ import annotations
@@ -33,7 +44,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "fsdp", "pipeline", "context", "tensor", "expert")
+AXES = ("data", "fsdp", "pipeline", "context", "seq", "tensor", "expert")
 
 
 @dataclass(frozen=True)
@@ -44,6 +55,7 @@ class MeshConfig:
     fsdp: int = 1
     pipeline: int = 1
     context: int = 1
+    seq: int = 1
     tensor: int = 1
     expert: int = 1
 
@@ -163,6 +175,47 @@ TRANSFORMER_RULES: Rules = [
 ]
 
 
+# Logical ACTIVATION axis → mesh axis (SNIPPETS.md [3] lineage: the
+# sharding-rules table whose ``"seq": None  # TODO`` this fills).  Params
+# are matched by the regex Rules above; intermediate activations are
+# placed by logical-axis name through :func:`activation_spec`.  A value
+# may be one mesh axis, a tuple of mesh axes (the dim shards over their
+# product), or None (replicated).
+ACTIVATION_RULES: Dict[str, Any] = {
+    "batch": ("data", "fsdp"),     # batch dim: DP (+ ZeRO-3 data shards)
+    "seq": ("seq", "tensor"),      # sequence-parallel region BETWEEN
+                                   # attention and MLP: tokens shard over
+                                   # the dedicated seq axis AND the tensor
+                                   # group (Megatron-SP composition) —
+                                   # norms/residuals never replicate work
+    "seq_attn": "context",         # sequence THROUGH attention (ring CP)
+    "heads": "tensor",             # attention heads (Megatron TP)
+    "embed": None,                 # residual-stream feature dim
+    "mlp": "tensor",               # MLP hidden dim
+    "kv": None,                    # per-head feature dim
+    "vocab": "tensor",             # logits vocab dim
+}
+
+
+def activation_spec(*logical: Optional[str]) -> P:
+    """PartitionSpec for an activation from logical axis names.
+
+    ``activation_spec("batch", "seq", "embed")`` is the canonical
+    residual-stream placement between transformer blocks.  ``None``
+    entries pass through as replicated dims.
+    """
+    parts = []
+    for name in logical:
+        if name is None:
+            parts.append(None)
+            continue
+        if name not in ACTIVATION_RULES:
+            raise KeyError(f"unknown logical activation axis {name!r} "
+                           f"(have {sorted(ACTIVATION_RULES)})")
+        parts.append(ACTIVATION_RULES[name])
+    return P(*parts)
+
+
 def spec_for_path(path: str, rules: Rules) -> P:
     for pat, spec in rules:
         if re.fullmatch(pat, path):
@@ -221,7 +274,10 @@ def shard_params(mesh: Mesh, params: Any,
 
 def batch_spec(config: MeshConfig, rank: int = 2) -> P:
     """Sharding for a (batch, seq, ...) array: batch over data(+fsdp),
-    sequence over context."""
+    sequence over context.  The ``seq`` axis deliberately does NOT shard
+    the input tokens: (B, T+1) token blocks are rarely divisible by it,
+    and the sequence-parallel scatter happens at the manual-region
+    boundary inside the step (models/gpt2.py) where T is."""
     axes: List[Any] = [config.batch_axes()]
     if rank >= 2:
         axes.append("context" if config.context != 1 else None)

@@ -105,6 +105,7 @@ def build_train_program(
         rules: Rules = TRANSFORMER_RULES,
         batch_rank: int = 2,
         donate_state: bool = True,
+        donate_batch: bool = False,
         accum_steps: int = 1,
         accum_dtype: Any = None) -> SpmdProgram:
     """Assemble the one-jit distributed train step.
@@ -208,11 +209,22 @@ def build_train_program(
         return new, {"loss": loss, "grad_norm": gnorm,
                      "step": new.step.astype(jnp.float32)}
 
+    # Donation: the WHOLE TrainState — params AND both Adam moments —
+    # aliases its output buffers (in/out shardings match leaf-for-leaf,
+    # so XLA reuses every buffer in place; the optimizer phase is
+    # HBM-bandwidth-floored and an un-donated moment tree would double
+    # its traffic AND its footprint).  ``donate_batch`` additionally
+    # donates the input batch for callers that feed a fresh batch every
+    # step (streaming ingest, train_bench) — never for callers that
+    # re-feed one batch (bench.py's steady-state loop).
+    donate: Tuple[int, ...] = (0,) if donate_state else ()
+    if donate_batch:
+        donate = donate + (1,)
     step_fn = jax.jit(
         _step,
         in_shardings=(state_sh, batch_sh),
         out_shardings=(state_sh, NamedSharding(mesh, P())),
-        donate_argnums=(0,) if donate_state else ())
+        donate_argnums=donate)
 
     return SpmdProgram(mesh=mesh, mesh_config=mesh_config, init_fn=init_fn,
                        step_fn=step_fn, state_shardings=state_sh,

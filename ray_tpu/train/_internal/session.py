@@ -102,9 +102,11 @@ class _TrainSession:
         self._last_report_wall = time.time()
         self._reported_once = False
 
-    def _observe_step(self) -> None:
+    def _observe_step(self, metrics: Optional[Dict[str, Any]] = None) -> None:
         """Per-worker step telemetry: ``rtpu_train_step_seconds`` +
-        instantaneous throughput gauge, plus a ``train.step`` span on the
+        instantaneous throughput gauge (plus ``rtpu_train_mfu`` /
+        ``rtpu_train_overlap_exposed_ms`` when the loop reports them),
+        plus a ``train.step`` span on the
         cluster timeline so a slow step shows WHERE it went next to the
         device trace rows (tracing.profile_device).
 
@@ -129,6 +131,18 @@ class _TrainSession:
             if step_s > 0:
                 mcat.get("rtpu_train_throughput_steps_per_s").set(
                     1.0 / step_s, tags={"rank": rank})
+            # Overlap-scheduled-step telemetry: training loops that
+            # measure MFU / exposed-collective time (bench.py-style
+            # accounting) report them as plain metric keys and the
+            # session republishes them as fleet-visible gauges.
+            metrics = metrics or {}
+            if isinstance(metrics.get("mfu"), (int, float)):
+                mcat.get("rtpu_train_mfu").set(
+                    float(metrics["mfu"]), tags={"rank": rank})
+            if isinstance(metrics.get("overlap_exposed_ms"), (int, float)):
+                mcat.get("rtpu_train_overlap_exposed_ms").set(
+                    float(metrics["overlap_exposed_ms"]),
+                    tags={"rank": rank})
         span = tracing.current_span()
         name = ("train.setup_to_first_report" if first
                 else f"train.step[{self.iteration}]")
@@ -147,7 +161,7 @@ class _TrainSession:
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         self.iteration += 1
-        self._observe_step()
+        self._observe_step(metrics)
         ckpt_path = None
         if checkpoint is not None:
             # attempt in the name: a restarted attempt must never collide

@@ -317,6 +317,21 @@ CATALOG: Dict[str, dict] = {
         description="Instantaneous training throughput (1 / last step "
                     "duration) per worker rank",
         emitted_by="train worker"),
+    "rtpu_train_mfu": dict(
+        kind="gauge", tag_keys=("rank",),
+        description="Model-FLOPs utilization reported by the training "
+                    "loop (train.report key 'mfu'): model FLOP/s over "
+                    "the chip's peak — the overlap-scheduled step's "
+                    "headline number, fleet-visible via ray_tpu top",
+        emitted_by="train worker"),
+    "rtpu_train_overlap_exposed_ms": dict(
+        kind="gauge", tag_keys=("rank",),
+        description="Exposed (compute-unhidden) collective ms per train "
+                    "step reported by the training loop (train.report "
+                    "key 'overlap_exposed_ms', from bench-style device-"
+                    "trace accounting) — the number the decomposed "
+                    "collective matmuls drive toward zero",
+        emitted_by="train worker"),
     # --- synthesized at collect time (documented here; no instantiation) ----
     "rtpu_device_hbm_bytes_in_use": dict(
         kind="gauge", tag_keys=("device", "kind"),

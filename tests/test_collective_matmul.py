@@ -1,0 +1,228 @@
+"""Decomposed collective-matmul numerics (ops/collective_matmul.py).
+
+The overlap-scheduled train step's correctness rests on two claims:
+
+1. the chunked ppermute-ring primitives (all-gather-matmul /
+   matmul-reduce-scatter) match the plain psum/all-gather einsum they
+   decompose, forward AND grad (custom-VJP path), on 1-, 2- and 4-way
+   rings;
+2. the overlapped train step reproduces the un-overlapped step's loss
+   trajectory from a fixed seed (same mesh, ``collective_matmul``
+   "auto" vs "off" — same-mesh A/B because param init on this jax
+   build is sharding-dependent: ``jax_threefry_partitionable=False``).
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu._private.jax_compat import shard_map, shard_map_available
+from ray_tpu.ops import collective_matmul as cm
+
+pytestmark = pytest.mark.skipif(not shard_map_available(),
+                                reason="no shard_map in this jax build")
+
+
+def _ring_mesh(n: int) -> Mesh:
+    return Mesh(np.array(jax.devices()[:n]).reshape(n), ("tensor",))
+
+
+def _sharded(mesh, fn, in_specs, out_specs):
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_all_gather_matmul_matches_reference(n):
+    """Fwd + custom-VJP grads == one-all-gather-then-matmul, fp32 tol."""
+    mesh = _ring_mesh(n)
+    B, T, K, N = 2, 8 * n, 16, 24
+    x = jax.random.normal(jax.random.key(0), (B, T, K))
+    w = jax.random.normal(jax.random.key(1), (K, N)) / np.sqrt(K)
+    in_specs = (P(None, "tensor", None), P(None, "tensor"))
+    out_specs = P(None, None, "tensor")
+
+    def decomposed(xl, wl):
+        return cm.all_gather_matmul(xl, wl, "tensor", n)
+
+    def reference(xl, wl):
+        return cm.all_gather_matmul_reference(xl, wl, "tensor", n)
+
+    ys = {}
+    grads = {}
+    for name, fn in (("ring", decomposed), ("psum", reference)):
+        f = _sharded(mesh, fn, in_specs, out_specs)
+        ys[name] = f(x, w)
+
+        def loss(x, w, f=f):
+            return jnp.sum(jnp.sin(f(x, w)))
+
+        grads[name] = jax.grad(loss, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(ys["ring"]),
+                               np.asarray(ys["psum"]), atol=1e-5)
+    for a, b in zip(grads["ring"], grads["psum"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_matmul_reduce_scatter_matches_reference(n):
+    """Fwd + custom-VJP grads == matmul-then-psum_scatter, fp32 tol."""
+    mesh = _ring_mesh(n)
+    B, T, K, N = 2, 8 * n, 16 * n, 24
+    x = jax.random.normal(jax.random.key(2), (B, T, K))
+    w = jax.random.normal(jax.random.key(3), (K, N)) / np.sqrt(K)
+    in_specs = (P(None, None, "tensor"), P("tensor", None))
+    out_specs = P(None, "tensor", None)
+
+    def decomposed(xl, wl):
+        return cm.matmul_reduce_scatter(xl, wl, "tensor", n)
+
+    def reference(xl, wl):
+        return cm.matmul_reduce_scatter_reference(xl, wl, "tensor", n)
+
+    ys = {}
+    grads = {}
+    for name, fn in (("ring", decomposed), ("psum", reference)):
+        f = _sharded(mesh, fn, in_specs, out_specs)
+        ys[name] = f(x, w)
+
+        def loss(x, w, f=f):
+            return jnp.sum(jnp.sin(f(x, w)))
+
+        grads[name] = jax.grad(loss, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(ys["ring"]),
+                               np.asarray(ys["psum"]), atol=1e-5)
+    for a, b in zip(grads["ring"], grads["psum"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+
+def test_primitives_against_dense_math():
+    """The sharded results equal the UNsharded x @ w — not just each
+    other (a shared layout bug would fool the pairwise test)."""
+    n = 4
+    mesh = _ring_mesh(n)
+    B, T, K, N = 2, 8, 12, 8
+    x = jax.random.normal(jax.random.key(4), (B, T * n, K))
+    w = jax.random.normal(jax.random.key(5), (K, N))
+    ref = x @ w
+
+    ag = _sharded(mesh,
+                  lambda xl, wl: cm.all_gather_matmul(xl, wl, "tensor", n),
+                  (P(None, "tensor", None), P(None, "tensor")),
+                  P(None, None, "tensor"))(x, w)
+    np.testing.assert_allclose(np.asarray(ag), np.asarray(ref), atol=1e-5)
+
+    x2 = jax.random.normal(jax.random.key(6), (B, T * n, K * n))
+    w2 = jax.random.normal(jax.random.key(7), (K * n, N)) / np.sqrt(K * n)
+    rs = _sharded(mesh,
+                  lambda xl, wl: cm.matmul_reduce_scatter(
+                      xl, wl, "tensor", n),
+                  (P(None, None, "tensor"), P("tensor", None)),
+                  P(None, "tensor", None))(x2, w2)
+    np.testing.assert_allclose(np.asarray(rs), np.asarray(x2 @ w2),
+                               atol=1e-5)
+
+
+def test_ring_scan_rotation_order():
+    """ring_scan presents block (me - s) % n at step s — the contract
+    ring attention and both matmul rings are built on."""
+    n = 4
+    mesh = _ring_mesh(n)
+
+    def collect(x):
+        me = jax.lax.axis_index("tensor")
+
+        def body(step, seen, blk):
+            return seen.at[step].set(blk[0] - (me - step) % n)
+
+        out = cm.ring_scan(body, jnp.zeros((n,), jnp.int32), x,
+                           axis_name="tensor", axis_size=n)
+        return out[None]
+
+    x = jnp.arange(n, dtype=jnp.int32)  # block i holds value i
+    got = _sharded(mesh, collect, (P("tensor"),), P("tensor", None))(x)
+    assert np.all(np.asarray(got) == 0)
+
+
+def test_overlapped_step_loss_continuity():
+    """10-step trajectory of the overlapped (decomposed + seq-parallel)
+    train step == the un-overlapped GSPMD step, same mesh, fixed seed."""
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import spmd
+    from ray_tpu.parallel.mesh import MeshConfig
+
+    toks = np.random.default_rng(0).integers(
+        0, 256, (8, 33)).astype(np.int32)
+    traj = {}
+    for mode in ("auto", "off"):
+        cfg = dataclasses.replace(gpt2.tiny(), dtype=jnp.float32,
+                                  collective_matmul=mode)
+        prog = spmd.build_train_program(
+            loss_fn=lambda p, b: gpt2.loss_fn(p, b, cfg),
+            init_params_fn=partial(gpt2.init_params, cfg=cfg),
+            optimizer=spmd.default_optimizer(lr=1e-2, warmup=1,
+                                             total_steps=50),
+            mesh_config=MeshConfig(data=2, seq=2, tensor=2))
+        state = prog.init_fn(jax.random.key(0))
+        batch = spmd.shard_batch(prog, {"tokens": toks})
+        losses = []
+        for _ in range(10):
+            state, m = prog.step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        traj[mode] = losses
+    np.testing.assert_allclose(traj["auto"], traj["off"], rtol=1e-4)
+    assert traj["auto"][-1] < traj["auto"][0]  # and it actually trains
+
+
+def test_seq_axis_requires_compatible_shapes():
+    """A mesh with seq > 1 must not silently fall back to a non-seq
+    program — incompatible shapes raise at trace time."""
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import mesh as mesh_lib
+    from ray_tpu.parallel.mesh import MeshConfig
+
+    cfg = gpt2.tiny()
+    mesh = mesh_lib.build_mesh(MeshConfig(data=2, seq=4).resolved(8))
+    params = jax.eval_shape(partial(gpt2.init_params, cfg=cfg),
+                            jax.random.key(0))
+    toks = jnp.zeros((8, 30), jnp.int32)  # 30 % 4 != 0
+    with mesh_lib.ambient_mesh(mesh):
+        with pytest.raises(ValueError, match="seq"):
+            jax.eval_shape(partial(gpt2.forward_hidden, cfg=cfg),
+                           params, toks)
+
+
+def test_donate_batch_program_trains():
+    """donate_batch=True: fresh batch every step (the streaming-ingest
+    shape), state and batch both donated, loss finite and decreasing."""
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import spmd
+    from ray_tpu.parallel.mesh import MeshConfig
+
+    cfg = gpt2.tiny()
+    prog = spmd.build_train_program(
+        loss_fn=lambda p, b: gpt2.loss_fn(p, b, cfg),
+        init_params_fn=partial(gpt2.init_params, cfg=cfg),
+        optimizer=spmd.default_optimizer(lr=1e-2, warmup=1, total_steps=50),
+        mesh_config=MeshConfig(data=4, seq=2), donate_batch=True)
+    state = prog.init_fn(jax.random.key(1))
+    rng = np.random.default_rng(1)
+    first = None
+    for _ in range(6):
+        toks = rng.integers(0, cfg.vocab_size, (8, 33)).astype(np.int32)
+        state, m = prog.step_fn(state,
+                                spmd.shard_batch(prog, {"tokens": toks}))
+        loss = float(m["loss"])
+        assert np.isfinite(loss)
+        first = first if first is not None else loss
+    # fresh i.i.d. batch each step: per-batch noise swamps 6 steps of
+    # descent — assert sanity (not diverging), not monotonicity
+    assert loss < first + 0.5
+    assert int(jax.device_get(state.step)) == 6

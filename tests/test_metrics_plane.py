@@ -568,3 +568,28 @@ def test_train_step_metrics(metrics_cluster, tmp_path):
     assert "rtpu_train_throughput_steps_per_s" in snap
     thr = snap["rtpu_train_throughput_steps_per_s"]["series"][0]["value"]
     assert thr > 0
+
+
+def test_train_overlap_gauges_from_report(metrics_cluster, tmp_path):
+    """Loops that report mfu / overlap_exposed_ms get them republished
+    as rank-tagged gauges (the PR-12 overlap-scheduled-step telemetry);
+    steps that omit them leave the gauges at their last value."""
+    from ray_tpu.train._internal import session as sess
+
+    metrics_lib._reset_for_tests()
+    sess.init_session(run_id="orun", run_name="orun", rank=3, world_size=4,
+                      storage_dir=str(tmp_path), restore_checkpoint=None)
+    try:
+        sess.get_session().report({"loss": 1.0})   # setup interval
+        sess.get_session().report({"loss": 0.5, "mfu": 0.61,
+                                   "overlap_exposed_ms": 4.2})
+        sess.get_session().report({"loss": 0.4})   # no overlap keys: no-op
+    finally:
+        sess.shutdown_session()
+    snap = metrics_lib.registry_snapshot()
+    for name, want in (("rtpu_train_mfu", 0.61),
+                       ("rtpu_train_overlap_exposed_ms", 4.2)):
+        assert name in snap, name
+        s = snap[name]["series"][0]
+        assert s["tags"]["rank"] == "3"
+        assert abs(s["value"] - want) < 1e-9
