@@ -107,12 +107,20 @@ releasebench-quick:
 # 100-simulated-node fleet against the real autoscaler bin-packing
 # loop; asserts determinism from the seed, zero stranded demand, zero
 # double-placements, and elastic re-mesh >= 2x the restart-from-
-# checkpoint goodput.  The committed full-scale artifact is
-# benchmarks/results/fleet_bench_r11.json.
+# checkpoint goodput.  The second run is the closed-loop autopilot A/B
+# (DESIGN.md §4n): the same weather plus degradation episodes, the
+# real reflex engine actuating — asserts the autopilot beats the
+# reactive ratio, drains stay inside the rate budget (zero actuation
+# storms), and the forecast reflex reduces demand lag.  Committed
+# full-scale artifacts: benchmarks/results/fleet_bench_r11.json
+# (reactive), fleet_bench_r15.json (closed loop).
 fleetbench-quick:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --quick \
 		--assert-sane --json benchmarks/results/fleetbench_ci.json \
 		--label ci
+	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --quick \
+		--closed-loop --assert-sane \
+		--json benchmarks/results/fleetbench_ci.json --label ci-closed
 
 # Observability-history smoke (CI): serial task RTs with the head TSDB
 # ingesting every snapshot + detectors ticking + live metrics_query

@@ -256,7 +256,7 @@ _FENCED_OK_KINDS = frozenset({
     "peek_meta", "pg_table", "list_nodes", "list_actors", "list_tasks",
     "list_objects", "list_workers", "cluster_resources", "store_stats",
     "metrics_query", "fleet_state", "fleet_events", "raylet_table",
-    "resource_demand"})
+    "resource_demand", "autopilot_status"})
 
 
 class GcsServer:
@@ -405,6 +405,21 @@ class GcsServer:
                     window_s=GLOBAL_CONFIG.tsdb_straggler_window_s,
                     ratio=GLOBAL_CONFIG.tsdb_straggler_ratio),
                 SloBurnAlerter(self._tsdb, SLO_RULES)]
+        # Fleet autopilot (DESIGN.md §4n): the reflex arc turning the
+        # detectors' fleet events + TSDB history into bounded
+        # remediation actions.  Ticked from the monitor loop; reads the
+        # fleet-event ring through its own cursor; actuates through the
+        # internal drain/undrain paths and whatever autoscaler attaches
+        # itself via AutoscalerLoop.  Off by default (autopilot_enabled).
+        self._autopilot = None
+        self._autopilot_cursor = 0
+        self._last_autopilot = 0.0
+        if GLOBAL_CONFIG.autopilot_enabled:
+            from ray_tpu.elastic.autopilot import (Autopilot,
+                                                   AutopilotConfig,
+                                                   GcsActuator)
+            self._autopilot = Autopilot(
+                AutopilotConfig.from_global_config(), GcsActuator(self))
         # reply cache for client-supplied request ids: makes the worker's
         # one post-reconnect retry exactly-once against a still-live GCS
         # (non-idempotent mutations must not double-apply when only the
@@ -1922,6 +1937,18 @@ class GcsServer:
                     self._run_detectors()
                 except Exception:  # noqa: BLE001 - telemetry best-effort
                     logger.exception("anomaly detectors failed")
+            # fleet autopilot reflex pass (§4n): feed the fleet events
+            # since the last pass through the reflex engine, then tick
+            # its periodic work (undrain, forecast, standby).  No GCS
+            # lock is held here; the actuator takes what it documents.
+            if self._autopilot is not None and \
+                    now - self._last_autopilot > \
+                    GLOBAL_CONFIG.autopilot_interval_s:
+                self._last_autopilot = now
+                try:
+                    self._tick_autopilot()
+                except Exception:  # noqa: BLE001 - reflexes must not
+                    logger.exception("autopilot tick failed")  # kill GCS
             # purge chunked uploads abandoned by a dead uploader
             with self.lock:
                 now = time.time()
@@ -4043,29 +4070,77 @@ class GcsServer:
         — the Kubernetes provider only knows pod names)."""
         deadline_s = float(msg.get("deadline_s") or 0.0)
         sel = msg.get("label") or {}
+        node_id = msg.get("node_id") or ""
+        if sel:
+            with self.cv:
+                # label fallback also covers a stale/unknown node_id —
+                # the Kubernetes provider only reliably knows pod names
+                if node_id not in self.nodes:
+                    for n in self.nodes.values():
+                        if all(n.labels.get(k) == v
+                               for k, v in sel.items()):
+                            node_id = n.node_id
+                            break
+        ok = self.drain_node_internal(
+            node_id, deadline_s=deadline_s,
+            reason=str(msg.get("reason") or "preemption"))
+        return {"ok": ok, "node_id": node_id if ok else None}
+
+    def drain_node_internal(self, node_id: str, deadline_s: float = 0.0,
+                            reason: str = "preemption",
+                            only_if_running: bool = False) -> bool:
+        """Mark one node draining (placement avoids it; work already
+        there keeps running) and publish the ``node_draining`` fleet
+        event.  Shared by the RPC handler above and the autopilot's
+        straggler reflex (§4n) — remediation drains ride the exact path
+        provider warnings do, so every subscriber reacts the same way.
+        ``only_if_running`` (the autopilot) refuses a node that is
+        already draining: claiming a provider-drained node would let a
+        later autopilot undrain cancel the provider's preemption
+        warning — the autopilot only owns drains it issued."""
         with self.cv:
-            node = self.nodes.get(msg.get("node_id") or "")
-            if node is None and sel:
-                for n in self.nodes.values():
-                    if all(n.labels.get(k) == v for k, v in sel.items()):
-                        node = n
-                        break
+            node = self.nodes.get(node_id or "")
             if node is None or not node.alive:
-                return {"ok": False, "node_id": None}
+                return False
             already = node.phase == "draining"
+            if only_if_running and node.phase != "running":
+                return False
             node.phase = "draining"
-            node.drain_reason = str(msg.get("reason") or "preemption")
+            node.drain_reason = reason
             if deadline_s > 0:
                 node.drain_deadline = time.monotonic() + deadline_s
             self.cv.notify_all()
         if not already:
             self._fleet_event("node_draining", node.node_id,
-                              reason=node.drain_reason,
-                              deadline_s=deadline_s)
+                              reason=reason, deadline_s=deadline_s)
             if GLOBAL_CONFIG.metrics_enabled:
                 mcat.get("rtpu_elastic_node_draining_total").inc(
-                    tags={"reason": node.drain_reason})
-        return {"ok": True, "node_id": node.node_id}
+                    tags={"reason": reason})
+        return True
+
+    def undrain_node_internal(self, node_id: str,
+                              only_reason: Optional[str] = None) -> bool:
+        """Return a drained node to the schedulable pool (the autopilot's
+        recovery path: the straggler signal cleared, the host is healthy
+        again).  Publishes ``node_undrained`` and re-pumps so backlogged
+        work can land on the restored capacity.  ``only_reason`` (the
+        autopilot passes "straggler") refuses when the CURRENT drain
+        reason differs — a provider preemption warning that superseded
+        the remediation drain must not be cancelled by the autopilot's
+        recovery timer."""
+        with self.cv:
+            node = self.nodes.get(node_id or "")
+            if node is None or not node.alive or node.phase != "draining":
+                return False
+            if only_reason is not None and node.drain_reason != only_reason:
+                return False
+            node.phase = "running"
+            node.drain_reason = ""
+            node.drain_deadline = None
+            self.cv.notify_all()
+        self._fleet_event("node_undrained", node_id)
+        self._pump()
+        return True
 
     def _h_metrics_query(self, msg: dict) -> dict:
         """Query the head TSDB (DESIGN.md §4k): ``op`` selects instant
@@ -4083,6 +4158,12 @@ class GcsServer:
             return {"results": self._tsdb.query_range(
                 msg["expr"], start=msg.get("start"), end=msg.get("end"),
                 step=msg.get("step"))}
+        if op == "forecast":
+            return {"results": self._tsdb.forecast(
+                msg["expr"], float(msg.get("horizon_s") or 0.0),
+                period_s=float(msg.get("period_s") or 86400.0),
+                smooth_s=float(msg.get("smooth_s") or 600.0),
+                now=msg.get("at"))}
         return {"results": self._tsdb.query(msg["expr"],
                                             at=msg.get("at"))}
 
@@ -4113,6 +4194,31 @@ class GcsServer:
                 mcat.get("rtpu_anomaly_events_total").inc(
                     tags={"kind": kind})
             logger.warning("anomaly detected: %s %s", kind, ev)
+
+    def _tick_autopilot(self) -> None:
+        """One autopilot reflex pass (monitor loop, §4n): hand the
+        reflex engine every fleet event it has not seen (cursor over
+        the same ring ``fleet_events`` serves, read head-side without
+        an RPC), then tick."""
+        with self._events_lock:
+            events = [dict(e) for e in self._fleet_events
+                      if e["seq"] > self._autopilot_cursor]
+            self._autopilot_cursor = self._fleet_event_seq
+        for ev in events:
+            self._autopilot.observe(ev)
+        self._autopilot.tick()
+
+    def _h_autopilot_status(self, msg: dict) -> dict:
+        """The autopilot's bounded action history + reflex counters
+        (§4n) — what `ray_tpu status` and the chaos tests read to
+        assert the loop acted (and, just as important, that it did NOT
+        act more than its rate limits allow)."""
+        if self._autopilot is None:
+            return {"enabled": False, "actions": [], "stats": {}}
+        return {"enabled": True,
+                "actions": self._autopilot.actions(
+                    int(msg.get("limit") or 50)),
+                "stats": self._autopilot.stats()}
 
     def _h_fleet_events(self, msg: dict) -> dict:
         """Cursor read of the fleet lifecycle feed: events with
@@ -4530,6 +4636,13 @@ class GcsServer:
         if _INPROC_SERVER is self:
             _INPROC_SERVER = None
         self._shutdown = True
+        if self._autopilot is not None:
+            # stop the supervised standby FIRST: a clean cluster stop
+            # must not leave a warm standby to promote over the corpse
+            try:
+                self._autopilot.actuator.shutdown()
+            except Exception:  # noqa: BLE001 - child already gone
+                logger.debug("autopilot shutdown failed", exc_info=True)
         with self.cv:
             # tell attached raylets to tear their nodes down cleanly
             for n in self.nodes.values():

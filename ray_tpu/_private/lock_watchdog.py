@@ -179,6 +179,21 @@ REPL_NOBLOCK_LOCKS: Set[str] = {"_lock"}
 
 REPL_CV_ALIASES: Dict[str, str] = {}
 
+# Fleet autopilot (elastic/autopilot.py, DESIGN.md §4n): one no-block
+# leaf lock guards the bounded action history + per-(kind,outcome)
+# counters shared between the ticking GCS monitor thread and
+# ``autopilot_status`` RPC readers.  Every other piece of reflex state
+# (rate window, per-node cooldown ledger, prewarm set) is single-writer
+# — only the tick thread touches it — and actuator calls (which may
+# take GCS locks) run with NO autopilot lock held.
+AUTOPILOT_LOCK_DAG: Dict[str, Set[str]] = {
+    "_lock": set(),
+}
+
+AUTOPILOT_NOBLOCK_LOCKS: Set[str] = {"_lock"}
+
+AUTOPILOT_CV_ALIASES: Dict[str, str] = {}
+
 # Metrics TSDB (util/tsdb.py, DESIGN.md §4k): one no-block leaf lock
 # guards the series table, rings, and ingest counters.  Critical
 # sections are O(dict/ring op); queries copy samples out under it and

@@ -748,6 +748,13 @@ class ReplicationHub:
             logger.warning("standby disconnected (%d active)",
                            len(self._standbys))
 
+    def standby_count(self) -> int:
+        """Attached standbys right now.  ``_standbys`` is drain-thread-
+        owned; this cross-thread ``len`` read (the autopilot's standby
+        reflex, §4n) is a benign snapshot — at worst one attach/detach
+        stale, which the next tick corrects."""
+        return len(self._standbys)
+
     def _set_standby_gauge(self) -> None:
         try:
             from ray_tpu._private.config import GLOBAL_CONFIG

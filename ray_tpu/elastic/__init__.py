@@ -24,8 +24,18 @@ The subsystem ROADMAP open item 5 named, in three coupled pieces:
 - **Goodput accounting** (``goodput.py``): useful (first-time) train
   steps per wall-second — the chaos suite asserts goodput, not mere
   survival.
+
+- **Autopilot** (``autopilot.py``, DESIGN.md §4n): the reflex arc
+  closing the observability → actuation loop — straggler events drain
+  the offending host, drain warnings pre-warm replacements, the TSDB's
+  diurnal history feeds the autoscaler a lead-time demand signal, and
+  the head keeps a warm GCS standby attached.  Rate-limited,
+  hysteresis-guarded, vetoed — and every action is itself a fleet
+  event + metric.
 """
 
+from ray_tpu.elastic.autopilot import (Autopilot, AutopilotConfig,
+                                       GcsActuator)
 from ray_tpu.elastic.events import (FleetEventSubscriber, drain_node,
                                     fleet_events, fleet_state)
 from ray_tpu.elastic.goodput import GoodputTracker
@@ -34,7 +44,8 @@ from ray_tpu.elastic.manager import (ElasticConfig, ElasticResult,
 from ray_tpu.elastic.worker_loop import ElasticSpec
 
 __all__ = [
-    "ElasticConfig", "ElasticResult", "ElasticSpec", "ElasticityManager",
-    "FleetEventSubscriber", "GoodputTracker", "drain_node",
-    "fleet_events", "fleet_state",
+    "Autopilot", "AutopilotConfig", "ElasticConfig", "ElasticResult",
+    "ElasticSpec", "ElasticityManager", "FleetEventSubscriber",
+    "GcsActuator", "GoodputTracker", "drain_node", "fleet_events",
+    "fleet_state",
 ]

@@ -16,11 +16,18 @@ The harness ``cluster_utils.Cluster`` + the fake kube provider grew into
   oversubscribed; one placement per demand slot),
 - goodput accounting for one fleet-wide elastic training job under the
   two recovery policies (elastic re-mesh vs restart-from-checkpoint),
-  replayed on the SAME node trajectory.
+  replayed on the SAME node trajectory,
+- optionally, the CLOSED LOOP (§4n): the REAL autopilot policy
+  (``elastic/autopilot.py``) driven on sim time through a
+  :class:`SimActuator` — straggler episodes from the trace become
+  node-tagged detections, remediation drains cost the job real warned
+  transitions, pre-warms and the forecast floor actuate through the
+  real autoscaler hooks, and the rate-limit / veto bounds are asserted
+  against the exact code production runs.
 
 Everything is deterministic from ``(seed, params)``: traces are data
-(``elastic/traces.py``), the sim never reads wall clocks, and ties
-break by sorted ids.
+(``elastic/traces.py``), the sim never reads wall clocks (the autopilot
+gets the sim clock injected), and ties break by sorted ids.
 """
 
 from __future__ import annotations
@@ -31,6 +38,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
 from ray_tpu.autoscaler.node_provider import (
     NODE_KIND_WORKER, NodeProvider, TAG_NODE_KIND, TAG_NODE_TYPE)
+from ray_tpu.elastic.autopilot import Actuator, Autopilot, AutopilotConfig
 from ray_tpu.elastic.goodput import GoodputTracker
 from ray_tpu.elastic.traces import DemandTrace, PreemptionTrace
 
@@ -153,6 +161,54 @@ class SimAutoscaler(StandardAutoscaler):
                 for nid, n in self._harness.provider.nodes.items()}
 
 
+# ----------------------------------------------------------------- actuator
+class SimActuator(Actuator):
+    """Binds the REAL autopilot policy (``elastic/autopilot.py``) to the
+    simulated fleet: drains go through the sim provider (and cost the
+    job a warned transition, exactly like a provider preemption),
+    pre-warms and the forecast floor go through the REAL autoscaler's
+    new hooks, and every emitted event lands in the sim's action log.
+    The storm bounds asserted here are the storm bounds production
+    runs."""
+
+    def __init__(self, sim: "FleetSimulator"):
+        self.sim = sim
+        self.veto_fn = None          # test hook: node_id -> reason|None
+
+    def drain(self, node_id: str, reason: str) -> bool:
+        return self.sim._autopilot_drain(node_id, reason)
+
+    def undrain(self, node_id: str) -> bool:
+        n = self.sim.provider.nodes.get(node_id)
+        if n is None or n.phase != "draining":
+            return False
+        n.phase = "running"
+        return True
+
+    def veto(self, node_id):
+        return self.veto_fn(node_id) if self.veto_fn else None
+
+    def prewarm(self, node_id: str) -> bool:
+        n = self.sim.provider.nodes.get(node_id)
+        if n is None or not n.placements:
+            return False        # idle node: a replacement buys nothing
+        return self.sim.autoscaler.prewarm_for_drain(node_id)
+
+    def demand_now(self) -> float:
+        return float(self.sim._demand_level)
+
+    def demand_forecast(self):
+        return self.sim._seasonal_forecast()
+
+    def forecast_demand(self, slots: int) -> bool:
+        self.sim.autoscaler.set_forecast_demand(slots)
+        return True
+
+    def emit(self, kind, node_id=None, **fields):
+        self.sim.emitted.append({"kind": kind, "node_id": node_id,
+                                 "t": self.sim.provider.now, **fields})
+
+
 # ------------------------------------------------------------------ job model
 @dataclass
 class TrainJobModel:
@@ -233,15 +289,19 @@ class _PolicyState:
         self.tracker.record_pause(new_until - max(self.paused_until, t))
         self.paused_until = new_until
 
-    def advance(self, t: float, dt: float) -> None:
-        """Accrue progress over [t, t+dt)."""
+    def advance(self, t: float, dt: float,
+                rate_scale: float = 1.0) -> None:
+        """Accrue progress over [t, t+dt).  ``rate_scale`` < 1 models a
+        degraded (straggling) member gating the synchronous domain —
+        the whole job runs at the slowest rank's pace until the node is
+        drained out (the autopilot reflex) or recovers."""
         run_s = dt
         if t < self.paused_until:
             run_s = max(0.0, (t + dt) - self.paused_until)
         if run_s <= 0 or self.active <= 0:
             self.tracker.add_progress(ts=t + dt)
             return
-        rate = self.job.steps_per_s_per_slice * self.active
+        rate = self.job.steps_per_s_per_slice * self.active * rate_scale
         # recompute debt burns run time producing WASTED steps first
         waste_s = min(self.pending_recompute_s, run_s)
         self.pending_recompute_s -= waste_s
@@ -263,6 +323,8 @@ class FleetReport:
     max_unfulfilled: int
     double_placements: int
     policies: Dict[str, dict] = field(default_factory=dict)
+    unfulfilled_integral: float = 0.0      # shape-seconds of demand lag
+    autopilot: Optional[dict] = None       # closed-loop action summary
 
     @property
     def goodput_ratio(self) -> float:
@@ -276,6 +338,8 @@ class FleetReport:
                 "stranded_demand": self.stranded_demand,
                 "max_unfulfilled": self.max_unfulfilled,
                 "double_placements": self.double_placements,
+                "unfulfilled_integral": round(self.unfulfilled_integral, 3),
+                "autopilot": self.autopilot,
                 "goodput_ratio": (round(self.goodput_ratio, 4)
                                   if self.goodput_ratio != float("inf")
                                   else None),
@@ -291,7 +355,13 @@ class FleetSimulator:
                  tick_s: float = 5.0,
                  boot_delay_s: float = 30.0,
                  max_workers: int = 200,
-                 autoscale_every_s: float = 10.0):
+                 autoscale_every_s: float = 10.0,
+                 autopilot: bool = False,
+                 autopilot_config: Optional[AutopilotConfig] = None,
+                 detector_delay_s: float = 20.0,
+                 drain_grace_s: float = 20.0,
+                 forecast_horizon_s: float = 90.0,
+                 forecast_period_s: Optional[float] = None):
         self.preemption = preemption
         self.demand_trace = demand
         self.demand_shape = dict(demand_shape)
@@ -307,6 +377,26 @@ class FleetSimulator:
         self._demand_level = 0
         self._placed = 0          # placements currently held
         self._double_placements = 0
+        # --- closed loop (§4n): the REAL autopilot policy on sim time
+        self.actuator = SimActuator(self)
+        self.autopilot: Optional[Autopilot] = None
+        if autopilot:
+            self.autopilot = Autopilot(
+                autopilot_config or AutopilotConfig(),
+                self.actuator, clock=lambda: self.provider.now,
+                metrics=False)
+        self.detector_delay_s = detector_delay_s
+        self.drain_grace_s = drain_grace_s
+        self.forecast_horizon_s = forecast_horizon_s
+        self.forecast_period_s = forecast_period_s or (
+            demand.period_s if demand is not None else 3600.0)
+        self.emitted: List[dict] = []            # autopilot fleet events
+        self.unfulfilled_integral = 0.0          # shape-seconds of lag
+        self._policies: Dict[str, _PolicyState] = {}
+        self._death_row: List[tuple] = []        # (kill_at, node_id)
+        self._stragglers: Dict[str, tuple] = {}  # node -> (factor, until)
+        self._strag_reported: Dict[str, float] = {}
+        self._demand_history: List[tuple] = []   # (t, level)
 
     # -- harness inputs to the real autoscaler
     def unfulfilled_demand(self) -> List[Dict[str, float]]:
@@ -339,23 +429,102 @@ class FleetSimulator:
                 self._placed -= 1
                 excess -= 1
 
+    # -- closed-loop hooks (§4n)
+    def _seasonal_forecast(self) -> Optional[float]:
+        """Demand level one season back at (now + horizon) — the sim's
+        stand-in for the head TSDB's 48h rungs (same seasonal-naive
+        baseline as ``TSDB.forecast``)."""
+        anchor = self.provider.now + self.forecast_horizon_s \
+            - self.forecast_period_s
+        if anchor < 0:
+            return None     # cold start: less than one period of history
+        best = None
+        for ts, level in self._demand_history:
+            if ts <= anchor:
+                best = level
+            else:
+                break
+        return None if best is None else float(best)
+
+    def _autopilot_drain(self, node_id: str, reason: str) -> bool:
+        """The autopilot's remediation drain, sim-side: mark the node
+        draining (it stops straggling the domain — the quiesce excludes
+        it), schedule its hand-off death after ``drain_grace_s``, and
+        charge every policy the WARNED transition it causes."""
+        node = self.provider.nodes.get(node_id)
+        if node is None or node.phase != "running":
+            return False
+        t = self.provider.now
+        self.provider.drain_node(node_id, deadline_s=self.drain_grace_s)
+        self._death_row.append((t + self.drain_grace_s, node_id))
+        self._stragglers.pop(node_id, None)
+        if node.placements:
+            for ps in self._policies.values():
+                ps.lose_slice(t, warned=True)
+        return True
+
+    def _rate_scale(self, t: float) -> float:
+        """The synchronous domain runs at its slowest member's pace: the
+        min factor over currently-degraded nodes still holding
+        placements and still in the domain (phase running)."""
+        scale = 1.0
+        for nid in list(self._stragglers):
+            factor, until = self._stragglers[nid]
+            node = self.provider.nodes.get(nid)
+            if until <= t or node is None:
+                self._stragglers.pop(nid)
+                self._strag_reported.pop(nid, None)
+                continue
+            if node.phase == "running" and node.placements:
+                scale = min(scale, factor)
+        return scale
+
+    def _feed_autopilot(self, t: float) -> None:
+        """Synthesize the detector/fleet-event feed for the reflex
+        engine: a degradation episode older than ``detector_delay_s``
+        (the sim's stand-in for the straggler detector's window) fires a
+        node-tagged straggler event, re-fired each detector interval
+        while it persists — the flapping input the rate limits must
+        bound."""
+        ap = self.autopilot
+        if ap is None:
+            return
+        for nid, (factor, until) in self._stragglers.items():
+            node = self.provider.nodes.get(nid)
+            if node is None or node.phase != "running" \
+                    or not node.placements:
+                continue
+            onset = self._strag_onset.get(nid, t)
+            last = self._strag_reported.get(nid)
+            if t - onset < self.detector_delay_s:
+                continue
+            if last is not None and t - last < self.detector_delay_s:
+                continue
+            self._strag_reported[nid] = t
+            ap.observe({"kind": "straggler", "node_id": nid,
+                        "skew_ratio": round(1.0 / max(factor, 1e-9), 3)})
+        ap.tick(now=t)
+
     # -- run
     def run(self) -> FleetReport:
         trace = self.preemption
         events = sorted(trace.events, key=lambda e: (e.t, e.slice_index))
-        ev_i = 0
+        stragglers = sorted(trace.stragglers,
+                            key=lambda e: (e.t, e.slice_index))
+        ev_i = sv_i = 0
         t = 0.0
         ticks = 0
         launched_total = 0
         preempted_total = 0
         max_unfulfilled = 0
         next_autoscale = 0.0
-        # pending warned preemptions: (kill_at, node_id)
-        death_row: List[tuple] = []
+        self._death_row = []
+        self._strag_onset: Dict[str, float] = {}
         policies = {}
         if self.job is not None:
             policies = {p: _PolicyState(p, self.job, t0=0.0)
                         for p in ("elastic", "restart")}
+        self._policies = policies
 
         while t < trace.duration_s:
             outage = trace.in_outage(t)
@@ -365,6 +534,7 @@ class FleetSimulator:
                 self._demand_level = self.demand_trace.shapes_at(t)
             elif self.job is not None:
                 self._demand_level = self.job.slices_target
+            self._demand_history.append((t, self._demand_level))
             # job slices come up as placements land on booted nodes
             before = self._placed
             self._place_pending()
@@ -373,6 +543,21 @@ class FleetSimulator:
             for ps in policies.values():
                 for _ in range(max(gained, 0)):
                     ps.gain_slice(t)
+
+            # degradation episodes due this tick hit a PLACED node (an
+            # idle node straggling drags nobody)
+            while sv_i < len(stragglers) and \
+                    stragglers[sv_i].t < t + self.tick_s:
+                sv = stragglers[sv_i]
+                sv_i += 1
+                placed = [n for n in self.provider.running()
+                          if n.placements]
+                if not placed:
+                    continue
+                victim = placed[sv.slice_index % len(placed)]
+                self._stragglers[victim.node_id] = (
+                    sv.factor, sv.t + sv.duration_s)
+                self._strag_onset[victim.node_id] = sv.t
 
             # preemption events due this tick
             while ev_i < len(events) and events[ev_i].t < t + self.tick_s:
@@ -387,17 +572,27 @@ class FleetSimulator:
                 if warned:
                     self.provider.drain_node(victim.node_id,
                                              deadline_s=ev.warning_s)
-                    death_row.append((ev.t + ev.warning_s, victim.node_id))
+                    self._death_row.append(
+                        (ev.t + ev.warning_s, victim.node_id))
+                    if self.autopilot is not None:
+                        self.autopilot.observe(
+                            {"kind": "node_draining",
+                             "node_id": victim.node_id})
                 else:
                     self._kill_node(victim.node_id)
                 if victim.placements:
                     for ps in policies.values():
                         ps.lose_slice(ev.t, warned)
             # warned preemptions whose deadline passed die now
-            due = [nid for kill_at, nid in death_row if kill_at <= t]
-            death_row = [(k, n) for k, n in death_row if k > t]
+            due = [nid for kill_at, nid in self._death_row
+                   if kill_at <= t]
+            self._death_row = [(k, n) for k, n in self._death_row
+                               if k > t]
             for nid in due:
                 self._kill_node(nid)
+
+            # the reflex pass: detector feed + autopilot tick (§4n)
+            self._feed_autopilot(t)
 
             # the REAL autoscaler reconcile, on its own cadence
             if t >= next_autoscale:
@@ -408,10 +603,12 @@ class FleetSimulator:
                         len(ids) for ids in report["launched"].values())
                 except RuntimeError:
                     pass        # outage window: launches rejected
-            max_unfulfilled = max(max_unfulfilled,
-                                  len(self.unfulfilled_demand()))
+            backlog = len(self.unfulfilled_demand())
+            max_unfulfilled = max(max_unfulfilled, backlog)
+            self.unfulfilled_integral += backlog * self.tick_s
+            rate_scale = self._rate_scale(t)
             for ps in policies.values():
-                ps.advance(t, self.tick_s)
+                ps.advance(t, self.tick_s, rate_scale)
             t += self.tick_s
             ticks += 1
 
@@ -431,12 +628,20 @@ class FleetSimulator:
                     pass
             t += self.tick_s
 
+        ap_summary = None
+        if self.autopilot is not None:
+            stats = self.autopilot.stats()
+            ap_summary = {"counts": stats["counts"],
+                          "forecast_slots": stats["forecast_slots"],
+                          "events": len(self.emitted)}
         report = FleetReport(
             duration_s=trace.duration_s, ticks=ticks,
             launched=launched_total, preempted=preempted_total,
             stranded_demand=len(self.unfulfilled_demand()),
             max_unfulfilled=max_unfulfilled,
             double_placements=self._double_placements,
+            unfulfilled_integral=self.unfulfilled_integral,
+            autopilot=ap_summary,
             policies={p: {**ps.tracker.summary(now=trace.duration_s),
                           "active_slices": ps.active,
                           "transitions": ps.transitions}
@@ -449,3 +654,8 @@ class FleetSimulator:
             return
         self._placed -= len(node.placements)
         self.provider.terminate_node(node_id)
+        self._stragglers.pop(node_id, None)
+        self._strag_reported.pop(node_id, None)
+        if self.autopilot is not None:
+            self.autopilot.observe({"kind": "node_removed",
+                                    "node_id": node_id})

@@ -65,6 +65,10 @@ class ElasticConfig:
     num_workers: int = 2
     min_workers: int = 1
     cpus_per_worker: float = 1.0
+    # extra per-worker resource claims (TPU chips, custom resources) —
+    # scheduled alongside cpus_per_worker so elastic workers account
+    # for devices exactly like BackendExecutor workers do
+    resources_per_worker: Optional[Dict[str, float]] = None
     spread: bool = True
     auto_rejoin: bool = True
     poll_s: float = 0.1
@@ -118,9 +122,18 @@ class ElasticityManager:
         self._history: List[dict] = []
         self._worker_results: List[dict] = []
         self._restarts = 0
+        # adopted object-plane state record (§4n): unpickling the KV
+        # record borrows the embedded ObjectRef, so holding it here
+        # keeps a large gathered checkpoint alive across worker
+        # restarts (the publishing rank's own ref dies with it).  The
+        # raw bytes are cached so an unchanged record is not
+        # re-borrowed every poll.
+        self._state_rec: Optional[dict] = None
+        self._state_raw: Optional[bytes] = None
         self._events = fleet.FleetEventSubscriber(
             self._on_fleet_event,
-            kinds=("node_draining", "node_added", "node_removed"))
+            kinds=("node_draining", "node_added", "node_removed",
+                   "node_undrained"))
 
     # ------------------------------------------------------------ lifecycle
     def fit(self, timeout_s: float = 600.0) -> ElasticResult:
@@ -179,9 +192,13 @@ class ElasticityManager:
     # ------------------------------------------------------------- spawning
     def _pick_nodes(self, count: int, exclude: set) -> List[dict]:
         from ray_tpu.util import state
+        need = dict(self.config.resources_per_worker or {})
+        need.pop("CPU", None)
         nodes = [n for n in state.list_nodes()
                  if n["alive"] and n["phase"] == "running"
-                 and n["node_id"] not in exclude]
+                 and n["node_id"] not in exclude
+                 and all(n["resources_available"].get(k, 0.0) >= v
+                         for k, v in need.items())]
         nodes.sort(key=lambda n: -n["resources_available"].get("CPU", 0.0))
         if self.config.spread:
             return nodes[:count]
@@ -190,7 +207,8 @@ class ElasticityManager:
     def _spawn_member(self, node: dict) -> _Member:
         from ray_tpu.train._internal.worker_group import TrainWorkerActor
         worker_id = f"ew_{uuid.uuid4().hex[:8]}"
-        res = {}
+        res = dict(self.config.resources_per_worker or {})
+        res.pop("CPU", None)   # CPU rides cpus_per_worker
         if self.config.spread:
             # node-affinity via the node-id resource: the worker IS the
             # slice's representative, so it must live on that node
@@ -343,6 +361,13 @@ class ElasticityManager:
                                    "back to restart", self.group)
         elif kind == "node_removed":
             self._drained_nodes.discard(node_id)
+        elif kind == "node_undrained":
+            # the autopilot returned a drained node to the pool (§4n):
+            # it is schedulable again, so a degraded group may re-grow
+            # onto it exactly like a fresh node
+            self._drained_nodes.discard(node_id)
+            if self.config.auto_rejoin:
+                self._maybe_scale_up()
         elif kind == "node_added" and self.config.auto_rejoin:
             self._maybe_scale_up()
 
@@ -432,6 +457,26 @@ class ElasticityManager:
             useful = self.goodput.record_step(rec["step"])
             rec["useful"] = useful
             self._history.append(rec)
+        # adopt the object-plane checkpoint record every pass: the
+        # ``stateref`` key is tiny (absent for inline states), and
+        # adopting at poll cadence keeps the publisher-died-before-
+        # adoption window at ~poll_s.  Only a CHANGED record is
+        # unpickled (and thereby borrowed) — the old borrow is dropped
+        # when _state_rec is replaced.
+        try:
+            import pickle
+            raw = self.kv._get("stateref")
+            if raw is None:
+                # the checkpoint reverted to inline (or was cleared):
+                # release the superseded blob's borrow — the adopted
+                # ref must not pin a replaced multi-GB object
+                self._state_rec = None
+                self._state_raw = None
+            elif raw != self._state_raw:
+                self._state_raw = raw
+                self._state_rec = pickle.loads(raw)
+        except Exception:  # noqa: BLE001 - adoption is best-effort
+            logger.debug("state-record adoption failed", exc_info=True)
         if GLOBAL_CONFIG.metrics_enabled and self._history:
             mcat.get("rtpu_elastic_goodput_steps_per_s").set(
                 self.goodput.goodput(now=time.monotonic()),
@@ -449,6 +494,7 @@ class ElasticityManager:
                 pass
         self._members = []
         self._leavers = []
+        self._state_rec = None   # release the adopted checkpoint borrow
         try:
             # every worker is gone: drop the group's coordination keys
             # (plan/state/reports) so runs don't accrete in the GCS KV

@@ -23,6 +23,20 @@ class PreemptionEvent:
 
 
 @dataclass
+class StragglerEvent:
+    """One node degrades (slow HBM, thermal throttle, noisy neighbor):
+    from ``t`` the victim's compute runs at ``factor`` of nominal for
+    ``duration_s`` — and a synchronous training domain runs at its
+    slowest member's pace, so the WHOLE job drags until the node is
+    drained (the autopilot's reflex) or the episode ends."""
+
+    t: float
+    slice_index: int
+    factor: float
+    duration_s: float
+
+
+@dataclass
 class PreemptionTrace:
     duration_s: float
     events: List[PreemptionEvent] = field(default_factory=list)
@@ -30,6 +44,8 @@ class PreemptionTrace:
     # cannot boot replacements (spot capacity crunch) — demand backlogs
     # and MUST fully drain once the window closes (the no-strand test)
     outages: List[tuple] = field(default_factory=list)
+    # degradation episodes (closed-loop autopilot traces)
+    stragglers: List[StragglerEvent] = field(default_factory=list)
 
     def in_outage(self, t: float) -> bool:
         return any(a <= t < b for a, b in self.outages)
@@ -41,12 +57,21 @@ def synthetic_preemption_trace(
         warning_s: float = 30.0,
         unwarned_fraction: float = 0.0,
         outage_every_s: Optional[float] = None,
-        outage_len_s: float = 120.0) -> PreemptionTrace:
+        outage_len_s: float = 120.0,
+        straggler_every_s: Optional[float] = None,
+        straggler_factor: float = 0.4,
+        straggler_len_s: float = 900.0) -> PreemptionTrace:
     """Poisson preemption arrivals over a fleet of ``n_slices`` slots.
 
     ``unwarned_fraction`` of events carry no advance notice (hard
     SIGKILL — the restart-only failure mode both recovery policies pay
     full price for); the rest give ``warning_s`` of drain window.
+
+    ``straggler_every_s`` adds seeded degradation episodes (the
+    autopilot's straggler-reflex input) from an INDEPENDENT rng stream
+    (``seed + 2``), so a straggler-bearing trace replays the exact
+    preemption/outage event list of its straggler-free sibling — the
+    closed-loop A/B compares reflexes, not different weather.
     """
     import numpy as np
     rng = np.random.default_rng(seed)
@@ -67,8 +92,21 @@ def synthetic_preemption_trace(
         while start < duration_s:
             outages.append((start, min(start + outage_len_s, duration_s)))
             start += outage_every_s
+    stragglers: List[StragglerEvent] = []
+    if straggler_every_s:
+        srng = np.random.default_rng(seed + 2)
+        t = 0.0
+        while True:
+            t += float(srng.exponential(straggler_every_s))
+            if t >= duration_s:
+                break
+            stragglers.append(StragglerEvent(
+                t=round(t, 3),
+                slice_index=int(srng.integers(0, n_slices)),
+                factor=straggler_factor,
+                duration_s=straggler_len_s))
     return PreemptionTrace(duration_s=duration_s, events=events,
-                           outages=outages)
+                           outages=outages, stragglers=stragglers)
 
 
 @dataclass

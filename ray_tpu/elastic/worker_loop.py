@@ -86,6 +86,15 @@ class ElasticKv:
 
     def __init__(self, group: str):
         self.group = group
+        # newest object-plane state ref published from THIS process
+        # (rank 0 keeps the blob alive until the next gather replaces
+        # it; the manager holds its own borrow via peek_state_record).
+        # _maybe_stale_ref: a "stateref" record may exist in the KV
+        # (True at start — a restarted publisher cannot know); cleared
+        # after the first inline-publish delete so the gather-every-
+        # step hot path pays ONE delete RPC, not one per step
+        self._state_ref: Optional[Any] = None
+        self._maybe_stale_ref = True
 
     # -- raw ops (work from driver and worker processes alike)
     def _put(self, key: str, value: bytes) -> None:
@@ -140,18 +149,78 @@ class ElasticKv:
     def stopped(self) -> bool:
         return self._get("stop") is not None
 
-    # -- gathered state (the checkpoint a re-mesh re-shards from).  KV
-    # transport keeps the protocol one-hop and crash-safe; large states
-    # should raise gather_every and lean on the object-store/data-plane
-    # path instead (the blob is whatever spec.gather_state returns).
+    # -- gathered state (the checkpoint a re-mesh re-shards from).
+    # Small states ride the KV inline (head-durable, one hop, crash-
+    # safe); states above ``elastic_state_inline_max_bytes`` are
+    # published to the object plane and pulled PEER-TO-PEER over the
+    # §4e streaming data plane (range-striped bulk frames) — a multi-GB
+    # gathered state never transits the head.  The KV then holds only a
+    # small record with the ObjectRef; the publisher keeps the newest
+    # ref alive in-process and the manager adopts a borrow
+    # (``peek_state_record``) so the blob outlives the publishing
+    # worker across restarts.  The durability trade (an unwarned loss
+    # of BOTH the publisher's node and the manager loses the blob where
+    # the inline path would have survived) is documented in §4n.
     def put_state(self, host_state: Any, step: int, gen: int) -> None:
         import cloudpickle
-        self._put("state", cloudpickle.dumps(
-            {"step": step, "gen": gen, "state": host_state}))
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        blob = cloudpickle.dumps(
+            {"step": step, "gen": gen, "state": host_state}, protocol=5)
+        if len(blob) <= GLOBAL_CONFIG.elastic_state_inline_max_bytes:
+            self._put("state", blob)
+            if self._maybe_stale_ref:
+                self._del("stateref")    # no object to adopt anymore
+                self._maybe_stale_ref = False
+            self._state_ref = None       # inline copy supersedes the ref
+            return
+        import ray_tpu
+        ref = ray_tpu.put(blob)
+        rec = pickle.dumps({"step": step, "gen": gen, "ref": ref},
+                           protocol=5)
+        self._put("state", rec)
+        # duplicate SMALL record under its own key: the manager's
+        # adoption poll reads only this (absent for inline states), so
+        # it never ships a multi-MB inline checkpoint over the KV just
+        # to discover there is nothing to adopt
+        self._put("stateref", rec)
+        self._maybe_stale_ref = True
+        # hold the NEWEST ref until the next publish replaces it — a
+        # ray_tpu.put refcount follows the local handle, and the KV
+        # stores bytes, not a borrow
+        self._state_ref = ref
+
+    def peek_state_record(self) -> Optional[dict]:
+        """The object-plane state record WITHOUT resolving the blob
+        (None when the newest checkpoint is inline) — unpickling
+        registers a borrow on the embedded ref, which is exactly why
+        the manager calls this: holding the returned record keeps an
+        object-plane checkpoint alive across worker restarts."""
+        blob = self._get("stateref")
+        return pickle.loads(blob) if blob else None
 
     def get_state(self) -> Optional[dict]:
+        """The newest gathered checkpoint, or None.  An object-plane
+        record whose blob is gone (owner node + every borrow lost —
+        the documented durability trade) degrades to None with a loud
+        log: the group restarts from scratch instead of wedging on an
+        unfetchable ref."""
         blob = self._get("state")
-        return pickle.loads(blob) if blob else None
+        if blob is None:
+            return None
+        rec = pickle.loads(blob)
+        if "ref" not in rec:
+            return rec
+        import ray_tpu
+        try:
+            data = ray_tpu.get(rec["ref"])   # streamed peer pull (§4e)
+        except Exception:  # noqa: BLE001 - blob lost with its holders
+            logger.error(
+                "elastic[%s] gathered checkpoint (step %s) lost from "
+                "the object plane — its holder died before the manager "
+                "adopted a borrow; restarting from scratch",
+                self.group, rec.get("step"), exc_info=True)
+            return None
+        return pickle.loads(data)
 
     # -- per-step reports (rank 0): the manager polls + deletes
     def report(self, step: int, gen: int, metrics: Dict[str, Any]) -> None:
@@ -248,9 +317,27 @@ def elastic_worker_loop(group: str, worker_id: str, spec_blob: bytes,
                     "(%s)", group, worker_id[:8], gen, rank, world, step,
                     "cold" if cold else "re-meshed")
 
+        # per-rank step-time histogram: the §4k straggler detector reads
+        # rtpu_train_step_seconds, so an elastic run is node-tagged and
+        # autopilot-drainable exactly like a JaxTrainer session run.
+        # The group tag cohorts the comparison — this job's ranks are
+        # only ever measured against THIS job's median, never against
+        # an unrelated (faster or slower) run sharing the cluster
+        step_hist = None
+        if spec.report_metrics:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+            if GLOBAL_CONFIG.metrics_enabled:
+                from ray_tpu.util import metrics_catalog as mcat
+                step_hist = mcat.get("rtpu_train_step_seconds")
+
         target_gen = None
         while step < spec.total_steps:
+            t_step = time.monotonic()
             state, metrics = prog.step(state, step)
+            if step_hist is not None:
+                step_hist.observe(time.monotonic() - t_step,
+                                  tags={"rank": str(rank),
+                                        "group": group})
             step += 1
             if step % spec.gather_every == 0 or step == spec.total_steps:
                 host_state, host_step = prog.gather_state(state), step

@@ -126,8 +126,13 @@ class _TrainSession:
         from ray_tpu.util import tracing
         if GLOBAL_CONFIG.metrics_enabled and not first:
             rank = str(self.rank)
+            # the run name cohorts the straggler detector's median
+            # (§4k): this run's ranks are only compared among
+            # themselves, never against a concurrent (faster or
+            # slower) run sharing the cluster
             mcat.get("rtpu_train_step_seconds").observe(
-                step_s, tags={"rank": rank})
+                step_s, tags={"rank": rank,
+                              "group": str(self.run_name or "")})
             if step_s > 0:
                 mcat.get("rtpu_train_throughput_steps_per_s").set(
                     1.0 / step_s, tags={"rank": rank})

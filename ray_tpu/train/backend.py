@@ -78,6 +78,23 @@ class JaxConfig(BackendConfig):
     # (or hands control to ray_tpu.elastic, which re-meshes instead of
     # restarting).  None = not subscribed.
     drain_handler: Optional[callable] = None
+    # --- elastic routing (DESIGN.md §4n) -------------------------------
+    # With ``elastic=True``, JaxTrainer.fit() runs through the elastic
+    # worker loop (ElasticityManager) instead of the restart-on-failure
+    # BackendExecutor: node drains quiesce → re-mesh the surviving
+    # jax.distributed domain without a restart, autopilot straggler
+    # drains included.  The contract changes with it:
+    # ``train_loop_per_worker(config)`` must RETURN a program object
+    # with init_state / restore_state / gather_state / step (the
+    # ElasticSpec.build contract) — it runs once per mesh generation on
+    # every worker, after the generation's domain is up.
+    elastic: bool = False
+    elastic_total_steps: int = 0          # or train_loop_config["total_steps"]
+    elastic_gather_every: int = 1
+    elastic_min_workers: int = 1
+    elastic_auto_rejoin: bool = True
+    elastic_quiesce_timeout_s: float = 60.0
+    elastic_timeout_s: float = 600.0
 
     @property
     def backend_cls(self):

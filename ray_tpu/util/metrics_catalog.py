@@ -307,10 +307,30 @@ CATALOG: Dict[str, dict] = {
         kind="counter", tag_keys=("action",),
         description="Autoscaler reconcile decisions (launch | terminate)",
         emitted_by="driver (autoscaler)"),
+    "rtpu_autoscaler_forecast_slots": dict(
+        kind="gauge", tag_keys=(),
+        description="Lead-time demand floor the autopilot's diurnal "
+                    "forecast is currently feeding the autoscaler "
+                    "(extra shapes packed ahead of the measured "
+                    "backlog; DESIGN.md §4n)",
+        emitted_by="driver (autoscaler)"),
+    "rtpu_autopilot_actions_total": dict(
+        kind="counter", tag_keys=("kind", "outcome"),
+        description="Autopilot remediation actions (kind: drain | "
+                    "undrain | prewarm | forecast | standby_launch; "
+                    "outcome: applied | skipped | error) — every "
+                    "reflex firing, including the ones the rate "
+                    "limits and vetoes suppressed (DESIGN.md §4n)",
+        emitted_by="head (GCS)"),
     "rtpu_train_step_seconds": dict(
-        kind="histogram", tag_keys=("rank",), buckets=LATENCY_BUCKETS,
+        kind="histogram", tag_keys=("rank", "group"),
+        buckets=LATENCY_BUCKETS,
         description="Wall time between consecutive train.report() calls "
-                    "on a training worker (one reported step)",
+                    "on a training worker (one reported step).  Elastic "
+                    "worker loops additionally stamp their training "
+                    "group — the straggler detector cohorts its median "
+                    "by this tag so concurrent jobs never read each "
+                    "other as sick",
         emitted_by="train worker"),
     "rtpu_train_throughput_steps_per_s": dict(
         kind="gauge", tag_keys=("rank",),

@@ -101,6 +101,28 @@ def metrics_history(expr: str, start: Optional[float] = None,
     return _rpc("metrics_query", expr=expr, at=at)["results"]
 
 
+def metrics_forecast(expr: str, horizon_s: float,
+                     period_s: float = 86400.0, smooth_s: float = 600.0,
+                     at: Optional[float] = None) -> List[dict]:
+    """Seasonal-naive forecast over the TSDB's 48h rungs (DESIGN.md
+    §4n): the predicted value of each matching gauge series at ``now +
+    horizon_s``, read one ``period_s`` earlier from the ladder — the
+    autopilot's lead-time demand signal, exposed for operators too."""
+    return _rpc("metrics_query", op="forecast", expr=expr,
+                horizon_s=horizon_s, period_s=period_s,
+                smooth_s=smooth_s, at=at)["results"]
+
+
+def autopilot_status(limit: int = 50) -> Dict[str, Any]:
+    """The autopilot's recent remediation actions + reflex counters
+    (DESIGN.md §4n): ``{"enabled": bool, "actions": [...], "stats":
+    {...}}`` — every drain / prewarm / forecast / standby action with
+    its outcome (applied | skipped | error) and reason."""
+    resp = _rpc("autopilot_status", limit=limit)
+    resp.pop("error", None)
+    return resp
+
+
 def metrics_series(match: Optional[str] = None) -> List[dict]:
     """List the TSDB's series (name, kind, tags, newest-sample age);
     ``match`` filters with selector syntax (``name{label="v"}``)."""

@@ -770,6 +770,55 @@ class TSDB:
                         "mean": dsum / dcount, "count": dcount})
         return out
 
+    def forecast(self, expr: str, horizon_s: float,
+                 period_s: float = 86400.0, smooth_s: float = 600.0,
+                 now: Optional[float] = None) -> List[dict]:
+        """Seasonal-naive forecast: the predicted value of each matching
+        series at ``now + horizon_s`` is its mean over the ``smooth_s``
+        window ending one season earlier (``now + horizon_s -
+        period_s``) — yesterday's value at the hour we are scaling for,
+        read from whichever ladder rung still covers it (the 48h long
+        rung holds two diurnal periods).  Cold start (no samples near
+        the seasonal anchor yet) falls back to the mean over the most
+        recent ``smooth_s``, i.e. "no better guess than now" — the
+        autopilot's forecast reflex then never *withholds* capacity it
+        would have requested reactively.
+
+        Gauge (and untyped) series only — a forecast of a cumulative
+        counter or histogram is not a level, so those are omitted.  Returns
+        rows shaped like :meth:`query`, each with ``value`` (the
+        prediction) and ``seasonal`` (False on the cold-start
+        fallback)."""
+        if horizon_s < 0:
+            raise QueryError(f"horizon_s must be >= 0 (got {horizon_s})")
+        if period_s <= 0:
+            raise QueryError(f"period_s must be > 0 (got {period_s})")
+        now = self._clock() if now is None else now
+        sel = _parse_selector(expr)
+        if sel.window_s is not None:
+            raise QueryError("forecast() takes a bare selector "
+                             "(no [window])")
+        anchor = now + horizon_s - period_s
+        rows: List[dict] = []
+        for rec in self._collect(sel, anchor - smooth_s, now):
+            if rec["kind"] not in ("gauge", "untyped"):
+                continue    # counters/histograms: cumulative, not a level
+            seasonal = [float(v) for ts, v in rec["samples"]
+                        if anchor - smooth_s <= ts <= anchor]
+            if seasonal:
+                rows.append({"tags": rec["tags"],
+                             "value": sum(seasonal) / len(seasonal),
+                             "seasonal": True})
+                continue
+            recent = [float(v) for ts, v in rec["samples"]
+                      if ts >= now - smooth_s]
+            if recent:
+                rows.append({"tags": rec["tags"],
+                             "value": sum(recent) / len(recent),
+                             "seasonal": False})
+        rows.sort(key=lambda r: sorted(r["tags"].items()))
+        return rows
+
     def burn_rate(self, series: str, threshold_s: float, objective: float,
                   window_s: float, now: Optional[float] = None
                   ) -> Optional[float]:
@@ -812,12 +861,18 @@ class StragglerDetector:
 
     Over a sliding ``window_s``, each ``rtpu_train_step_seconds`` series
     (one per rank per worker process) yields a window-mean step time
-    (Δsum/Δcount).  With >= ``min_ranks`` active ranks, any rank whose
-    mean exceeds ``ratio`` x the group median is a straggler — reported
-    once per ``cooldown_s`` (default: the window) so a persistently slow
-    rank doesn't flood the fleet-event feed.  The event carries the
-    worker id; the GCS tags on the node id so the elasticity manager
-    can drain the slow host."""
+    (Δsum/Δcount).  Series are COHORTED by their ``group`` tag before
+    comparison (the elastic worker loop stamps its training group;
+    untagged session runs form their own cohort): ranks are only
+    stragglers relative to THEIR job's median — two concurrent jobs
+    with different step times must not read each other as sick, and a
+    cross-job median would misdirect the autopilot's drains.  Within a
+    cohort of >= ``min_ranks`` active ranks, any rank whose mean
+    exceeds ``ratio`` x the cohort median is a straggler — reported
+    once per ``cooldown_s`` (default: the window) so a persistently
+    slow rank doesn't flood the fleet-event feed.  The event carries
+    the worker id; the GCS tags on the node id so the elasticity
+    manager can drain the slow host."""
 
     SERIES = "rtpu_train_step_seconds"
 
@@ -842,30 +897,38 @@ class StragglerDetector:
                             if now - t < self.cooldown_s}
         rows = self.tsdb.windowed_mean_per_series(
             self.SERIES, self.window_s, now=now, min_count=self.min_steps)
-        if len(rows) < self.min_ranks:
-            return []
-        means = sorted(r["mean"] for r in rows)
-        mid = len(means) // 2
-        median = means[mid] if len(means) % 2 \
-            else (means[mid - 1] + means[mid]) / 2.0
-        if median <= 0:
-            return []
-        out: List[dict] = []
+        cohorts: Dict[str, List[dict]] = {}
         for r in rows:
-            if r["mean"] <= self.ratio * median:
+            cohorts.setdefault(r["tags"].get("group", ""), []).append(r)
+        out: List[dict] = []
+        for group, members in sorted(cohorts.items()):
+            if len(members) < self.min_ranks:
                 continue
-            key = (r["tags"].get("rank", "?"), r["tags"].get("worker", "?"))
-            fired = self._last_fired.get(key, 0.0)
-            if now - fired < self.cooldown_s:
+            means = sorted(r["mean"] for r in members)
+            mid = len(means) // 2
+            median = means[mid] if len(means) % 2 \
+                else (means[mid - 1] + means[mid]) / 2.0
+            if median <= 0:
                 continue
-            self._last_fired[key] = now
-            out.append({
-                "kind": "straggler",
-                "rank": key[0], "worker": key[1],
-                "mean_step_s": round(r["mean"], 6),
-                "median_step_s": round(median, 6),
-                "skew_ratio": round(r["mean"] / median, 3),
-                "steps": r["count"], "window_s": self.window_s})
+            for r in members:
+                if r["mean"] <= self.ratio * median:
+                    continue
+                key = (r["tags"].get("rank", "?"),
+                       r["tags"].get("worker", "?"))
+                fired = self._last_fired.get(key, 0.0)
+                if now - fired < self.cooldown_s:
+                    continue
+                self._last_fired[key] = now
+                ev = {
+                    "kind": "straggler",
+                    "rank": key[0], "worker": key[1],
+                    "mean_step_s": round(r["mean"], 6),
+                    "median_step_s": round(median, 6),
+                    "skew_ratio": round(r["mean"] / median, 3),
+                    "steps": r["count"], "window_s": self.window_s}
+                if group:
+                    ev["group"] = group
+                out.append(ev)
         return out
 
 

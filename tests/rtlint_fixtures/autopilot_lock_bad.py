@@ -1,0 +1,34 @@
+"""rtlint fixture: POSITIVE under the AUTOPILOT DAG
+(lock_watchdog.AUTOPILOT_LOCK_DAG) — actuator calls (blocking work)
+under the action-history leaf, and a lockless write to a guarded
+counter.  Not a test module (no test_ prefix); exercised by
+tests/test_rtlint.py."""
+
+import threading
+
+
+class BadAutopilot:
+    def __init__(self, actuator):
+        self.actuator = actuator
+        self._lock = threading.Lock()
+        self._actions = []                   # guarded by: _lock
+        self._counts = {}                    # guarded by: _lock
+
+    def drain_under_history_lock(self, conn, node_id):
+        # actuation (which may dial the GCS or take its locks) belongs
+        # strictly OUTSIDE the leaf: a send under it stalls every
+        # autopilot_status reader mid-RPC (§4d: no blocking under
+        # leaves)
+        with self._lock:
+            conn.send({"kind": "node_draining", "node_id": node_id})
+            self._actions.append(node_id)
+
+    def sleep_under_history_lock(self):
+        import time
+        with self._lock:
+            time.sleep(0.1)
+
+    def lockless_count_bump(self, key):
+        # the counters are read by status RPC threads — a bare update
+        # races the tick thread
+        self._counts[key] = self._counts.get(key, 0) + 1

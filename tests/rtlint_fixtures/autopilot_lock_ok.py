@@ -1,0 +1,28 @@
+"""rtlint fixture: NEGATIVE under the AUTOPILOT DAG — the discipline
+autopilot.py follows: actuator calls with no autopilot lock held, O(1)
+appends to the bounded history under the leaf, copies out for
+readers."""
+
+import threading
+
+
+class OkAutopilot:
+    def __init__(self, actuator):
+        self.actuator = actuator
+        self._lock = threading.Lock()
+        self._actions = []                   # guarded by: _lock
+        self._counts = {}                    # guarded by: _lock
+
+    def record(self, rec, key):
+        with self._lock:
+            self._actions.append(rec)
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def act(self, conn, node_id, rec):
+        # actuation strictly outside the leaf; the record afterwards
+        conn.send({"kind": "node_draining", "node_id": node_id})
+        self.record(rec, "drain/applied")
+
+    def actions(self):
+        with self._lock:
+            return list(self._actions)

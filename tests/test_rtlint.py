@@ -412,7 +412,8 @@ def test_elastic_modules_in_resource_pass_scope():
     from tools.rtlint.resources import default_files
     names = {p.name for p in default_files(ROOT)
              if p.parent.name == "elastic"}
-    assert names == {"events.py", "manager.py", "worker_loop.py"}
+    assert names == {"events.py", "manager.py", "worker_loop.py",
+                     "autopilot.py"}
 
 
 # ------------------------------------------------- whole-tree invariants
@@ -521,6 +522,56 @@ def test_replication_module_in_resource_pass_scope():
     from tools.rtlint.resources import default_files
     names = {p.name for p in default_files(ROOT)}
     assert "replication.py" in names
+
+
+# --------------------------------------------------- autopilot coverage
+def _autopilot_spec():
+    from ray_tpu._private import lock_watchdog as lw
+    from tools.rtlint.lockorder import LockSpec
+    return LockSpec(lw.AUTOPILOT_LOCK_DAG, lw.AUTOPILOT_NOBLOCK_LOCKS,
+                    lw.AUTOPILOT_CV_ALIASES, set())
+
+
+def test_autopilot_lock_pass_flags_positive_fixture():
+    """The lock/guarded passes cover autopilot.py with the AUTOPILOT
+    DAG: actuation (sends, sleeps) under the action-history leaf and a
+    lockless write to a guarded counter are findings."""
+    from ray_tpu._private import lock_watchdog as lw
+    found = check_locks(load(FIX / "autopilot_lock_bad.py"),
+                        _autopilot_spec())
+    assert any(f.rule == "lock-blocking" for f in found), found
+    guarded = check_guarded(load(FIX / "autopilot_lock_bad.py"),
+                            set(lw.AUTOPILOT_LOCK_DAG),
+                            lw.AUTOPILOT_CV_ALIASES)
+    assert any(f.rule == "unguarded" for f in guarded), guarded
+
+
+def test_autopilot_lock_pass_silent_on_negative_fixture():
+    from ray_tpu._private import lock_watchdog as lw
+    found = check_locks(load(FIX / "autopilot_lock_ok.py"),
+                        _autopilot_spec())
+    assert found == [], found
+    guarded = check_guarded(load(FIX / "autopilot_lock_ok.py"),
+                            set(lw.AUTOPILOT_LOCK_DAG),
+                            lw.AUTOPILOT_CV_ALIASES)
+    assert guarded == [], guarded
+
+
+def test_autopilot_tree_is_clean_and_in_scope():
+    """The real autopilot.py passes its lock/guarded checks and the
+    resource pass scans it (the standby Popen's log file handle
+    carries a close obligation)."""
+    from ray_tpu._private import lock_watchdog as lw
+    from tools.rtlint.resources import default_files
+    src = load(ROOT / "ray_tpu" / "elastic" / "autopilot.py")
+    assert check_locks(src, _autopilot_spec()) == []
+    assert check_guarded(src, set(lw.AUTOPILOT_LOCK_DAG),
+                         lw.AUTOPILOT_CV_ALIASES) == []
+    names = {p.name for p in default_files(ROOT)}
+    assert "autopilot.py" in names
+    reach = lw.reachable(lw.AUTOPILOT_LOCK_DAG)
+    for lock, succ in reach.items():
+        assert lock not in succ, f"cycle through {lock}"
 
 
 def test_replication_wire_kinds_checked():

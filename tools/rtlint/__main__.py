@@ -95,6 +95,10 @@ def run_pass(name: str) -> List[Finding]:
             load(priv / "replication.py"),
             LockSpec(lw.REPL_LOCK_DAG, lw.REPL_NOBLOCK_LOCKS,
                      lw.REPL_CV_ALIASES, set()))
+        out += check_locks(
+            load(REPO_ROOT / "ray_tpu" / "elastic" / "autopilot.py"),
+            LockSpec(lw.AUTOPILOT_LOCK_DAG, lw.AUTOPILOT_NOBLOCK_LOCKS,
+                     lw.AUTOPILOT_CV_ALIASES, set()))
         return out
     if name == "guarded":
         from ray_tpu._private import lock_watchdog as lw
@@ -128,6 +132,9 @@ def run_pass(name: str) -> List[Finding]:
             set(lw.TSDB_LOCK_DAG), lw.TSDB_CV_ALIASES)
         out += check_guarded(load(priv / "replication.py"),
                              set(lw.REPL_LOCK_DAG), lw.REPL_CV_ALIASES)
+        out += check_guarded(
+            load(REPO_ROOT / "ray_tpu" / "elastic" / "autopilot.py"),
+            set(lw.AUTOPILOT_LOCK_DAG), lw.AUTOPILOT_CV_ALIASES)
         return out
     if name == "wire":
         from tools.rtlint.wirecheck import check_wire, default_config

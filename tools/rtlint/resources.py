@@ -856,7 +856,7 @@ def default_files(root: Path) -> List[Path]:
              "shm_store.py", "node_agent.py", "actor_server.py",
              "resource_sanitizer.py", "raylet.py", "replication.py")] + \
            [elastic / n for n in
-            ("events.py", "manager.py", "worker_loop.py")]
+            ("events.py", "manager.py", "worker_loop.py", "autopilot.py")]
 
 
 def default_check(root: Path) -> List[Finding]:
