@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
 	databench-quick servebench-quick llmbench-quick tracebench-quick \
 	releasebench-quick fleetbench-quick obsbench-quick \
-	failoverbench-quick trainbench-quick leakcheck
+	profbench-quick failoverbench-quick trainbench-quick leakcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -131,6 +131,17 @@ fleetbench-quick:
 obsbench-quick:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/obs_bench.py --quick \
 		--assert-sane --json benchmarks/results/obsbench_ci.json \
+		--label ci
+
+# Continuous-profiler smoke (CI): serial task RTs with every process
+# sampling at 10Hz + deltas riding the metrics cadence + live
+# profile_query traffic vs profiler_enabled=0, interleaved A/B in one
+# process; asserts <5% overhead on the serial-RT floor and leaves a
+# JSON artifact for the uploader.  The committed full-scale artifact
+# is benchmarks/results/prof_bench_r16.json.
+profbench-quick:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/prof_bench.py --quick \
+		--assert-sane --json benchmarks/results/profbench_ci.json \
 		--label ci
 
 # Head-failover smoke (CI): SIGKILL the primary GCS with a warm

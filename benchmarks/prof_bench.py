@@ -1,0 +1,286 @@
+"""Always-on profiler overhead bench: the §4o sampling plane on vs off.
+
+The continuous-profiling tentpole's contract is that an ALWAYS-ON 10Hz
+sampling profiler — every process walking ``sys._current_frames()``,
+folding stacks, and shipping deltas over the ``__profile__/`` KV plane
+into the head ProfileStore — costs near zero on the task hot path.
+Measured exactly like obs_bench: interleaved A/B in one process on the
+serial submit+get FLOOR (the fastest op is immune to the scheduler
+noise that swings p50s ±50% on shared CI hosts):
+
+- ``off``: ``profiler_enabled=0`` — no sampler threads anywhere, no
+  profile publishes, no head store.
+- ``on``:  ``profiler_enabled=1`` at the default 10Hz with a 1s export
+  period (deltas ride every metrics publish) AND a background client
+  hammering ``profile_query`` (window aggregate + diff) every 100ms
+  during the measurement — sampling, ingest, and query all live.
+
+``--assert-sane`` bounds on-vs-off overhead at <5% (min-of-N floors,
+up to two full interleaved retries — CI hosts are shared).  The sampler
+and store are also microbenched directly (single-sample walk latency,
+store ingest throughput, merged window query latency) for the artifact.
+
+Usage::
+
+    python benchmarks/prof_bench.py --quick --assert-sane \
+        --json benchmarks/results/profbench_ci.json --label ci
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OVERHEAD_BOUND = 0.05
+
+_OFF_CFG = {"profiler_enabled": False, "metrics_export_period_s": 1.0}
+_ON_CFG = {"profiler_enabled": True, "profiler_hz": 10.0,
+           "metrics_export_period_s": 1.0}
+
+
+def _measure_phase(cfg: dict, ops: int, query_load: bool = False) -> dict:
+    """One fresh cluster; serial submit+get floor + p50 in µs."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, _system_config=cfg)
+    stop = threading.Event()
+    qthread = None
+    qcount = [0]
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        for _ in range(10):             # warm the worker + lease cache
+            ray_tpu.get(f.remote(), timeout=60)
+
+        if query_load:
+            # dedicated channel: the hammer must contend with the GCS
+            # like a real `ray_tpu profile` process would (its own conn
+            # + server thread), NOT serialize against the measured
+            # loop's client channel
+            from ray_tpu._private import protocol, worker as worker_mod
+            w = worker_mod.global_worker()
+            chan = protocol.RpcChannel(w.open_conn(w.gcs_path),
+                                       negotiate=True)
+
+            def _hammer():
+                i = 0
+                try:
+                    while not stop.is_set():
+                        try:
+                            if i % 3 == 2:
+                                chan.call("profile_query", op="diff",
+                                          window_a=30.0, window_b=60.0)
+                            else:
+                                chan.call("profile_query",
+                                          window_s=300.0)
+                            qcount[0] += 1
+                        except Exception:  # noqa: BLE001 - head gone
+                            return
+                        i += 1
+                        stop.wait(0.1)
+                finally:
+                    chan.close()
+
+            qthread = threading.Thread(target=_hammer, daemon=True,
+                                       name="profbench-query-load")
+            qthread.start()
+
+        samples: List[float] = []
+        for _ in range(ops):
+            t0 = time.perf_counter()
+            ray_tpu.get(f.remote(), timeout=60)
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return {"floor": samples[0] * 1e6,
+                "p50": samples[len(samples) // 2] * 1e6,
+                "queries": qcount[0]}
+    finally:
+        stop.set()
+        if qthread is not None:
+            qthread.join(timeout=5)
+        ray_tpu.shutdown()
+
+
+def _run_sides(ops: int, repeat: int) -> Dict[str, dict]:
+    best: Dict[str, dict] = {
+        "off": {"floor": float("inf"), "p50": float("inf"), "queries": 0},
+        "on": {"floor": float("inf"), "p50": float("inf"), "queries": 0}}
+    for _ in range(repeat):
+        for side, cfg in (("off", _OFF_CFG), ("on", _ON_CFG)):
+            got = _measure_phase(cfg, ops, query_load=(side == "on"))
+            best[side] = {
+                "floor": min(best[side]["floor"], got["floor"]),
+                "p50": min(best[side]["p50"], got["p50"]),
+                "queries": best[side]["queries"] + got["queries"]}
+    return best
+
+
+def _sampler_micro(quick: bool) -> dict:
+    """Direct sampler + store micro numbers: one stack-walk sample over
+    a realistically deep thread population, store ingest throughput on
+    a fleet-shaped payload, and merged window query latency."""
+    from ray_tpu.util.profiler import ProfileStore, Sampler
+
+    # a few parked threads with ~20-frame stacks so the walk measures
+    # real folding work, not an empty frame table
+    stop = threading.Event()
+
+    def deep(n):
+        if n:
+            return deep(n - 1)
+        stop.wait(60)
+
+    threads = [threading.Thread(target=deep, args=(20,), daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    s = Sampler("bench", hz=10.0, max_stacks=512)
+    s.stop()                            # drive the walk by hand
+    rounds = 200 if quick else 1000
+    lat: List[float] = []
+    try:
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            s._sample_once()
+            lat.append(time.perf_counter() - t0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    lat.sort()
+    delta = s.take_delta() or {"samples": 0, "stacks": {}}
+
+    procs = 16 if quick else 64
+    rounds = 100 if quick else 300
+    clock = [1_000_000.0]
+    store = ProfileStore(clock=lambda: clock[0])
+    stacks = {f"worker.py:main;task.py:run;op{i}:step": 5
+              for i in range(40)}
+    payloads = []
+    for i in range(rounds):
+        payloads.append(json.dumps(
+            {"ts": clock[0] + i, "role": "worker", "pid": 1,
+             "node_id": "n", "samples": 200, "stacks": stacks}).encode())
+    t0 = time.perf_counter()
+    n = 0
+    for i, p in enumerate(payloads):
+        clock[0] += 1.0
+        for wk in range(procs):
+            n += store.ingest(f"w{wk}", p)
+    ingest_s = time.perf_counter() - t0
+    qlat: List[float] = []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        store.profile(window_s=120.0)
+        qlat.append(time.perf_counter() - t0)
+    qlat.sort()
+    return {"sample_walk_p50_us": round(lat[len(lat) // 2] * 1e6, 1),
+            "sample_walk_p99_us": round(lat[int(len(lat) * 0.99)] * 1e6,
+                                        1),
+            "sampled_stacks": len(delta["stacks"]),
+            "store_windows": store.stats()["windows"],
+            "ingest_windows_per_s": round(n / ingest_s),
+            "merged_query_p50_ms": round(qlat[len(qlat) // 2] * 1e3, 3)}
+
+
+def run(quick: bool = False) -> dict:
+    ops = 120 if quick else 200
+    repeat = 3 if quick else 6
+    # throwaway phase: first-boot one-time costs stay off both sides
+    _measure_phase(_OFF_CFG, max(30, ops // 5))
+    best = _run_sides(ops, repeat)
+    overhead = best["on"]["floor"] / best["off"]["floor"] - 1.0
+    # shared-host hiccups on one side: up to two full interleaved
+    # retries before declaring a regression (floors on this class of
+    # host occasionally swing past the bound in EITHER direction)
+    for _ in range(2):
+        if overhead <= OVERHEAD_BOUND:
+            break
+        again = _run_sides(ops, repeat)
+        for side in best:
+            best[side] = {
+                "floor": min(best[side]["floor"], again[side]["floor"]),
+                "p50": min(best[side]["p50"], again[side]["p50"]),
+                "queries": best[side]["queries"] + again[side]["queries"]}
+        overhead = best["on"]["floor"] / best["off"]["floor"] - 1.0
+    micro = _sampler_micro(quick)
+    out = {
+        "ops": ops,
+        "off_floor_us": round(best["off"]["floor"], 1),
+        "on_floor_us": round(best["on"]["floor"], 1),
+        "off_p50_us": round(best["off"]["p50"], 1),
+        "on_p50_us": round(best["on"]["p50"], 1),
+        "overhead_frac": round(overhead, 4),
+        "concurrent_queries": best["on"]["queries"],
+        "bound": OVERHEAD_BOUND,
+        "sampler_micro": micro,
+    }
+    print(f"serial RT floor: off={out['off_floor_us']}us "
+          f"on={out['on_floor_us']}us "
+          f"({100 * out['overhead_frac']:+.2f}%)  "
+          f"[{out['concurrent_queries']} concurrent profile queries "
+          f"served; p50 off={out['off_p50_us']} on={out['on_p50_us']}]")
+    print(f"sampler micro: walk p50 {micro['sample_walk_p50_us']}us "
+          f"p99 {micro['sample_walk_p99_us']}us "
+          f"({micro['sampled_stacks']} stacks); store ingest "
+          f"{micro['ingest_windows_per_s']} windows/s, merged query "
+          f"p50 {micro['merged_query_p50_ms']}ms")
+    return out
+
+
+def assert_sane(res: dict) -> None:
+    assert res["off_floor_us"] > 0 and res["on_floor_us"] > 0, res
+    assert res["overhead_frac"] < OVERHEAD_BOUND, (
+        f"always-on profiler sampling+publish overhead "
+        f"{100 * res['overhead_frac']:.2f}% exceeds the "
+        f"{100 * OVERHEAD_BOUND:.0f}% bound (floor "
+        f"off={res['off_floor_us']}us on={res['on_floor_us']}us)")
+    assert res["concurrent_queries"] > 0, \
+        "the on-side query load never ran — the A/B measured nothing"
+    micro = res["sampler_micro"]
+    # a 10Hz sampler whose walk costs >10ms would eat a core's percent
+    assert micro["sample_walk_p99_us"] < 10_000, \
+        f"implausibly slow stack walk: {micro}"
+    assert micro["ingest_windows_per_s"] > 1_000, \
+        f"implausibly slow store ingest: {micro}"
+    print("prof_bench --assert-sane: OK")
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", default=None, metavar="PATH")
+    ap.add_argument("--label", default=None)
+    ap.add_argument("--assert-sane", action="store_true")
+    args = ap.parse_args(argv)
+    res = run(quick=args.quick)
+    if args.json:
+        doc = {}
+        if os.path.exists(args.json):
+            try:
+                with open(args.json) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = {}
+        doc[args.label or "run"] = res
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"wrote {args.json}")
+    if args.assert_sane:
+        assert_sane(res)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

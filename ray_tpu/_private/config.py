@@ -251,6 +251,29 @@ _FLAG_DEFS = [
           "A rank is a straggler when its window-mean step time "
           "exceeds this multiple of the group median (fires a "
           "'straggler' fleet event tagged with the rank's node)."),
+    _flag("profiler_enabled", True,
+          "Always-on sampling profiler (DESIGN.md §4o): every non-client "
+          "process runs one jittered daemon thread at profiler_hz "
+          "walking sys._current_frames() into a bounded folded-stack "
+          "table; deltas ride the metrics-publisher cadence under the "
+          "reserved __profile__/ KV prefix into the head ProfileStore, "
+          "queryable via profile_query / state.profile() / "
+          "`ray_tpu profile` / the dashboard /profile/flame endpoint."),
+    _flag("profiler_hz", 10.0,
+          "Sampling frequency of the always-on profiler (jittered per "
+          "cycle; ~10Hz keeps the floor overhead under the 5% "
+          "prof_bench bound while still resolving 100ms hot spots)."),
+    _flag("profiler_max_stacks", 512,
+          "Distinct folded stacks kept per publish window; beyond it "
+          "new stacks fold into one '(overflow)' bucket (fixed "
+          "memory, never grown)."),
+    _flag("incident_max", 32,
+          "Incident bundles kept under <session>/incidents/; beyond it "
+          "the oldest bundle directories are evicted (bounded disk)."),
+    _flag("incident_dedup_s", 300.0,
+          "One incident bundle per node per this window: detector "
+          "refires and the autopilot drain that follows them reuse the "
+          "existing bundle id instead of capturing again."),
     # --- fleet autopilot (DESIGN.md §4n) -------------------------------------
     _flag("autopilot_enabled", False,
           "Head-side supervision loop closing the observability -> "

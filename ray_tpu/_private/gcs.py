@@ -34,7 +34,9 @@ nest inside each other and never acquire the global lock.
 
 from __future__ import annotations
 
+import json
 import os
+import shutil
 import subprocess
 import sys
 import threading
@@ -50,6 +52,7 @@ from ray_tpu._private.session import Session
 from ray_tpu._private.shm_store import ShmObjectStore
 from ray_tpu.util import metrics_catalog as mcat
 from ray_tpu.util.metrics import is_metrics_key
+from ray_tpu.util.profiler import is_profile_key
 from ray_tpu import exceptions as exc
 
 logger = rtlog.get("gcs")
@@ -256,7 +259,8 @@ _FENCED_OK_KINDS = frozenset({
     "peek_meta", "pg_table", "list_nodes", "list_actors", "list_tasks",
     "list_objects", "list_workers", "cluster_resources", "store_stats",
     "metrics_query", "fleet_state", "fleet_events", "raylet_table",
-    "resource_demand", "autopilot_status"})
+    "resource_demand", "autopilot_status", "profile_query",
+    "debug_incidents"})
 
 
 class GcsServer:
@@ -272,6 +276,11 @@ class GcsServer:
         # installed before any serve thread so nothing escapes it.
         from ray_tpu._private import flight_recorder
         flight_recorder.maybe_install(session.path, "gcs")
+        # Sampling profiler (DESIGN.md §4o): the head samples itself
+        # too; its deltas skip the KV hop — the monitor loop drains
+        # them straight into the ProfileStore below.
+        from ray_tpu.util import profiler as profiler_mod
+        profiler_mod.maybe_install("gcs")
         self.store = ShmObjectStore(spill_dir=str(session.spill_dir))
         # Native C++ slab store: the small-object data plane (workers attach
         # and read/write directly; the GCS owns lifecycle + refcount deletes).
@@ -371,6 +380,9 @@ class GcsServer:
         # skew > grace would reap a dying worker's final flush instantly)
         # guarded by: _kv_lock
         self._metrics_key_seen: Dict[str, float] = {}
+        # __profile__/ receipts, same head-side receipt-time hygiene
+        # guarded by: _kv_lock
+        self._profile_key_seen: Dict[str, float] = {}
         # Metrics time-series store (DESIGN.md §4k): every __metrics__/
         # snapshot the KV plane already receives is ALSO ingested into
         # head-resident fixed-memory rings (zero new RPCs), queryable
@@ -405,6 +417,22 @@ class GcsServer:
                     window_s=GLOBAL_CONFIG.tsdb_straggler_window_s,
                     ratio=GLOBAL_CONFIG.tsdb_straggler_ratio),
                 SloBurnAlerter(self._tsdb, SLO_RULES)]
+        # Profiling plane (DESIGN.md §4o): every __profile__/ receipt
+        # the KV plane already gets is handed to the head-resident
+        # windowed ProfileStore (fixed memory; history survives the
+        # publisher's death).  Answered by the profile_query op; the
+        # store has its own leaf lock (PROFILER_LOCK_DAG) and is never
+        # called with a GCS lock held.
+        self._profile_store = None
+        self._last_profile_flush = 0.0        # monitor thread only
+        if GLOBAL_CONFIG.profiler_enabled:
+            from ray_tpu.util.profiler import ProfileStore
+            self._profile_store = ProfileStore()
+        # Incident capture (§4o): node_id -> (capture time, bundle id).
+        # Both writers (the detector pass and the autopilot's actuator
+        # callback) run on the monitor thread, so this dedup ledger is
+        # single-threaded — monitor thread only, no lock.
+        self._incident_recent: Dict[str, Tuple[float, str]] = {}
         # Fleet autopilot (DESIGN.md §4n): the reflex arc turning the
         # detectors' fleet events + TSDB history into bounded
         # remediation actions.  Ticked from the monitor loop; reads the
@@ -615,7 +643,8 @@ class GcsServer:
                 # snapshot+WAL == capture equivalence oracle diverges
                 "kv": {ns: flt for ns, t in self.kv.items()
                        if (flt := {k: v for k, v in t.items()
-                                   if not is_metrics_key(k)})},
+                                   if not is_metrics_key(k)
+                                   and not is_profile_key(k)})},
                 "functions": dict(self.functions),
                 "named_actors": dict(self.named_actors),
                 "actors": {
@@ -695,7 +724,8 @@ class GcsServer:
         # publishers' series (and such keys would be invisible to the
         # sweep's receipt index)
         kv_tables = {ns: {k: v for k, v in t.items()
-                          if not is_metrics_key(k)}
+                          if not is_metrics_key(k)
+                          and not is_profile_key(k)}
                      for ns, t in state["kv"].items()}
         functions = dict(state["functions"])
         named = dict(state["named_actors"])
@@ -1875,6 +1905,16 @@ class GcsServer:
                 if now - seen > DEAD_SNAPSHOT_GRACE_S:
                     ns.pop(key, None)
                     self._metrics_key_seen.pop(key, None)
+            # __profile__/ receipts get the same KV hygiene; the
+            # ProfileStore's windowed HISTORY for the dead process
+            # stays queryable (bounded by its own rings) — only the
+            # raw KV payload is reaped
+            for key, seen in list(self._profile_key_seen.items()):
+                if key.split("/", 1)[1] in live:
+                    continue
+                if now - seen > DEAD_SNAPSHOT_GRACE_S:
+                    ns.pop(key, None)
+                    self._profile_key_seen.pop(key, None)
 
     def _monitor_loop(self) -> None:
         from ray_tpu._private.memory_monitor import MemoryMonitor
@@ -1928,6 +1968,21 @@ class GcsServer:
                     self._sweep_dead_metrics()
                 except Exception:  # noqa: BLE001 - telemetry hygiene only
                     logger.exception("metrics snapshot sweep failed")
+            # the head's OWN profiler delta skips the KV hop: drain the
+            # local sampler straight into the store on the same cadence
+            # workers publish at (§4o)
+            if self._profile_store is not None and \
+                    now - self._last_profile_flush > \
+                    max(1.0, GLOBAL_CONFIG.metrics_export_period_s):
+                self._last_profile_flush = now
+                try:
+                    from ray_tpu.util import profiler as profiler_mod
+                    payload = profiler_mod.local_payload(
+                        node_id=self.head_node_id)
+                    if payload is not None:
+                        self._profile_store.ingest("__head__", payload)
+                except Exception:  # noqa: BLE001 - telemetry best-effort
+                    logger.exception("head profile flush failed")
             # anomaly detectors over the TSDB (§4k): straggler skew +
             # SLO burn rate, results into the fleet-event feed
             if self._detectors and now - self._last_detector_check > \
@@ -3866,7 +3921,9 @@ class GcsServer:
             return {"blob": self.functions[msg["fn_id"]]}
 
     def _h_kv_put(self, msg: dict) -> dict:
-        if is_metrics_key(msg["key"]) and \
+        metrics_key = is_metrics_key(msg["key"])
+        profile_key = is_profile_key(msg["key"])
+        if metrics_key and \
                 (msg.get("namespace", "default") != "default"
                  or msg["key"] != f"__metrics__/{msg.get('client_id')}"):
             # reserved prefix IN EVERY NAMESPACE: metrics snapshots are
@@ -3879,16 +3936,24 @@ class GcsServer:
                 "the '__metrics__/' KV prefix is reserved for metric "
                 "snapshot publishing (ephemeral, auto-reaped); store "
                 "application data under a different key")
-        metrics_key = is_metrics_key(msg["key"])
+        if profile_key and \
+                (msg.get("namespace", "default") != "default"
+                 or msg["key"] != f"__profile__/{msg.get('client_id')}"):
+            # same reservation contract as __metrics__/ above
+            raise ValueError(
+                "the '__profile__/' KV prefix is reserved for profiler "
+                "delta publishing (ephemeral, auto-reaped); store "
+                "application data under a different key")
+        telemetry_key = metrics_key or profile_key
         with self._kv_lock:
             ns = self.kv[msg.get("namespace", "default")]
             existed = msg["key"] in ns
             if not (msg.get("overwrite", True) is False and existed):
                 ns[msg["key"]] = msg["value"]
-                if not metrics_key:
+                if not telemetry_key:
                     # WAL capture inside the critical section so two
                     # racing puts of one key record in table order
-                    # (O(1) buffer append; metrics keys are ephemeral
+                    # (O(1) buffer append; telemetry keys are ephemeral
                     # and excluded from the durable set)
                     self._repl_record("kv",
                                       msg.get("namespace", "default"),
@@ -3898,6 +3963,8 @@ class GcsServer:
                 # unguarded: a bare-dict update raced the sweep's
                 # iterate+pop)
                 self._metrics_key_seen[msg["key"]] = time.monotonic()
+            elif profile_key:
+                self._profile_key_seen[msg["key"]] = time.monotonic()
         if metrics_key and self._tsdb is not None:
             # history ingest rides the receipt the KV plane already has
             # (zero new RPCs) — OUTSIDE _kv_lock (json parse + ring
@@ -3908,7 +3975,16 @@ class GcsServer:
                                   msg["value"])
             except Exception:  # noqa: BLE001 - telemetry best-effort
                 logger.exception("tsdb ingest failed")
-        if not metrics_key:
+        if profile_key and self._profile_store is not None:
+            # same receipt-riding ingest, into the profile window rings
+            # — OUTSIDE _kv_lock (parse + merge under the store's own
+            # leaf), and never fails the put
+            try:
+                self._profile_store.ingest(msg["key"].split("/", 1)[1],
+                                           msg["value"])
+            except Exception:  # noqa: BLE001 - telemetry best-effort
+                logger.exception("profile ingest failed")
+        if not telemetry_key:
             # telemetry snapshots are ephemeral by design (re-published
             # every period, reaped when the publisher dies) — every
             # process's publisher dirtying the durable snapshot each
@@ -3922,14 +3998,17 @@ class GcsServer:
 
     def _h_kv_del(self, msg: dict) -> dict:
         metrics_key = is_metrics_key(msg["key"])
+        profile_key = is_profile_key(msg["key"])
         with self._kv_lock:
             existed = self.kv[msg.get("namespace", "default")].pop(msg["key"], None)
             if existed is not None and metrics_key:
                 self._metrics_key_seen.pop(msg["key"], None)
+            elif existed is not None and profile_key:
+                self._profile_key_seen.pop(msg["key"], None)
             elif existed is not None:
                 self._repl_record("kv", msg.get("namespace", "default"),
                                   msg["key"], None)
-        if existed is not None and not metrics_key:
+        if existed is not None and not (metrics_key or profile_key):
             # same ephemeral-telemetry exemption as _h_kv_put: metrics
             # keys are excluded from the snapshot, so reaping one must
             # not rewrite the durable state for nothing
@@ -4167,6 +4246,27 @@ class GcsServer:
         return {"results": self._tsdb.query(msg["expr"],
                                             at=msg.get("at"))}
 
+    def _h_profile_query(self, msg: dict) -> dict:
+        """Query the head ProfileStore (DESIGN.md §4o): ``op`` selects
+        window aggregate ``profile`` (default; optional proc/node
+        filter), ``diff`` (recent window A vs the baseline window B
+        immediately before it), or ``stats``.  Runs entirely off the
+        GCS locks — the store has its own leaf lock."""
+        if self._profile_store is None:
+            return {"samples": 0, "stacks": {}, "procs": [],
+                    "disabled": True}
+        op = msg.get("op", "profile")
+        if op == "stats":
+            return {"stats": self._profile_store.stats()}
+        if op == "diff":
+            return self._profile_store.diff(
+                float(msg.get("window_a") or 300.0),
+                float(msg.get("window_b") or 300.0),
+                proc=msg.get("proc"))
+        return self._profile_store.profile(
+            window_s=float(msg.get("window_s") or 300.0),
+            proc=msg.get("proc"), node_id=msg.get("node_id"))
+
     def _run_detectors(self) -> None:
         """Monitor-loop tick: run the TSDB anomaly detectors and emit
         what they find into the fleet-event feed (§4j), the flight
@@ -4185,6 +4285,12 @@ class GcsServer:
         for ev in found:
             kind = ev.pop("kind")
             node_id = node_of.get(ev.get("worker"))
+            # post-mortem capture (§4o): bundle the offending node's
+            # hot stacks + rings BEFORE anyone reacts — by the time a
+            # human looks, the autopilot may already have drained it
+            iid = self._capture_incident(kind, node_id, detail=ev)
+            if iid is not None:
+                ev = dict(ev, incident=iid)
             self._fleet_event(kind, node_id, **ev)
             if flight_recorder.enabled():
                 flight_recorder.record(
@@ -4207,6 +4313,123 @@ class GcsServer:
         for ev in events:
             self._autopilot.observe(ev)
         self._autopilot.tick()
+
+    def _capture_incident(self, kind: str, node_id: Optional[str],
+                          detail: Optional[dict] = None) -> Optional[str]:
+        """Write one bounded post-mortem bundle into
+        ``<session>/incidents/<ts>_<kind>_<node8>/`` (DESIGN.md §4o):
+        the offending node's recent profile window, an all-worker stack
+        dump, the flight-recorder ring tails, and TSDB sparkline data
+        around the event.  Monitor thread only (the detector pass and
+        the autopilot's actuator callback both run there): one bundle
+        per node per ``incident_dedup_s`` — a refire or the drain that
+        follows reuses the existing id, so the bundle is written
+        exactly once per episode.  Returns the bundle id (or None when
+        the profiling plane is disabled / capture failed)."""
+        if self._profile_store is None:
+            return None
+        now = time.monotonic()
+        dedup_key = node_id or "cluster"
+        prev = self._incident_recent.get(dedup_key)
+        if prev is not None and \
+                now - prev[0] < GLOBAL_CONFIG.incident_dedup_s:
+            return prev[1]
+        ts = time.time()
+        iid = (time.strftime("%Y%m%d_%H%M%S", time.localtime(ts))
+               + f"_{kind}_{(node_id or 'cluster')[:8]}")
+        root = os.path.join(str(self.session.path), "incidents")
+        inc_dir = os.path.join(root, iid)
+        try:
+            os.makedirs(inc_dir, exist_ok=True)
+            bundle: Dict[str, dict] = {
+                "meta.json": {"id": iid, "kind": kind,
+                              "node_id": node_id, "ts": ts,
+                              "detail": detail or {}}}
+            # the node's last profile windows; cluster-wide fallback
+            # when the node published nothing yet (short-lived victim)
+            prof = self._profile_store.profile(window_s=600.0,
+                                               node_id=node_id)
+            if node_id is not None and not prof["samples"]:
+                prof = self._profile_store.profile(window_s=600.0)
+            bundle["profile.json"] = prof
+            try:
+                bundle["stacks.json"] = self._h_stack({"timeout": 2.0})
+            except Exception:  # noqa: BLE001 - best-effort layer
+                bundle["stacks.json"] = {"stacks": {}, "expected": 0}
+            from ray_tpu._private import flight_recorder
+            try:
+                bundle["flight.json"] = flight_recorder.collect(
+                    self.session.path, tail=200)
+            except Exception:  # noqa: BLE001 - best-effort layer
+                bundle["flight.json"] = {}
+            spark: Dict[str, list] = {}
+            if self._tsdb is not None:
+                for expr in (
+                        "sum(rate(rtpu_tasks_total[60s]))",
+                        "quantile_over_time(0.99, "
+                        "rtpu_train_step_seconds[2m])"):
+                    try:
+                        spark[expr] = self._tsdb.query_range(
+                            expr, start=ts - 600.0, end=ts, step=10.0)
+                    except Exception:  # noqa: BLE001 - sparkline only
+                        spark[expr] = []
+            bundle["tsdb.json"] = spark
+            for name, doc in bundle.items():
+                with open(os.path.join(inc_dir, name), "w") as f:
+                    json.dump(doc, f, indent=2, default=str)
+        except Exception:  # noqa: BLE001 - capture must not kill GCS
+            logger.exception("incident capture failed (%s, %s)",
+                             kind, node_id)
+            shutil.rmtree(inc_dir, ignore_errors=True)
+            return None
+        self._incident_recent[dedup_key] = (now, iid)
+        if GLOBAL_CONFIG.metrics_enabled:
+            mcat.get("rtpu_incidents_total").inc(tags={"kind": kind})
+        logger.warning("incident bundle captured: %s", iid)
+        # bounded disk: evict the oldest bundles past incident_max
+        # (ids sort by their timestamp prefix)
+        try:
+            dirs = sorted(d for d in os.listdir(root)
+                          if os.path.isdir(os.path.join(root, d)))
+            while len(dirs) > max(1, GLOBAL_CONFIG.incident_max):
+                shutil.rmtree(os.path.join(root, dirs.pop(0)),
+                              ignore_errors=True)
+        except OSError:
+            pass
+        return iid
+
+    def _h_debug_incidents(self, msg: dict) -> dict:
+        """List captured incident bundles (id + meta), or with ``id``
+        fetch one bundle's files (`ray_tpu debug incidents`)."""
+        root = os.path.join(str(self.session.path), "incidents")
+        iid = msg.get("id")
+        if iid:
+            if os.sep in iid or iid.startswith("."):
+                raise ValueError(f"bad incident id {iid!r}")
+            d = os.path.join(root, iid)
+            if not os.path.isdir(d):
+                return {"error": f"no incident {iid!r}"}
+            files: Dict[str, str] = {}
+            for name in sorted(os.listdir(d)):
+                try:
+                    with open(os.path.join(d, name), "rb") as f:
+                        files[name] = f.read(4 * 1024 * 1024) \
+                            .decode("utf-8", "replace")
+                except OSError:
+                    continue
+            return {"id": iid, "files": files}
+        out: List[dict] = []
+        if os.path.isdir(root):
+            for name in sorted(os.listdir(root)):
+                rec = {"id": name}
+                try:
+                    with open(os.path.join(root, name,
+                                           "meta.json")) as f:
+                        rec.update(json.load(f))
+                except (OSError, ValueError):
+                    pass
+                out.append(rec)
+        return {"incidents": out}
 
     def _h_autopilot_status(self, msg: dict) -> dict:
         """The autopilot's bounded action history + reflex counters
@@ -4686,6 +4909,10 @@ class GcsServer:
         # it is the crash artifact); must precede the leak assert below
         from ray_tpu._private import flight_recorder
         flight_recorder.close()
+        # stop the head's sampling profiler thread (daemon, but a clean
+        # shutdown joins it so no sampler races interpreter teardown)
+        from ray_tpu.util import profiler as profiler_mod
+        profiler_mod.close()
         # leak oracle: a CLEAN head shutdown must leave zero net
         # tracked resources (the driver's Worker.shutdown ran first —
         # __init__.shutdown() orders worker before head)

@@ -208,6 +208,21 @@ TSDB_NOBLOCK_LOCKS: Set[str] = {"_lock"}
 
 TSDB_CV_ALIASES: Dict[str, str] = {}
 
+# Profiling plane (util/profiler.py, DESIGN.md §4o): one no-block leaf
+# lock guards BOTH halves — the sampler's folded-stack delta table
+# (written by the sampling daemon, swapped out by the publisher) and
+# the head ProfileStore's per-process window rings (written at receipt
+# time, copied out by profile_query readers).  Critical sections are
+# O(dict op); stack folding, JSON parsing, merging and diffing all run
+# outside the leaf.
+PROFILER_LOCK_DAG: Dict[str, Set[str]] = {
+    "_lock": set(),
+}
+
+PROFILER_NOBLOCK_LOCKS: Set[str] = {"_lock"}
+
+PROFILER_CV_ALIASES: Dict[str, str] = {}
+
 
 def reachable(dag: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
     """Transitive closure: lock → every lock legally acquirable under it."""
@@ -316,8 +331,16 @@ class WatchdogLock:
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         self._state.on_acquire(self.name)
         import time as _time
+        # fold this thread under a synthetic ``waiting:<lock>`` frame in
+        # the sampling profiler for the duration of the inner acquire —
+        # lock contention then shows up in flames (DESIGN.md §4o)
+        from ray_tpu.util import profiler as _profiler
+        _profiler.note_lock_wait(self.name)
         t0 = _time.monotonic()
-        got = self._inner.acquire(blocking, timeout)
+        try:
+            got = self._inner.acquire(blocking, timeout)
+        finally:
+            _profiler.clear_lock_wait()
         waited = _time.monotonic() - t0
         if waited > self.SLOW_WAIT_S:
             from ray_tpu._private import flight_recorder

@@ -94,6 +94,8 @@ class NodeAgent:
                     flight_recorder.maybe_install(
                         os.path.join(self._spool_dir, str(sess)),
                         "raylet")
+                from ray_tpu.util import profiler as profiler_mod
+                profiler_mod.maybe_install("raylet")
                 self.raylet = raylet.Raylet(
                     self.head, self.node_id, node_info,
                     sock_dir=self._spool_dir,

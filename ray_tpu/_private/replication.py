@@ -1160,6 +1160,10 @@ def _main(argv=None) -> int:
     from ray_tpu._private import resource_sanitizer
     from ray_tpu._private.session import Session
     resource_sanitizer.maybe_install()
+    # the warm standby samples itself too (DESIGN.md §4o): its history
+    # becomes visible through the store the moment it promotes to head
+    from ray_tpu.util import profiler as profiler_mod
+    profiler_mod.maybe_install("standby")
     root, name = os.path.split(os.path.abspath(args.session))
     session = Session(root=root, name=name)
     protocol.set_authkey(session.auth_key())

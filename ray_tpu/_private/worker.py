@@ -297,6 +297,12 @@ class Worker:
             # this is a no-op (first installer of a session wins)
             from ray_tpu._private import flight_recorder
             flight_recorder.maybe_install(session.path, role)
+        if not self.is_client:
+            # always-on sampling profiler (DESIGN.md §4o); same
+            # first-installer-wins idempotence as the flight recorder,
+            # deltas ride the metrics publisher below
+            from ray_tpu.util import profiler as profiler_mod
+            profiler_mod.maybe_install(role)
         self._start_metrics_publisher()
 
     # ------------------------------------------------------ metrics publisher
@@ -322,9 +328,14 @@ class Worker:
         err_logged = False
         # jittered: a fleet of workers forked together must not land
         # synchronized kv_puts on the head every period
+        from ray_tpu.util import profiler as profiler_mod
         while not self._stop.wait(period * random.uniform(0.75, 1.25)):
             try:
                 metrics_mod.publish(self)
+                # the profiler's folded-stack delta rides the same
+                # cadence and connection (§4o) — one more kv_put per
+                # period, nothing per task
+                profiler_mod.publish(self)
                 err_logged = False
             except Exception:  # noqa: BLE001 - head restarting / shutting
                 # down: telemetry must never take a process with it; the
@@ -349,6 +360,11 @@ class Worker:
         try:
             from ray_tpu.util import metrics as metrics_mod
             metrics_mod.publish(self)
+        except Exception:  # noqa: BLE001 - control plane already gone
+            pass
+        try:
+            from ray_tpu.util import profiler as profiler_mod
+            profiler_mod.publish(self)
         except Exception:  # noqa: BLE001 - control plane already gone
             pass
 
@@ -1580,8 +1596,11 @@ class Worker:
         if self._local_server() is None:
             # pure worker/driver process: discharge the recorder mmap
             # now.  In a head==driver process the GCS still serves after
-            # this worker closes — GcsServer.shutdown closes it.
+            # this worker closes — GcsServer.shutdown closes it (and
+            # stops the shared sampler the same way).
             flight_recorder.close()
+            from ray_tpu.util import profiler as profiler_mod
+            profiler_mod.close()
         with self._actor_chan_lock:
             for ch in self._actor_channels.values():
                 ch.close()

@@ -15,6 +15,8 @@ Endpoints:
   GET /metrics/history     — head-TSDB range query (?series=<expr>
                              [&window=600][&step=10]; DESIGN.md §4k) —
                              history + the UI's sparkline feed
+  GET /profile/flame       — continuous-profiling flamegraph SVG
+                             (?window=5m[&proc=ROLE:PID]; DESIGN.md §4o)
 """
 
 from __future__ import annotations
@@ -82,6 +84,33 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 self._json({"expr": expr, "window_s": window,
                             "step_s": step, "results": rows})
+            elif self.path.startswith("/profile/flame"):
+                # continuous-profiling flamegraph (DESIGN.md §4o):
+                # ?window=<dur>[&proc=ROLE:PID] → inline SVG over the
+                # head ProfileStore's trailing window.
+                from urllib.parse import parse_qs, urlparse
+                from ray_tpu.util import profiler as profiler_mod
+                from ray_tpu.util.tsdb import QueryError
+                qs = parse_qs(urlparse(self.path).query)
+                try:
+                    window = profiler_mod.parse_duration(
+                        (qs.get("window") or ["5m"])[0])
+                    resp = state.profile(
+                        window_s=window,
+                        proc=(qs.get("proc") or [None])[0])
+                except QueryError as e:
+                    self._send(400, f"bad query: {e}".encode(),
+                               "text/plain")
+                    return
+                if resp.get("disabled"):
+                    self._send(404, b"profiler disabled on head",
+                               "text/plain")
+                    return
+                svg = profiler_mod.render_flame_svg(
+                    resp.get("stacks", {}),
+                    title=f"ray_tpu flame — {window:.0f}s window, "
+                          f"{resp.get('samples', 0)} samples")
+                self._send(200, svg.encode(), "image/svg+xml")
             elif self.path == "/api/cluster_summary":
                 self._json(state.cluster_summary())
             elif self.path == "/api/nodes":

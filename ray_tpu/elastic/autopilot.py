@@ -154,6 +154,12 @@ class Actuator:
              **fields) -> None:
         pass
 
+    def incident(self, node_id: str, reason: str) -> Optional[str]:
+        """Post-mortem bundle id for this node's episode (§4o) — the
+        head captures one (or returns the id the detector's capture
+        already minted inside the dedup window); None = unsupported."""
+        return None
+
     # -- standby supervision (head-only; None = unsupported here)
     def standby_count(self) -> Optional[int]:
         return None
@@ -288,10 +294,19 @@ class Autopilot:
             st["drains"] += 1
             if relapse:
                 st["permanent"] = True
+            # link the post-mortem bundle: inside the dedup window this
+            # returns the id the detector's capture already minted, so
+            # the action history points at the evidence without a
+            # second bundle ever being written
+            try:
+                iid = self.actuator.incident(node_id, "straggler")
+            except Exception:  # noqa: BLE001 - evidence is best-effort
+                iid = None
             out += self._record(KIND_DRAIN, OUT_APPLIED, node_id,
                                 "straggler", now,
                                 skew=ev.get("skew_ratio"),
-                                rank=ev.get("rank"))
+                                rank=ev.get("rank"),
+                                incident=iid)
             if self.config.prewarm:
                 out += self._do_prewarm(node_id, now)
         else:
@@ -502,6 +517,12 @@ class GcsActuator(Actuator):
         # not be cancelled by the recovery timer
         return self.gcs.undrain_node_internal(node_id,
                                               only_reason="straggler")
+
+    def incident(self, node_id: str, reason: str) -> Optional[str]:
+        # runs on the monitor thread like the detector pass, so the
+        # head's per-node dedup ledger makes this the SAME bundle the
+        # detector captured moments earlier (exactly-once per episode)
+        return self.gcs._capture_incident(reason, node_id)
 
     def veto(self, node_id: str) -> Optional[str]:
         with self.gcs.lock:

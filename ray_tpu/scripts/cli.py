@@ -228,14 +228,135 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Query the always-on cluster profiler (``ray_tpu profile``,
+    DESIGN.md §4o): merged folded stacks over a trailing window,
+    optional differential view, and a dependency-free SVG flamegraph."""
+    _connect(args.address)
+    from ray_tpu.util import profiler as profiler_mod
+    from ray_tpu.util import state
+    from ray_tpu.util.tsdb import QueryError
+    try:
+        if args.diff:
+            win_a = profiler_mod.parse_duration(args.diff[0])
+            win_b = profiler_mod.parse_duration(args.diff[1])
+            resp = state.profile_diff(win_a, win_b, proc=args.proc)
+        else:
+            resp = state.profile(
+                window_s=profiler_mod.parse_duration(args.window),
+                proc=args.proc)
+    except QueryError as e:
+        print(f"profile query error: {e}", file=sys.stderr)
+        return 2
+    if resp.get("disabled"):
+        print("head has no profile store (profiler_enabled=0 or older "
+              "release)", file=sys.stderr)
+        return 1
+    if args.diff:
+        rows = sorted(resp.get("diff", {}).items(),
+                      key=lambda kv: -abs(kv[1]))
+        print(f"# windows: A={resp['window_a_s']:.0f}s (recent) vs "
+              f"B={resp['window_b_s']:.0f}s (before it); "
+              f"delta = A fraction - B fraction")
+        for stack, delta in rows[:40]:
+            print(f"{delta:+.4f}  {stack}")
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(resp, f, indent=2)
+            print(f"wrote diff JSON to {args.output}")
+        return 0
+    stacks = resp.get("stacks", {})
+    if args.flame:
+        svg = profiler_mod.render_flame_svg(stacks)
+        with open(args.flame, "w") as f:
+            f.write(svg)
+        print(f"wrote flamegraph ({resp.get('samples', 0)} samples, "
+              f"{len(stacks)} stacks) to {args.flame}")
+    folded = profiler_mod.folded_text(stacks)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(folded + ("\n" if folded else ""))
+        print(f"wrote folded stacks to {args.output}")
+    if not args.flame and not args.output:
+        print(f"# {resp.get('samples', 0)} samples over "
+              f"{resp.get('window_s', 0):.0f}s from "
+              f"{len(resp.get('procs', []))} process(es)")
+        for line in folded.splitlines()[:40]:
+            print(line)
+    return 0
+
+
+def _debug_stacks(args) -> int:
+    """All-worker stack dump via the debug surface (``ray_tpu debug
+    stacks``): same GCS ``stack`` fan-out as ``ray_tpu stack`` but with
+    a machine-readable ``-o`` JSON form for tooling."""
+    from ray_tpu._private import worker as _worker
+    resp = _worker.global_worker().rpc("stack")
+    got, expected = resp["stacks"], resp["expected"]
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump({"stacks": got, "expected": expected}, f, indent=2)
+        print(f"wrote stacks of {len(got)}/{expected} worker(s) "
+              f"to {args.output}")
+        return 0
+    for wid, text in sorted(got.items()):
+        print(f"===== worker {wid} =====")
+        print(text)
+    if len(got) < expected:
+        print(f"({expected - len(got)} worker(s) did not reply in time)")
+    return 0
+
+
+def _debug_incidents(args) -> int:
+    """Post-mortem bundle access (``ray_tpu debug incidents``): list the
+    head's captured incident bundles, or fetch one with ``--id``."""
+    from ray_tpu._private import worker as _worker
+    w = _worker.global_worker()
+    if args.id:
+        resp = w.rpc("debug_incidents", id=args.id)
+        if resp.get("error"):
+            print(resp["error"], file=sys.stderr)
+            return 1
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(resp, f, indent=2)
+            print(f"wrote incident {args.id} to {args.output}")
+            return 0
+        for name, text in sorted(resp.get("files", {}).items()):
+            print(f"===== {name} =====")
+            print(text)
+        return 0
+    resp = w.rpc("debug_incidents")
+    incidents = resp.get("incidents", [])
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(incidents, f, indent=2)
+        print(f"wrote {len(incidents)} incident(s) to {args.output}")
+        return 0
+    if not incidents:
+        print("no incidents captured")
+        return 0
+    for inc in incidents:
+        ts = inc.get("ts")
+        when = time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(ts)) if ts else "?"
+        print(f"{inc['id']}  kind={inc.get('kind')} "
+              f"node={str(inc.get('node_id'))[:8]} at {when}")
+    return 0
+
+
 def cmd_debug(args) -> int:
-    """Flight-recorder access (``ray_tpu debug dump``): fetch every
-    process's ring — dead (SIGKILLed) processes included — via the GCS
-    ``debug_dump`` op."""
+    """Debug surface: ``dump`` (flight-recorder rings, SIGKILLed
+    processes included), ``stacks`` (all-worker stack dump), and
+    ``incidents`` (post-mortem bundles, DESIGN.md §4o)."""
+    _connect(args.address)
+    if args.action == "stacks":
+        return _debug_stacks(args)
+    if args.action == "incidents":
+        return _debug_incidents(args)
     if args.action != "dump":
         print(f"unknown debug action {args.action!r}", file=sys.stderr)
         return 2
-    _connect(args.address)
     from ray_tpu._private import worker as _worker
     resp = _worker.global_worker().rpc("debug_dump", tail=args.tail)
     procs = resp.get("procs", {})
@@ -474,16 +595,39 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also write the Chrome/Perfetto trace JSON here")
     sp.set_defaults(fn=cmd_trace)
 
-    sp = sub.add_parser("debug", help="debugging aids (flight recorder)")
-    sp.add_argument("action", choices=("dump",),
+    sp = sub.add_parser("debug", help="debugging aids (flight recorder, "
+                        "stack dumps, incident bundles)")
+    sp.add_argument("action", choices=("dump", "stacks", "incidents"),
                     help="dump: every process's flight-recorder ring "
-                         "(SIGKILLed processes included)")
+                         "(SIGKILLed processes included); stacks: "
+                         "all-worker stack dump; incidents: post-mortem "
+                         "bundles captured by the head")
     sp.add_argument("--address", default=None)
     sp.add_argument("--tail", type=int, default=50,
                     help="records per process (newest first kept)")
+    sp.add_argument("--id", default=None,
+                    help="incidents: fetch one bundle by id")
     sp.add_argument("-o", "--output", default=None,
                     help="write the full dump as JSON instead of text")
     sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser("profile", help="query the always-on cluster "
+                        "profiler: folded stacks, differential view, "
+                        "SVG flamegraph")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--window", default="5m",
+                    help="trailing window (e.g. 90s, 5m, 1h; default 5m)")
+    sp.add_argument("--proc", default=None,
+                    help="narrow to one publisher (worker id or ROLE:PID)")
+    sp.add_argument("--diff", nargs=2, metavar=("WINA", "WINB"),
+                    default=None,
+                    help="differential view: recent WINA vs the WINB "
+                         "before it")
+    sp.add_argument("--flame", default=None, metavar="OUT.SVG",
+                    help="write an SVG flamegraph here")
+    sp.add_argument("-o", "--output", default=None,
+                    help="write folded stacks (or diff JSON) here")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("list", help="list cluster entities")
     sp.add_argument("kind", choices=("nodes", "actors", "tasks", "objects",

@@ -35,12 +35,19 @@ def _observe_request(dep_key: str, status: int, t0: float) -> None:
     no-replica 503, user 500 — lands in the same histogram."""
     if not GLOBAL_CONFIG.metrics_enabled:
         return
+    # ``group`` is the cross-layer cohort label (same convention as the
+    # train plane's rtpu_train_step_seconds): serve/LLM series stamp the
+    # deployment key so one selector ({group="X"}) follows a deployment
+    # across proxy, handle, replica, and engine series.
     mcat.get("rtpu_serve_request_latency_seconds").observe(
-        time.monotonic() - t0, tags={"deployment": dep_key})
+        time.monotonic() - t0,
+        tags={"deployment": dep_key, "group": dep_key})
     mcat.get("rtpu_serve_requests_total").inc(
-        tags={"deployment": dep_key, "code": str(status)})
+        tags={"deployment": dep_key, "code": str(status),
+              "group": dep_key})
     if status >= 500:
-        mcat.get("rtpu_serve_errors_total").inc(tags={"deployment": dep_key})
+        mcat.get("rtpu_serve_errors_total").inc(
+            tags={"deployment": dep_key, "group": dep_key})
 
 
 class ProxyActor:

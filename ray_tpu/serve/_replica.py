@@ -56,6 +56,22 @@ class Replica:
         self._streams_lock = threading.Lock()
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu.util import metrics_catalog as mcat
+        if GLOBAL_CONFIG.metrics_enabled:
+            # group-label convention (metrics_catalog.py): this process
+            # hosts exactly one replica of one deployment, so the LLM
+            # engine's rtpu_llm_* series — emitted deep inside the
+            # engine, where no dep_key is in scope — inherit the
+            # deployment key as ``group`` via process-level default tags
+            # (stamped BEFORE user __init__ constructs the engine).
+            for _name in ("rtpu_llm_sequences", "rtpu_llm_kv_blocks",
+                          "rtpu_llm_batch_occupancy",
+                          "rtpu_llm_preemptions_total",
+                          "rtpu_llm_ttft_seconds",
+                          "rtpu_llm_tpot_seconds",
+                          "rtpu_llm_tokens_total"):
+                mcat.get(_name).set_default_tags({"group": dep_key})
         self._instance = user_cls(*init_args, **init_kwargs)
 
     def _track_ongoing(self, delta: int) -> None:
@@ -75,7 +91,8 @@ class Replica:
             if GLOBAL_CONFIG.metrics_enabled:
                 mcat.get("rtpu_serve_ongoing_requests").set(
                     self._ongoing, tags={"deployment": self._dep_key,
-                                         "replica": self._replica_tag})
+                                         "replica": self._replica_tag,
+                                         "group": self._dep_key})
 
     def handle_request(self, method: str, args: Tuple, kwargs: Dict):
         self._track_ongoing(1)

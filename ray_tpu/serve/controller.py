@@ -125,7 +125,7 @@ class ServeController:
         # by this long-lived controller forever (phantom deployment
         # "wanting" replicas on the dashboard)
         mcat.get("rtpu_serve_autoscaler_desired_replicas").remove_series(
-            tags={"deployment": key})
+            tags={"deployment": key, "group": key})
         now = time.monotonic()
         for rs in list(st.replicas.values()):
             self._retire(st, rs, now, grace=0.0)
@@ -237,7 +237,8 @@ class ServeController:
                 # target-vs-ready divergence on the dashboard IS the
                 # autoscaler acting (or stuck)
                 mcat.get("rtpu_serve_autoscaler_desired_replicas").set(
-                    st.target, tags={"deployment": st.key})
+                    st.target, tags={"deployment": st.key,
+                                     "group": st.key})
 
     def _do_autoscale_tick(self, st: _DeploymentState, now: float) -> None:
         ac: Optional[AutoscalingConfig] = st.config.autoscaling_config

@@ -251,7 +251,7 @@ class Router:
             self._deployment_gone = True
             from ray_tpu.util import metrics_catalog as mcat
             mcat.get("rtpu_serve_replica_queue_depth").remove_series(
-                tags={"deployment": self.dep_key})
+                tags={"deployment": self.dep_key, "group": self.dep_key})
             return
         self._deployment_gone = False  # (re)deployed
         with self._lock:
@@ -294,7 +294,8 @@ class Router:
             # watch to see a saturated deployment before latency blows up
             from ray_tpu.util import metrics_catalog as mcat
             mcat.get("rtpu_serve_replica_queue_depth").set(
-                pending, tags={"deployment": self.dep_key})
+                pending,
+                tags={"deployment": self.dep_key, "group": self.dep_key})
         self._controller.report_handle_stats.remote(
             self.router_id, self.dep_key, total)
 

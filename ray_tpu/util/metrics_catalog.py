@@ -143,71 +143,79 @@ CATALOG: Dict[str, dict] = {
                     "connection pool (idle + checked out)",
         emitted_by="every process with a DataPlanePool"),
     # --- serve data plane ---------------------------------------------------
+    # ``group`` label convention: cross-layer series that belong to one
+    # logical workload stamp its name as ``group`` — train series use the
+    # elastic training-group name, serve/LLM series use the deployment
+    # key (stamped at the proxy/handle call sites and, for the engine's
+    # rtpu_llm_* family, via per-replica-process ``set_default_tags``).
+    # One selector ({group="X"}) then follows a workload across every
+    # layer, and group-aware detectors (straggler cohorts) never mix
+    # concurrent workloads.
     "rtpu_serve_requests_total": dict(
-        kind="counter", tag_keys=("deployment", "code"),
+        kind="counter", tag_keys=("deployment", "code", "group"),
         description="HTTP requests completed by the Serve proxy, by "
                     "deployment key and status code",
         emitted_by="serve proxy"),
     "rtpu_serve_errors_total": dict(
-        kind="counter", tag_keys=("deployment",),
+        kind="counter", tag_keys=("deployment", "group"),
         description="Serve requests that ended in a 5xx response",
         emitted_by="serve proxy"),
     "rtpu_serve_request_latency_seconds": dict(
-        kind="histogram", tag_keys=("deployment",),
+        kind="histogram", tag_keys=("deployment", "group"),
         buckets=LATENCY_BUCKETS,
         description="End-to-end Serve request latency at the proxy "
                     "(replica assignment + execution; time-to-first-byte "
                     "for streaming responses)",
         emitted_by="serve proxy"),
     "rtpu_serve_replica_queue_depth": dict(
-        kind="gauge", tag_keys=("deployment",),
+        kind="gauge", tag_keys=("deployment", "group"),
         description="Requests held in a router's assign() waiting for a "
                     "free replica (max_ongoing_requests backpressure)",
         emitted_by="every process with a router (proxy/driver)"),
     "rtpu_serve_ongoing_requests": dict(
-        kind="gauge", tag_keys=("deployment", "replica"),
+        kind="gauge", tag_keys=("deployment", "replica", "group"),
         description="Requests currently executing inside a replica",
         emitted_by="serve replica"),
     "rtpu_serve_autoscaler_desired_replicas": dict(
-        kind="gauge", tag_keys=("deployment",),
+        kind="gauge", tag_keys=("deployment", "group"),
         description="Autoscaler target replica count after the current "
                     "decision tick (equals num_replicas when autoscaling "
                     "is off)",
         emitted_by="serve controller"),
     # --- serve.llm continuous-batching engine -------------------------------
     "rtpu_llm_sequences": dict(
-        kind="gauge", tag_keys=("model", "state"),
+        kind="gauge", tag_keys=("model", "state", "group"),
         description="Sequences inside an LLM engine by state "
                     "(running = in the decode batch, waiting = queued "
                     "for prefill admission, incl. preempted)",
         emitted_by="llm replica"),
     "rtpu_llm_kv_blocks": dict(
-        kind="gauge", tag_keys=("model", "state"),
+        kind="gauge", tag_keys=("model", "state", "group"),
         description="Paged KV cache blocks by state (used | free) in "
                     "an engine's shm block pool",
         emitted_by="llm replica"),
     "rtpu_llm_batch_occupancy": dict(
-        kind="gauge", tag_keys=("model",),
+        kind="gauge", tag_keys=("model", "group"),
         description="Decode batch occupancy: running sequences / "
                     "max_num_seqs after the last scheduler iteration",
         emitted_by="llm replica"),
     "rtpu_llm_preemptions_total": dict(
-        kind="counter", tag_keys=("model",),
+        kind="counter", tag_keys=("model", "group"),
         description="Sequences evicted under KV cache pressure "
                     "(blocks freed, re-prefilled later)",
         emitted_by="llm replica"),
     "rtpu_llm_ttft_seconds": dict(
-        kind="histogram", tag_keys=("model",), buckets=LATENCY_BUCKETS,
+        kind="histogram", tag_keys=("model", "group"), buckets=LATENCY_BUCKETS,
         description="Time to first token: request submission to the "
                     "first sampled token (queueing + prefill)",
         emitted_by="llm replica"),
     "rtpu_llm_tpot_seconds": dict(
-        kind="histogram", tag_keys=("model",), buckets=LATENCY_BUCKETS,
+        kind="histogram", tag_keys=("model", "group"), buckets=LATENCY_BUCKETS,
         description="Time per output token after the first (decode "
                     "cadence), observed once per finished sequence",
         emitted_by="llm replica"),
     "rtpu_llm_tokens_total": dict(
-        kind="counter", tag_keys=("model", "phase"),
+        kind="counter", tag_keys=("model", "phase", "group"),
         description="Tokens processed by an LLM engine: 'prefill' = "
                     "prompt tokens prefilled, 'decode' = tokens "
                     "generated by decode iterations",
@@ -222,6 +230,32 @@ CATALOG: Dict[str, dict] = {
         kind="counter", tag_keys=(),
         description="Samples ingested into the head TSDB from "
                     "__metrics__/ snapshot receipts",
+        emitted_by="head (GCS)"),
+    # --- continuous profiling / incident capture (DESIGN.md §4o) ------------
+    "rtpu_profile_samples_total": dict(
+        kind="counter", tag_keys=(),
+        description="Stack samples taken by this process's always-on "
+                    "sampling profiler and shipped to the head in "
+                    "__profile__/ deltas",
+        emitted_by="every non-client process (profiler_enabled)"),
+    "rtpu_profile_stacks": dict(
+        kind="gauge", tag_keys=(),
+        description="Distinct folded stacks in the last published "
+                    "profile delta (bounded by profiler_max_stacks; an "
+                    "'(overflow)' bucket absorbs the tail)",
+        emitted_by="every non-client process (profiler_enabled)"),
+    "rtpu_profile_publish_seconds": dict(
+        kind="histogram", tag_keys=(), buckets=HOT_HANDLER_BUCKETS,
+        description="Wall time to fold + serialize + ship one profile "
+                    "delta on the metrics-publisher cadence (the "
+                    "profiler's own overhead meter)",
+        emitted_by="every non-client process (profiler_enabled)"),
+    "rtpu_incidents_total": dict(
+        kind="counter", tag_keys=("kind",),
+        description="Post-mortem incident bundles captured by the head "
+                    "on anomaly events (straggler | slo_burn), after "
+                    "incident_dedup_s dedup — each bundle lands in "
+                    "<session>/incidents/<id>/",
         emitted_by="head (GCS)"),
     # --- GCS replication / head fault tolerance (DESIGN.md §4l) -------------
     "rtpu_gcs_wal_records_total": dict(

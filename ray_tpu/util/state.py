@@ -123,6 +123,30 @@ def autopilot_status(limit: int = 50) -> Dict[str, Any]:
     return resp
 
 
+def profile(window_s: float = 300.0, proc: Optional[str] = None,
+            node_id: Optional[str] = None) -> Dict[str, Any]:
+    """Query the head-resident continuous-profiling store (DESIGN.md
+    §4o): the merged folded-stack histogram over the trailing
+    ``window_s`` seconds — ``{"samples": int, "stacks": {folded:
+    count}, "procs": [...], "window_s": float}``.  ``proc`` narrows to
+    one publisher (worker id or ``role:pid``); ``node_id`` narrows to
+    one node.  History for dead processes stays queryable until the
+    store's window rolls past it."""
+    return _rpc("profile_query", window_s=window_s, proc=proc,
+                node_id=node_id)
+
+
+def profile_diff(window_a: float = 300.0, window_b: float = 300.0,
+                 proc: Optional[str] = None) -> Dict[str, Any]:
+    """Differential flame query (DESIGN.md §4o): window A = the
+    trailing ``window_a`` seconds, window B = the ``window_b`` seconds
+    before it.  Returns per-stack sample-fraction deltas (``diff``,
+    positive = hotter now) alongside the raw A/B histograms — the
+    "what changed" view for regressions."""
+    return _rpc("profile_query", op="diff", window_a=window_a,
+                window_b=window_b, proc=proc)
+
+
 def metrics_series(match: Optional[str] = None) -> List[dict]:
     """List the TSDB's series (name, kind, tags, newest-sample age);
     ``match`` filters with selector syntax (``name{label="v"}``)."""

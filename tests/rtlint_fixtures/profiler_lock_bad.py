@@ -1,0 +1,32 @@
+"""rtlint fixture: POSITIVE under the PROFILER DAG
+(lock_watchdog.PROFILER_LOCK_DAG) — blocking work (a KV publish send,
+a sleep) under the sampler's fold-table leaf, and a lockless write to
+a guarded field.  Not a test module (no test_ prefix); exercised by
+tests/test_rtlint.py."""
+
+import threading
+
+
+class BadSampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}                     # guarded by: _lock
+        self._samples = 0                    # guarded by: _lock
+
+    def publish_under_table_lock(self, conn, payload):
+        # shipping the delta (which serializes and dials the head)
+        # belongs strictly OUTSIDE the leaf: a send under it stalls the
+        # 10Hz sampler tick mid-RPC (§4d: no blocking under leaves)
+        with self._lock:
+            conn.send({"kind": "kv_put", "value": payload})
+
+    def sleep_under_table_lock(self):
+        import time
+        with self._lock:
+            time.sleep(0.1)
+
+    def lockless_sample_bump(self, folded):
+        # the table is swapped out by the publisher thread — a bare
+        # update races take_delta()
+        self._table[folded] = self._table.get(folded, 0) + 1
+        self._samples += 1

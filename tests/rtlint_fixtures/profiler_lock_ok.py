@@ -1,0 +1,29 @@
+"""rtlint fixture: NEGATIVE under the PROFILER DAG — the discipline
+profiler.py follows: frames folded OUTSIDE the leaf, O(1) table update
+under it, the delta swapped out and shipped with no lock held."""
+
+import threading
+
+
+class OkSampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}                     # guarded by: _lock
+        self._samples = 0                    # guarded by: _lock
+
+    def record(self, folded):
+        with self._lock:
+            self._table[folded] = self._table.get(folded, 0) + 1
+            self._samples += 1
+
+    def take_delta(self):
+        with self._lock:
+            table, self._table = self._table, {}
+            n, self._samples = self._samples, 0
+        return {"samples": n, "stacks": table}
+
+    def publish(self, conn):
+        # the swap is O(1) under the leaf; serialization and the send
+        # happen on the swapped-out copy with no lock held
+        delta = self.take_delta()
+        conn.send({"kind": "kv_put", "value": delta})

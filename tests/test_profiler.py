@@ -1,0 +1,564 @@
+"""Continuous cluster profiling plane (DESIGN.md §4o).
+
+Four layers, cheapest first:
+
+- **sampler/store units** — folding (distinctive frames, the synthetic
+  ``waiting:<lock>`` leaf, the overflow bucket), delta handoff, the
+  head store's window filtering / proc scoping / differential math, and
+  the presentation helpers (duration grammar, folded text, the
+  dependency-free SVG flamegraph);
+- **live integration** — worker publishers feed the head store over the
+  reserved ``__profile__/`` KV prefix (foreign writes rejected), the
+  head samples itself, ``state.profile()`` / ``profile_diff()`` answer,
+  and the CLI + dashboard surfaces render;
+- **SIGKILL churn** (the PR 10 contract, under the resource sanitizer)
+  — a dead publisher's history stays queryable after its snapshot key
+  is swept, and shutdown discharges every tracked resource;
+- **the chaos acceptance path** — an injected hot-loop straggler under
+  BOTH runtime oracles: the detector fires, exactly ONE post-mortem
+  bundle is captured (dedup asserted against a refiring detector), the
+  injected hot function is visible in the bundle's folded stacks, and
+  the bundle id links from the autopilot's applied drain action.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+
+# worker processes cannot import this test module by name — ship the
+# actor classes by value (the test_train_multicontroller idiom)
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+from conftest import time_scale  # noqa: E402
+from ray_tpu._private import worker as _worker_mod  # noqa: E402
+from ray_tpu._private.config import GLOBAL_CONFIG  # noqa: E402
+from ray_tpu.util import profiler  # noqa: E402
+from ray_tpu.util import state  # noqa: E402
+from ray_tpu.util.tsdb import QueryError  # noqa: E402
+
+
+def _clear_overrides(*names):
+    with GLOBAL_CONFIG._lock:
+        for k in names:
+            GLOBAL_CONFIG._overrides.pop(k, None)
+
+
+# ------------------------------------------------------------ sampler units
+def _stopped_sampler(**kw):
+    """A sampler driven by hand: the background thread is stopped so
+    each test controls exactly when samples are taken."""
+    s = profiler.Sampler("test", hz=kw.pop("hz", 100.0),
+                         max_stacks=kw.pop("max_stacks", 64))
+    s._stop.set()
+    s._thread.join(timeout=5.0)
+    return s
+
+
+def test_sampler_folds_threads_and_lock_waits():
+    s = _stopped_sampler()
+    ev = threading.Event()
+
+    def profiler_test_beacon():
+        profiler.note_lock_wait("gcs")
+        try:
+            ev.wait(30)
+        finally:
+            profiler.clear_lock_wait()
+
+    t = threading.Thread(target=profiler_test_beacon,
+                         name="beacon", daemon=True)
+    t.start()
+    try:
+        time.sleep(0.05)
+        s._sample_once()
+    finally:
+        ev.set()
+        t.join(timeout=5)
+    delta = s.take_delta()
+    assert delta and delta["samples"] >= 1
+    stacks = delta["stacks"]
+    beacon = [k for k in stacks if "profiler_test_beacon" in k]
+    assert beacon, sorted(stacks)
+    # the blocked thread folds under the synthetic lock-wait leaf, and
+    # frames are root-to-leaf (the beacon frame precedes the leaf)
+    assert all(k.endswith("waiting:gcs") for k in beacon), beacon
+    # drained: the next delta is empty
+    assert s.take_delta() is None
+
+
+def test_sampler_overflow_bucket_bounds_the_table():
+    s = _stopped_sampler(max_stacks=16)
+    ev = threading.Event()
+    t = threading.Thread(target=ev.wait, args=(30,),
+                         name="filler", daemon=True)
+    t.start()
+    try:
+        with s._lock:
+            for i in range(16):
+                s._table[f"synthetic;stack{i}"] = 1
+            s._samples = 16
+        time.sleep(0.05)
+        s._sample_once()
+    finally:
+        ev.set()
+        t.join(timeout=5)
+    delta = s.take_delta()
+    # every new distinct stack landed in the overflow bucket — the
+    # table never grew past max_stacks + the bucket itself
+    assert delta["stacks"].get(profiler.OVERFLOW_KEY, 0) >= 1
+    assert len(delta["stacks"]) <= 17
+
+
+def test_maybe_install_is_gated_and_idempotent():
+    GLOBAL_CONFIG.apply_system_config({"profiler_enabled": False})
+    try:
+        profiler.close()
+        assert profiler.maybe_install("t") is None
+        assert profiler.installed() is None
+    finally:
+        _clear_overrides("profiler_enabled")
+    first = profiler.maybe_install("first")
+    try:
+        assert first is not None and first.role == "first"
+        assert profiler.maybe_install("second") is first   # first wins
+    finally:
+        profiler.close()
+    assert profiler.installed() is None
+    profiler.close()   # idempotent
+
+
+# -------------------------------------------------------------- store units
+def _payload(ts, stacks, samples, role="worker", pid=7, node_id="n1"):
+    return json.dumps({"ts": ts, "role": role, "pid": pid,
+                       "node_id": node_id, "samples": samples,
+                       "stacks": stacks}).encode()
+
+
+def test_profile_store_windows_procs_and_nodes():
+    clk = [1000.0]
+    store = profiler.ProfileStore(clock=lambda: clk[0])
+    assert store.ingest("w1", _payload(890.0, {"a;b": 8, "a;c": 2}, 10))
+    assert store.ingest("w1", _payload(990.0, {"a;b": 1, "a;d": 9}, 10))
+    assert store.ingest("w2", _payload(
+        995.0, {"g;h": 5}, 5, role="gcs", pid=1, node_id="n2"))
+    # garbage is rejected, not crashed on
+    assert not store.ingest("bad", b"{not json")
+    assert not store.ingest("bad", _payload(990.0, {"x": 1}, 0))
+
+    p = store.profile(window_s=300.0)
+    assert p["samples"] == 25
+    assert p["stacks"]["a;b"] == 9 and p["stacks"]["g;h"] == 5
+    assert {m["proc"] for m in p["procs"]} == {"worker:7", "gcs:1"}
+    # window filter: only the two recent windows
+    p = store.profile(window_s=50.0)
+    assert p["samples"] == 15 and "a;c" not in p["stacks"]
+    # proc scoping accepts the worker id and the role:pid alias
+    for proc in ("w1", "worker:7"):
+        p = store.profile(window_s=300.0, proc=proc)
+        assert p["samples"] == 20 and "g;h" not in p["stacks"]
+    # node scoping
+    p = store.profile(window_s=300.0, node_id="n2")
+    assert p["samples"] == 5 and set(p["stacks"]) == {"g;h"}
+    with pytest.raises(QueryError):
+        store.profile(window_s=0.0)
+
+    # diff: A=[950,1000] has {a;b:1, a;d:9, g;h:5}; B=[900,950] is empty
+    # except nothing (ts 890 < 900) -> per-sample fractions vs empty B
+    d = store.diff(50.0, 50.0)
+    assert d["a"]["samples"] == 15 and d["b"]["samples"] == 0
+    assert d["diff"]["a;d"] == pytest.approx(9 / 15, abs=1e-6)
+    # A vs the window holding the OLD profile: a;b cooled down
+    d = store.diff(50.0, 100.0)
+    assert d["b"]["samples"] == 10
+    assert d["diff"]["a;b"] == pytest.approx(1 / 15 - 8 / 10, abs=1e-6)
+    with pytest.raises(QueryError):
+        store.diff(10.0, -1.0)
+    assert store.stats() == {"procs": 2, "windows": 3}
+
+
+def test_profile_store_eviction_is_bounded():
+    clk = [1000.0]
+    store = profiler.ProfileStore(clock=lambda: clk[0])
+    for i in range(store.MAX_PROCS + 5):
+        clk[0] += 1.0
+        store.ingest(f"w{i}", _payload(clk[0], {"s": 1}, 1, pid=i))
+    st = store.stats()
+    assert st["procs"] == store.MAX_PROCS     # oldest-first eviction
+    # idle procs are pruned once they age out
+    clk[0] += store.IDLE_PRUNE_S + 10.0
+    store.ingest("fresh", _payload(clk[0], {"s": 1}, 1, pid=999))
+    assert store.stats()["procs"] == 1
+
+
+# ------------------------------------------------------------- presentation
+def test_parse_duration_grammar():
+    assert profiler.parse_duration("90") == 90.0
+    assert profiler.parse_duration("90s") == 90.0
+    assert profiler.parse_duration("5m") == 300.0
+    assert profiler.parse_duration("2h") == 7200.0
+    assert profiler.parse_duration(42) == 42.0
+    for bad in ("junk", "", "-5m", "0", "nan"):
+        with pytest.raises(QueryError):
+            profiler.parse_duration(bad)
+
+
+def test_folded_text_heaviest_first():
+    text = profiler.folded_text({"a;b": 2, "a;c": 9, "z": 2})
+    assert text.splitlines() == ["a;c 9", "a;b 2", "z 2"]
+    assert profiler.folded_text({}) == ""
+
+
+def test_flame_svg_renders_and_escapes():
+    svg = profiler.render_flame_svg(
+        {"main;work<fast>": 3, "main;waiting:gcs": 1},
+        title="t & t")
+    assert svg.startswith("<svg") and svg.endswith("</svg>")
+    assert "t &amp; t — 4 samples" in svg
+    assert "work&lt;fast&gt;" in svg and "<fast>" not in svg
+    # the synthetic lock-wait frame renders in the cold palette
+    assert "rgb(90,130,210)" in svg
+    empty = profiler.render_flame_svg({})
+    assert "no samples in window" in empty
+
+
+# --------------------------------------------------------- live integration
+def _spin_remote(sec):
+    t0 = time.monotonic()
+    x = 0
+    while time.monotonic() - t0 < sec:
+        x += 1
+    return x
+
+
+def test_profile_plane_live_cli_and_dashboard(tmp_path, capsys):
+    """Worker samplers publish over __profile__/, the head ingests (its
+    own monitor-loop flush included), the query surfaces answer, and
+    the reserved prefix rejects foreign writes."""
+    import urllib.error
+    import urllib.request
+
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"metrics_export_period_s": 0.5})
+    try:
+        head = ray_tpu._head
+        if head._profile_store is None:
+            pytest.skip("profiler disabled")
+
+        @ray_tpu.remote
+        def profiler_live_spin(sec):
+            return _spin_remote(sec)
+
+        deadline = time.monotonic() + 60 * time_scale()
+        prof = {}
+        while time.monotonic() < deadline:
+            ray_tpu.get([profiler_live_spin.remote(0.3)
+                         for _ in range(2)])
+            prof = state.profile(window_s=600.0)
+            if prof["samples"] and any("profiler_live_spin" in k
+                                       for k in prof["stacks"]):
+                break
+            time.sleep(0.5)
+        assert prof.get("samples"), "no profile samples reached the head"
+        assert any("profiler_live_spin" in k for k in prof["stacks"]), \
+            sorted(prof["stacks"])[:20]
+        roles = {m["role"] for m in prof["procs"]}
+        assert "worker" in roles or "driver" in roles, prof["procs"]
+        # the head samples ITSELF (no KV hop): its gcs proc appears
+        deadline = time.monotonic() + 30 * time_scale()
+        while time.monotonic() < deadline:
+            prof = state.profile(window_s=600.0)
+            if any(m["role"] == "gcs" for m in prof["procs"]):
+                break
+            time.sleep(0.5)
+        assert any(m["role"] == "gcs" for m in prof["procs"]), \
+            prof["procs"]
+
+        # differential query answers through the same op
+        d = state.profile_diff(60.0, 60.0)
+        assert "diff" in d and d["window_a_s"] == 60.0
+
+        # the snapshot keys live under the reserved prefix...
+        w = _worker_mod.global_worker()
+        keys = w.rpc("kv_keys", prefix="__profile__/")["keys"]
+        assert keys, "publisher never wrote a profile delta to the KV"
+        # ...which rejects foreign writes loudly
+        with pytest.raises(Exception, match="reserved"):
+            w.rpc("kv_put", key="__profile__/mydata", value=b"x")
+
+        # CLI: folded text, file outputs, flamegraph, diff view
+        from ray_tpu.scripts import cli
+        rc = cli.main(["profile", "--window", "10m"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "samples over" in out
+        folded_path = tmp_path / "folded.txt"
+        flame_path = tmp_path / "flame.svg"
+        rc = cli.main(["profile", "--window", "10m",
+                       "-o", str(folded_path),
+                       "--flame", str(flame_path)])
+        capsys.readouterr()
+        assert rc == 0
+        assert any("profiler_live_spin" in ln
+                   for ln in folded_path.read_text().splitlines())
+        svg = flame_path.read_text()
+        assert svg.startswith("<svg") and "ray_tpu flame" in svg
+        rc = cli.main(["profile", "--diff", "1m", "5m"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "windows: A=60s" in out
+        rc = cli.main(["profile", "--window", "not-a-window"])
+        assert rc == 2
+        capsys.readouterr()
+
+        # dashboard: /profile/flame serves the SVG; bad windows 400
+        from ray_tpu.dashboard import start_dashboard, stop_dashboard
+        srv = start_dashboard(port=0)
+        try:
+            port = srv.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/profile/flame?window=10m",
+                    timeout=30) as r:
+                assert r.headers["Content-Type"] == "image/svg+xml"
+                body = r.read().decode()
+            assert body.startswith("<svg")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/profile/flame?window=junk",
+                    timeout=30)
+            assert ei.value.code == 400
+        finally:
+            stop_dashboard()
+    finally:
+        ray_tpu.shutdown()
+        _clear_overrides("metrics_export_period_s")
+
+
+def test_sigkill_mid_publish_history_survives(monkeypatch):
+    """The PR 10 churn contract, profiler edition, under the resource
+    sanitizer: SIGKILL a publishing worker; its __profile__/ key is
+    swept with the metrics sweep, but the head store's history for the
+    dead process stays queryable — and shutdown still balances."""
+    import time as _time
+
+    from ray_tpu.util import metrics as metrics_lib
+
+    monkeypatch.setenv("RAY_TPU_RESOURCE_SANITIZER", "1")
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"metrics_export_period_s": 0.25})
+    try:
+        head = ray_tpu._head
+        if head._profile_store is None:
+            pytest.skip("profiler disabled")
+
+        @ray_tpu.remote
+        class Spinner:
+            def pid(self):
+                return os.getpid()
+
+            def profiler_chaos_spin(self, sec):
+                return _spin_remote(sec)
+
+        a = Spinner.remote()
+        victim_pid = ray_tpu.get(a.pid.remote())
+        victim_wid = next(wk["worker_id"] for wk in state.list_workers()
+                          if wk["pid"] == victim_pid)
+        # drive until the victim's hot frame reaches the head store
+        proc = f"worker:{victim_pid}"
+        deadline = time.monotonic() + 60 * time_scale()
+        seen = {}
+        while time.monotonic() < deadline:
+            ray_tpu.get(a.profiler_chaos_spin.remote(0.4))
+            seen = state.profile(window_s=600.0, proc=proc)
+            if any("profiler_chaos_spin" in k for k in seen["stacks"]):
+                break
+            time.sleep(0.3)
+        assert any("profiler_chaos_spin" in k for k in seen["stacks"]), \
+            (victim_pid, seen)
+
+        # capture a bundle while the victim is alive: it must survive
+        # the SIGKILL (profile window, stack dump, flight rings are all
+        # already on disk — nothing needs the dead process)
+        victim_node = next(wk["node_id"] for wk in state.list_workers()
+                           if wk["pid"] == victim_pid)
+        iid = head._capture_incident("straggler", victim_node)
+        assert iid
+
+        os.kill(victim_pid, signal.SIGKILL)
+        deadline = time.monotonic() + 30 * time_scale()
+        while time.monotonic() < deadline:
+            if all(w["state"] == "dead" or w["pid"] != victim_pid
+                   for w in state.list_workers()):
+                break
+            time.sleep(0.2)
+        # backdate the dead publisher's receipt and sweep: the KV key
+        # goes, the history stays (same grace clock as __metrics__/)
+        w = _worker_mod.global_worker()
+        victim_key = profiler.PROFILE_KV_PREFIX + victim_wid
+        assert victim_key in head._profile_key_seen, \
+            "victim never published a profile delta"
+        with head._kv_lock:
+            head._profile_key_seen[victim_key] = \
+                _time.monotonic() - metrics_lib.DEAD_SNAPSHOT_GRACE_S - 60
+        head._sweep_dead_metrics()
+        assert victim_key not in \
+            w.rpc("kv_keys", prefix="__profile__/")["keys"]
+        after = state.profile(window_s=600.0, proc=proc)
+        assert any("profiler_chaos_spin" in k for k in after["stacks"]), \
+            "dead worker's profile history vanished with its snapshot"
+        assert w.rpc("profile_query", op="stats")["stats"]["procs"] >= 1
+        # the pre-kill incident bundle is intact, hot frames included
+        bundle = w.rpc("debug_incidents", id=iid)
+        assert {"meta.json", "profile.json", "stacks.json",
+                "flight.json"} <= set(bundle["files"]), bundle["files"]
+        prof = json.loads(bundle["files"]["profile.json"])
+        assert any("profiler_chaos_spin" in k for k in prof["stacks"])
+    finally:
+        # sanitizer asserts zero net resources at shutdown
+        ray_tpu.shutdown()
+        _clear_overrides("metrics_export_period_s")
+
+
+# --------------------------------------------- the chaos acceptance path
+def test_hot_loop_straggler_incident_capture_both_oracles(monkeypatch,
+                                                          capsys):
+    """Acceptance: an injected hot-loop straggler under BOTH runtime
+    oracles trips the real detector; exactly ONE incident bundle is
+    captured (the dedup window absorbs the refiring detector AND the
+    autopilot's own capture request); the injected hot function shows
+    in the bundle's folded stacks; and the autopilot's applied drain
+    action links the bundle id."""
+    monkeypatch.setenv("RAY_TPU_LOCK_WATCHDOG", "1")
+    monkeypatch.setenv("RAY_TPU_RESOURCE_SANITIZER", "1")
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import metrics as metrics_lib
+
+    def _captured():
+        snap = metrics_lib.registry_snapshot()
+        return sum(s["value"] for s in
+                   snap.get("rtpu_incidents_total", {}).get("series", [])
+                   if s["tags"].get("kind") == "straggler")
+
+    # the registry is process-global: earlier tests in this process may
+    # already have captured incidents — assert the DELTA, not the total
+    captured_before = _captured()
+
+    ts = time_scale()
+    window_s = 8.0 * ts
+    cluster = Cluster(head_node_args={
+        "num_cpus": 2,
+        "_system_config": {
+            "metrics_export_period_s": 1.0,
+            "tsdb_detector_interval_s": 1.0,
+            "tsdb_straggler_window_s": window_s,
+            "autopilot_enabled": True,
+            "autopilot_interval_s": 0.3,
+            "autopilot_drain_window_s": 600.0,
+            "autopilot_max_drains_per_window": 1,
+            "autopilot_node_cooldown_s": 3600.0,
+            "autopilot_undrain_after_s": 36000.0,
+            "autopilot_forecast": False,
+            "autopilot_standby": False,
+            "incident_dedup_s": 3600.0}})
+    try:
+        head = ray_tpu._head
+        if head._tsdb is None:
+            pytest.skip("tsdb disabled")
+        if head._profile_store is None:
+            pytest.skip("profiler disabled")
+        cluster.add_node(num_cpus=2)
+        victim = cluster.add_node(num_cpus=2)
+
+        @ray_tpu.remote
+        class Injector:
+            def __init__(self, rank):
+                self.rank = rank
+
+            def steps(self, n, step_s):
+                from ray_tpu.util import metrics_catalog as mc
+                h = mc.get("rtpu_train_step_seconds")
+                for _ in range(n):
+                    h.observe(step_s, tags={"rank": self.rank})
+                return n
+
+            def chaos_hot_loop(self, sec):
+                # the distinctively-named busy loop the captured
+                # post-mortem profile must show
+                return _spin_remote(sec)
+
+        fast = [Injector.options(num_cpus=0.05).remote(f"i{r}")
+                for r in range(3)]
+        slow = Injector.options(
+            num_cpus=0.05,
+            resources={f"node:{victim.node_id}": 0.001}).remote("i3")
+
+        w = ray_tpu._private.worker.global_worker()
+        deadline = time.time() + 180 * ts
+        incident_id = None
+        while time.time() < deadline and incident_id is None:
+            # the victim node runs hot (the profiler's view) AND slow
+            # (the detector's view) until the anomaly fires
+            ray_tpu.get([a.steps.remote(3, 0.1) for a in fast]
+                        + [slow.chaos_hot_loop.remote(1.0),
+                           slow.steps.remote(3, 2.0)])
+            events = w.rpc("fleet_events", since=0)["events"]
+            for e in events:
+                if e["kind"] == "straggler" and e.get("incident"):
+                    incident_id = e["incident"]
+                    break
+        assert incident_id, "detector never fired / no incident minted"
+
+        # exactly ONE bundle despite the detector refiring every tick
+        resp = w.rpc("debug_incidents")
+        incidents = resp["incidents"]
+        assert len(incidents) == 1, incidents
+        assert incidents[0]["id"] == incident_id
+        assert incidents[0]["kind"] == "straggler"
+        assert incidents[0]["node_id"] == victim.node_id
+
+        # the bundle: meta + profile + stacks + flight + tsdb, with the
+        # injected hot function in the captured folded stacks
+        bundle = w.rpc("debug_incidents", id=incident_id)
+        files = bundle["files"]
+        assert {"meta.json", "profile.json"} <= set(files), sorted(files)
+        prof = json.loads(files["profile.json"])
+        assert prof["samples"] > 0
+        assert any("chaos_hot_loop" in k for k in prof["stacks"]), \
+            sorted(prof["stacks"])[:20]
+        # traversal is refused, a missing id is an error not a crash
+        assert "error" in w.rpc("debug_incidents", id="nope")
+        with pytest.raises(Exception):
+            w.rpc("debug_incidents", id="../gcs_state")
+
+        # the autopilot's applied drain carries the SAME bundle id (the
+        # dedup window makes its capture request return the detector's)
+        deadline = time.time() + 60 * ts
+        applied = []
+        while time.time() < deadline and not applied:
+            status = state.autopilot_status(limit=200)
+            applied = [a for a in status["actions"]
+                       if a["kind"] == "drain"
+                       and a["outcome"] == "applied"]
+            time.sleep(0.3)
+        assert applied, "autopilot never drained the victim"
+        assert applied[0]["node_id"] == victim.node_id
+        assert applied[0].get("incident") == incident_id, applied[0]
+
+        # the incident counter ticked on the head — exactly once
+        assert _captured() - captured_before == 1
+
+        # operator surface: the CLI lists the bundle and fetches it
+        from ray_tpu.scripts import cli
+        rc = cli.main(["debug", "incidents"])
+        out = capsys.readouterr().out
+        assert rc == 0 and incident_id in out
+        rc = cli.main(["debug", "incidents", "--id", incident_id])
+        out = capsys.readouterr().out
+        assert rc == 0 and "meta.json" in out
+    finally:
+        cluster.shutdown()

@@ -574,6 +574,56 @@ def test_autopilot_tree_is_clean_and_in_scope():
         assert lock not in succ, f"cycle through {lock}"
 
 
+# ---------------------------------------------------- profiler coverage
+def _profiler_spec():
+    from ray_tpu._private import lock_watchdog as lw
+    from tools.rtlint.lockorder import LockSpec
+    return LockSpec(lw.PROFILER_LOCK_DAG, lw.PROFILER_NOBLOCK_LOCKS,
+                    lw.PROFILER_CV_ALIASES, set())
+
+
+def test_profiler_lock_pass_flags_positive_fixture():
+    """The lock/guarded passes cover profiler.py with the PROFILER DAG:
+    blocking work (sends, sleeps) under the sampler's fold-table leaf
+    and a lockless write to a guarded field are findings."""
+    from ray_tpu._private import lock_watchdog as lw
+    found = check_locks(load(FIX / "profiler_lock_bad.py"),
+                        _profiler_spec())
+    assert any(f.rule == "lock-blocking" for f in found), found
+    guarded = check_guarded(load(FIX / "profiler_lock_bad.py"),
+                            set(lw.PROFILER_LOCK_DAG),
+                            lw.PROFILER_CV_ALIASES)
+    assert any(f.rule == "unguarded" for f in guarded), guarded
+
+
+def test_profiler_lock_pass_silent_on_negative_fixture():
+    from ray_tpu._private import lock_watchdog as lw
+    found = check_locks(load(FIX / "profiler_lock_ok.py"),
+                        _profiler_spec())
+    assert found == [], found
+    guarded = check_guarded(load(FIX / "profiler_lock_ok.py"),
+                            set(lw.PROFILER_LOCK_DAG),
+                            lw.PROFILER_CV_ALIASES)
+    assert guarded == [], guarded
+
+
+def test_profiler_tree_is_clean_and_in_scope():
+    """The real profiler.py passes its lock/guarded checks and the
+    resource pass scans it (the sampler thread is daemon — self-
+    discharging — but the module must stay in scope as it grows)."""
+    from ray_tpu._private import lock_watchdog as lw
+    from tools.rtlint.resources import default_files
+    src = load(ROOT / "ray_tpu" / "util" / "profiler.py")
+    assert check_locks(src, _profiler_spec()) == []
+    assert check_guarded(src, set(lw.PROFILER_LOCK_DAG),
+                         lw.PROFILER_CV_ALIASES) == []
+    names = {p.name for p in default_files(ROOT)}
+    assert "profiler.py" in names
+    reach = lw.reachable(lw.PROFILER_LOCK_DAG)
+    for lock, succ in reach.items():
+        assert lock not in succ, f"cycle through {lock}"
+
+
 def test_replication_wire_kinds_checked():
     """The wire pass proves every REPL_* kind has its endpoint arm and
     producer — and catches a seeded kind with neither."""

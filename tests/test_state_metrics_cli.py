@@ -281,6 +281,46 @@ def test_stack_dump(ray_start_regular):
     ray_tpu.cancel(ref)
 
 
+def test_debug_stacks_cli(ray_start_regular, tmp_path, capsys):
+    """`ray_tpu debug stacks`: the same GCS stack fan-out as
+    `ray_tpu stack`, plus a machine-readable -o JSON form."""
+    import time as _t
+
+    from ray_tpu._private import worker as _wm
+    from ray_tpu.scripts import cli
+
+    @ray_tpu.remote
+    def sleepy_cli():
+        _t.sleep(8)
+        return 1
+
+    ref = sleepy_cli.remote()
+    # same poll-until-on-stack discipline as test_stack_dump above
+    deadline = _t.monotonic() + 60
+    while _t.monotonic() < deadline:
+        resp = _wm.global_worker().rpc("stack")
+        if resp["expected"] >= 1 and "sleepy_cli" in \
+                "\n".join(resp["stacks"].values()):
+            break
+        _t.sleep(0.3)
+    try:
+        rc = cli.main(["debug", "stacks"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "===== worker " in out and "sleepy_cli" in out
+
+        path = tmp_path / "stacks.json"
+        rc = cli.main(["debug", "stacks", "-o", str(path)])
+        capsys.readouterr()
+        assert rc == 0
+        doc = json.loads(path.read_text())
+        assert doc["expected"] >= 1
+        assert any("sleepy_cli" in text
+                   for text in doc["stacks"].values())
+    finally:
+        ray_tpu.cancel(ref)
+
+
 def test_native_store_metrics_exported(ray_start_regular):
     """SURVEY.md §2.1 Stats row: the C++ slab store's own counters
     (shared-header hits/misses/allocs/fails) surface as cluster gauges."""

@@ -99,6 +99,10 @@ def run_pass(name: str) -> List[Finding]:
             load(REPO_ROOT / "ray_tpu" / "elastic" / "autopilot.py"),
             LockSpec(lw.AUTOPILOT_LOCK_DAG, lw.AUTOPILOT_NOBLOCK_LOCKS,
                      lw.AUTOPILOT_CV_ALIASES, set()))
+        out += check_locks(
+            load(REPO_ROOT / "ray_tpu" / "util" / "profiler.py"),
+            LockSpec(lw.PROFILER_LOCK_DAG, lw.PROFILER_NOBLOCK_LOCKS,
+                     lw.PROFILER_CV_ALIASES, set()))
         return out
     if name == "guarded":
         from ray_tpu._private import lock_watchdog as lw
@@ -135,6 +139,9 @@ def run_pass(name: str) -> List[Finding]:
         out += check_guarded(
             load(REPO_ROOT / "ray_tpu" / "elastic" / "autopilot.py"),
             set(lw.AUTOPILOT_LOCK_DAG), lw.AUTOPILOT_CV_ALIASES)
+        out += check_guarded(
+            load(REPO_ROOT / "ray_tpu" / "util" / "profiler.py"),
+            set(lw.PROFILER_LOCK_DAG), lw.PROFILER_CV_ALIASES)
         return out
     if name == "wire":
         from tools.rtlint.wirecheck import check_wire, default_config

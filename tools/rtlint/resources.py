@@ -856,7 +856,8 @@ def default_files(root: Path) -> List[Path]:
              "shm_store.py", "node_agent.py", "actor_server.py",
              "resource_sanitizer.py", "raylet.py", "replication.py")] + \
            [elastic / n for n in
-            ("events.py", "manager.py", "worker_loop.py", "autopilot.py")]
+            ("events.py", "manager.py", "worker_loop.py", "autopilot.py")] + \
+           [root / "ray_tpu" / "util" / "profiler.py"]
 
 
 def default_check(root: Path) -> List[Finding]:
