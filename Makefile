@@ -12,17 +12,23 @@ test:
 
 # Lint gate (SURVEY.md §4 CI row): dependency-free flake8/clang-format
 # stand-in — ast checks for Python, g++ -fsyntax-only -Wall for C++ —
-# plus rtlint, the repo-specific concurrency/protocol analyzer.
+# plus rtlint in incremental mode: passes whose git-changed input set
+# is empty are skipped (interprocedural passes still run over their
+# full inputs when any input moved — partial summaries are unsound).
+# CI and `make rtlint` run the full tree.
 lint:
 	$(PY) tools/lint.py
-	$(PY) -m tools.rtlint
+	$(PY) -m tools.rtlint --changed-only
 
-# rtlint (DESIGN.md §4d/§4f): machine-enforces the GCS locking
+# rtlint (DESIGN.md §4d/§4f/§4p): machine-enforces the GCS locking
 # discipline (lock-order DAG, no blocking under leaf locks),
 # guarded-field annotations, wire-protocol exhaustiveness,
 # spawned-thread hygiene, metrics-catalog honesty, resource lifecycle
-# (close/transfer on every exit path incl. exception edges), and wire
-# reply discipline (exactly-one-reply per two-way dispatch arm).
+# (close/transfer on every exit path incl. exception edges), wire
+# reply discipline (exactly-one-reply per two-way dispatch arm),
+# interprocedural blocking-flow (REACTOR_SAFE / hot-arm / bounded-
+# timeout policies + the BLOCK_BOUNDS static==runtime identity), and
+# session-FSM conformance over the old x new version matrix.
 # Fixture corpus: tests/rtlint_fixtures/.  `--list-rules` prints the
 # catalog.
 rtlint:

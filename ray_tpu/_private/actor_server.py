@@ -93,6 +93,9 @@ class ActorServer:
         try:
             while not self._stopped.is_set():
                 try:
+                    # rtlint: blocks-ok(parks between a caller's method
+                    # invocations; caller death EOFs the conn — peer
+                    # liveness is the deadline, per-conn thread)
                     msg = conn.recv()
                 except (EOFError, OSError):
                     return
@@ -130,6 +133,9 @@ class ActorServer:
 
     def _exec_loop(self) -> None:
         while not self._stopped.is_set():
+            # rtlint: blocks-ok(parks until work arrives; _shutdown
+            # enqueues a None sentinel per exec thread, so stop always
+            # wakes the get — the sentinel is the deadline)
             item = self._queue.get()
             if item is None:
                 return

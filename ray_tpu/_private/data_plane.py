@@ -326,6 +326,9 @@ class DataPlaneServer:
         try:
             while not self._stop.is_set():
                 try:
+                    # rtlint: blocks-ok(parks between a puller's ops;
+                    # peer death EOFs the conn — per-conn thread, peer
+                    # liveness is the deadline)
                     msg = conn.recv()
                 except (EOFError, OSError):
                     return
@@ -597,6 +600,10 @@ def _negotiate_data_proto(conn) -> int:
     conn.send({"op": "__proto_hello__",
                "versions": list(range(wire.DATA_PROTO_MIN,
                                       wire.DATA_PROTO_MAX + 1))})
+    # rtlint: blocks-ok(hello handshake on a fresh dial: every server
+    # version replies to the first frame (legacy = unknown-op error),
+    # so the reply or EOF arrives within the peer's serve latency; the
+    # fetch leader's 120s coalesce cap bounds the caller)
     resp = conn.recv()
     if resp.get("error"):
         return 0
@@ -676,6 +683,9 @@ def _pull_chunks(conn, object_id: str) -> bytearray:
     """v0 request-per-chunk pull (legacy holders; also the in-pool
     fallback when a cached-v1 address turns out to be v0)."""
     conn.send({"op": "fetch_object", "object_id": object_id})
+    # rtlint: blocks-ok(request/reply on the v0 pull path: the holder
+    # answers every op or EOFs; the fetch leader's 120s coalesce cap
+    # (gcs._pull_remote_local) bounds the caller-visible wait)
     head = conn.recv()
     if "error" in head:
         raise FileNotFoundError(object_id)
@@ -686,6 +696,8 @@ def _pull_chunks(conn, object_id: str) -> bytearray:
     while off < size:
         conn.send({"op": "fetch_chunk", "object_id": object_id,
                    "offset": off, "length": min(chunk, size - off)})
+        # rtlint: blocks-ok(same request/reply contract and 120s
+        # coalesce cap as the fetch_object head frame above)
         r = conn.recv()
         piece = r.get("data")
         if not piece:
@@ -982,6 +994,10 @@ class DataPlanePool:
             t.start()
         run(bounds[0][0], bounds[0][1], pc0)
         for t in threads:
+            # rtlint: blocks-ok(stripe workers run _stream_range, whose
+            # every blocking op is EOF/reset-terminated; a dead holder
+            # errors all stripes and the joins return — the 120s fetch
+            # coalesce cap bounds the caller)
             t.join()
         if errors:
             raise errors[0]
@@ -1007,6 +1023,9 @@ class DataPlanePool:
         """(byte count, inline payload or None) from a fetch_stream ack
         — small ranges ride the ack itself, larger ones follow as bulk
         frames."""
+        # rtlint: blocks-ok(ack for a just-sent fetch_stream: the
+        # holder acks, errors, or EOFs; 120s fetch coalesce cap bounds
+        # the caller-visible wait)
         head = pc.conn.recv()
         err = head.get("error")
         if err is not None:
@@ -1039,18 +1058,25 @@ class DataPlanePool:
         s = _socket.socket(fileno=conn.fileno())
         try:
             while True:
+                # rtlint: blocks-ok(mid-stream read: the holder has
+                # acked and is writing frames back-to-back; death mid-
+                # stream resets the socket, 120s coalesce cap upstream)
                 protocol.recv_exact_into(s, hv)
                 kind, ln = wire.bulk_unpack_header(hdr)
                 if kind == wire.BULK_DATA:
                     if got + ln > n:
                         raise _StreamError(
                             f"stream overrun ({got + ln} > {n})")
+                    # rtlint: blocks-ok(same mid-stream contract as the
+                    # header read above)
                     protocol.recv_exact_into(s, view[got:got + ln])
                     got += ln
                 elif kind == wire.BULK_END:
                     break
                 elif kind == wire.BULK_ERR:
                     eb = bytearray(ln)
+                    # rtlint: blocks-ok(same mid-stream contract as the
+                    # header read above)
                     protocol.recv_exact_into(s, memoryview(eb))
                     raise _StreamMiss(eb.decode("utf-8", "replace"))
                 else:
@@ -1066,6 +1092,9 @@ class DataPlanePool:
         got = 0
         while got < n:
             try:
+                # rtlint: blocks-ok(mid-stream read on the relay path —
+                # same acked-stream contract as _recv_stream_raw, 120s
+                # coalesce cap upstream)
                 m = conn.recv_bytes_into(view, got)
             except BufferTooShort:
                 raise _StreamError("stream overrun (relay)") from None

@@ -2051,6 +2051,10 @@ class GcsServer:
                         # connection went quiet mid-burst: apply now (a
                         # lone release must not wait for a next frame)
                         self._drain_ref_ops(ref_buf)
+                    # rtlint: blocks-ok(parks between a client's rpcs;
+                    # client death EOFs the channel and the finally arm
+                    # drains buffered ref ops — peer liveness is the
+                    # deadline, per-conn thread so nothing else stalls)
                     msg, seen_ver, seen_codec = wire.conn_recv_ex(conn)
                     peer_rtmsg = seen_codec == wire._CODEC_RTMSG
                 except (EOFError, OSError):
@@ -2226,7 +2230,10 @@ class GcsServer:
                 if ev is None:
                     self._dedup_pending[key] = threading.Event()
                     return None
-            if not ev.wait(30.0):
+            from ray_tpu._private import lock_watchdog
+            with lock_watchdog.bounded_block("gcs.dedup_wait"):
+                won = ev.wait(30.0)
+            if not won:
                 # original thread wedged: degrade to at-least-once rather
                 # than hanging the retry forever
                 return None
@@ -2248,6 +2255,9 @@ class GcsServer:
         logger.info("node agent attached for node %s", node_id[:8])
         while not self._shutdown:
             try:
+                # rtlint: blocks-ok(parks for the agent's lifetime; the
+                # EOF on agent/host death is the signal this loop exists
+                # to catch — it triggers node removal below)
                 conn.recv()
             except (EOFError, OSError):
                 break
@@ -2297,6 +2307,9 @@ class GcsServer:
         detached = False
         while not self._shutdown:
             try:
+                # rtlint: blocks-ok(parks between raylet pushes; raylet
+                # heartbeats every beat so silence longer than the
+                # monitor's dead-node threshold ends in EOF/removal)
                 msg, _ = wire.conn_recv(conn)
             except (EOFError, OSError, wire.WireError):
                 break
@@ -3399,6 +3412,10 @@ class GcsServer:
                         left = sorted(waiter["left"])[:3]
                     raise exc.GetTimeoutError(
                         f"get() timed out waiting for {left}...")
+                # rtlint: blocks-ok(get_meta IS a client-blocking rpc:
+                # the per-conn dispatch thread stalls only its own
+                # caller; slices capped at 1s and the caller's deadline
+                # bounds the loop)
                 ev.wait(timeout=min(1.0, remaining)
                         if remaining is not None else 1.0)
                 ev.clear()
@@ -3470,6 +3487,10 @@ class GcsServer:
                     else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     break
+                # rtlint: blocks-ok(wait() IS a client-blocking rpc:
+                # stalls only its own caller's per-conn thread; slices
+                # capped at 0.5s and the wire-carried timeout bounds
+                # the loop)
                 ev.wait(timeout=min(0.5, remaining)
                         if remaining is not None else 0.5)
                 ev.clear()
@@ -4666,6 +4687,9 @@ class GcsServer:
             if leader:
                 ev = self._remote_pulls[oid] = threading.Event()
         if not leader:
+            # rtlint: blocks-ok(follower of a coalesced remote pull:
+            # parks its own caller only, 120s literal cap, and the
+            # leader settles or times out the shared event first)
             ev.wait(timeout=120)
             with self.lock:
                 m = self.objects.get(oid)

@@ -395,3 +395,168 @@ def wrap_gcs_locks(srv) -> WatchdogState:
                                         attr, state))
     srv._lock_watchdog = state
     return state
+
+
+# ======================================================================
+# Blocking-flow policy (DESIGN.md §4p) — the machine-readable side of
+# tools/rtlint's ``blocking`` pass, mirroring how the lock DAGs above
+# back the ``locks`` pass.  Three tables:
+#
+# - ``REACTOR_SAFE``: functions the item-1 reactor will call inline on
+#   the event loop.  rtlint proves each is TRANSITIVELY non-blocking
+#   over the whole in-repo call graph (rule ``block-reactor``) — seeded
+#   with the wire codec, frame parse, and the shm ``_sealed``-table
+#   read paths, and grown as handlers are made reactor-ready.
+# - ``BLOCK_BOUNDS``: every site wrapped in :func:`bounded_block`
+#   declares its worst-case bound (seconds) here.  rtlint asserts the
+#   call sites and this table agree exactly (``block-bound-undeclared``
+#   / ``block-bound-dead``), and the runtime oracle below asserts the
+#   declared bound actually holds under the chaos suite.
+# - the per-context *allowed blocking classes* live in
+#   ``tools/rtlint/blocking.py`` next to the context list (they
+#   parameterize the analysis, not the runtime).
+
+# Dotted as ``module.func`` / ``module.Class.method`` relative to
+# ray_tpu/_private (the reactor core lives there).
+REACTOR_SAFE: Set[str] = {
+    # wire codec + frame parse: encode/decode must run inline on the
+    # reactor between readiness callbacks
+    "wire.rtmsg_dumps",
+    "wire.rtmsg_loads",
+    "wire.encode_frame",
+    "wire.decode_frame",
+    "wire.decode_frame_ex",
+    "wire.bulk_pack_header",
+    "wire.bulk_unpack_header",
+    "wire.negotiate_version",
+    # shm ``_sealed``-table read paths: O(dict op) under leaf locks,
+    # safe to answer from the loop (get_meta/peek fast path)
+    "shm_store.ShmObjectStore.location",
+    "shm_store.ShmObjectStore.touch",
+    "shm_store.ShmObjectStore.stats",
+    "shm_store.ShmObjectStore.exists_in_shm",
+}
+
+# site name -> worst-case block duration in seconds.  A site's bound is
+# the DECLARED contract: the static pass pins each ``bounded_block``
+# call to exactly one row here, and ``RAY_TPU_BLOCK_WATCHDOG=1``
+# raises :class:`BlockBoundViolation` when a wrapped site overruns
+# ``bound * RAY_TPU_BLOCK_WATCHDOG_SLACK``.  Keep bounds honest-worst-
+# case (timeout argument + scheduling slop), not aspirational.
+BLOCK_BOUNDS: Dict[str, float] = {
+    # protocol.tunnel_connect: bounded handshake poll before the first
+    # recv (proxy answers immediately; 30s covers a GC-pausing head)
+    "protocol.tunnel_connect.handshake": 30.0,
+    # gcs._dedup_begin: winner-completion wait for a duplicate two-way
+    # mutation (ev.wait(30.0) literal)
+    "gcs.dedup_wait": 30.0,
+    # raylet._reconnect_upstream: one jittered backoff sleep
+    # (backoff_delays cap=0.5 base=0.05; 1s absorbs jitter + scheduler
+    # lag)
+    "raylet.reconnect_backoff": 1.0,
+    # raylet._done_flush_loop: batch-coalescing tick (wait(1.0) literal)
+    "raylet.done_flush_tick": 1.0,
+    # replication hub ticker: _event.wait(hb_period); dynamic bound
+    # passed at the site, this row is the config-default ceiling
+    "repl.hub_tick": 60.0,
+    # standby stream poll: conn.poll(gcs_standby_timeout_s) — a poll
+    # overrun means heartbeats stopped AND the poll itself wedged
+    "repl.stream_poll": 60.0,
+}
+
+
+class BlockBoundViolation(RuntimeError):
+    """A statically-declared-bounded blocking site overran its bound."""
+
+
+def block_watchdog_enabled() -> bool:
+    return os.environ.get("RAY_TPU_BLOCK_WATCHDOG") == "1"
+
+
+def _block_slack() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_BLOCK_WATCHDOG_SLACK",
+                                    "1.5"))
+    except ValueError:
+        return 1.5
+
+
+# site -> [count, total_s, max_s]; guarded by: _BLOCK_STATS_LOCK
+_BLOCK_STATS: Dict[str, List[float]] = {}
+_BLOCK_STATS_LOCK = threading.Lock()
+
+
+def block_stats() -> Dict[str, Tuple[int, float, float]]:
+    """{site: (count, total_s, max_s)} observed since the last reset."""
+    with _BLOCK_STATS_LOCK:
+        return {k: (int(v[0]), v[1], v[2])
+                for k, v in _BLOCK_STATS.items()}
+
+
+def reset_block_stats() -> None:
+    with _BLOCK_STATS_LOCK:
+        _BLOCK_STATS.clear()
+
+
+class bounded_block:
+    """Context manager wrapping one declared-bounded blocking site.
+
+    ``with lw.bounded_block("gcs.dedup_wait"): ev.wait(30.0)``
+
+    Zero-cost no-op unless ``RAY_TPU_BLOCK_WATCHDOG=1``.  When enabled:
+    folds the blocked thread under a synthetic ``waiting:block:<site>``
+    frame in the sampling profiler (same namespace as lock waits,
+    DESIGN.md §4o), records the actual duration, and raises
+    :class:`BlockBoundViolation` on exit if the site overran its
+    declared bound times the slack factor.  ``bound=`` overrides the
+    table's default for sites whose timeout is config-driven; the table
+    row is still mandatory (it is the declared ceiling).
+    """
+
+    __slots__ = ("site", "bound", "_t0", "_armed")
+
+    def __init__(self, site: str, bound: float = None):
+        self.site = site
+        self.bound = bound
+        self._armed = block_watchdog_enabled()
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if not self._armed:
+            return self
+        if self.site not in BLOCK_BOUNDS:
+            raise BlockBoundViolation(
+                f"blocking site {self.site!r} is not declared in "
+                f"lock_watchdog.BLOCK_BOUNDS (rtlint: "
+                f"block-bound-undeclared)")
+        import time as _time
+        from ray_tpu.util import profiler as _profiler
+        _profiler.note_lock_wait(f"block:{self.site}")
+        self._t0 = _time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if not self._armed:
+            return False
+        import time as _time
+        from ray_tpu.util import profiler as _profiler
+        waited = _time.monotonic() - self._t0
+        _profiler.clear_lock_wait()
+        with _BLOCK_STATS_LOCK:
+            st = _BLOCK_STATS.setdefault(self.site, [0, 0.0, 0.0])
+            st[0] += 1
+            st[1] += waited
+            st[2] = max(st[2], waited)
+        eff = BLOCK_BOUNDS[self.site] if self.bound is None \
+            else float(self.bound)
+        if waited > eff * _block_slack() and exc_type is None:
+            from ray_tpu._private import flight_recorder
+            if flight_recorder.enabled():
+                flight_recorder.record(
+                    "blockwait",
+                    f"{self.site} {waited:.3f}s > bound {eff:.3f}s")
+            raise BlockBoundViolation(
+                f"declared-bounded site {self.site!r} blocked for "
+                f"{waited:.3f}s, over its declared bound {eff:.3f}s "
+                f"(x{_block_slack()} slack)")
+        return False

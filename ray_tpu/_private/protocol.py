@@ -75,6 +75,9 @@ def serve_accept_loop(listener, should_stop, handle,
     from multiprocessing import AuthenticationError
     while not should_stop():
         try:
+            # rtlint: blocks-ok(parks until a peer dials; shutdown
+            # closes the listener fd, which lands in the except arm and
+            # exits via should_stop — the close IS the deadline)
             conn = listener.accept()
         except (OSError, EOFError, AuthenticationError):
             if should_stop():
@@ -250,6 +253,11 @@ def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
     got = 0
     n = len(view)
     while got < n:
+        # rtlint: blocks-ok(mid-frame read: the sender has already
+        # committed the bulk header, so bytes are in flight; peer death
+        # surfaces as reset/EOF and aborts the pull, and the fetch
+        # leader's coalesce deadline (gcs._pull_remote_local ev.wait
+        # 120s) caps every follower's client-visible wait)
         r = sock.recv_into(view[got:], n - got, socket.MSG_WAITALL)
         if r <= 0:
             raise EOFError("connection closed mid-stream")
@@ -328,10 +336,21 @@ def connect_addr(addr: str, timeout: float | None = None) -> Connection:
 def tunnel_connect(host: str, port: int, target: str) -> Connection:
     """Open a proxied connection to a cluster-local socket via the client
     proxy (single implementation of the {target}→{ok|error} handshake)."""
+    from ray_tpu._private import lock_watchdog as _lw
     conn = connect_tcp(host, port)
     try:
         conn.send({"target": target})
-        resp = conn.recv()
+        # the proxy answers a {target} probe immediately or never (a
+        # wedged head): gate the recv on a declared-bounded poll so the
+        # dial fails fast instead of hanging the caller forever
+        deadline = _lw.BLOCK_BOUNDS["protocol.tunnel_connect.handshake"]
+        with _lw.bounded_block("protocol.tunnel_connect.handshake"):
+            if not conn.poll(deadline):
+                raise ConnectionError(
+                    f"client proxy: no handshake reply in {deadline}s")
+            # rtlint: blocks-ok(poll gate above proved a frame is
+            # buffered; recv drains it without parking)
+            resp = conn.recv()
     except BaseException:
         # a proxy that dies mid-handshake must not leak the dialed conn
         conn.close()
@@ -408,6 +427,11 @@ class RpcChannel:
         with self._lock:
             wire.conn_send(self._conn, msg, self.version)
             while True:
+                # rtlint: blocks-ok(request/reply wait: the server
+                # replies to every rid'd frame (rtlint's replies pass
+                # proves arm totality) or dies, and its death EOFs this
+                # recv; callers needing a tighter deadline run their
+                # own timer and close the channel)
                 resp, _ = wire.conn_recv(self._conn)
                 if resp.get("rid") == rid:
                     break

@@ -48,7 +48,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
-from ray_tpu._private import protocol, rtlog, wire
+from ray_tpu._private import lock_watchdog, protocol, rtlog, wire
 from ray_tpu._private.config import GLOBAL_CONFIG
 
 logger = rtlog.get("raylet")
@@ -213,6 +213,9 @@ class Raylet:
                     return
                 continue
             try:
+                # rtlint: blocks-ok(parks until the head pushes; head
+                # death EOFs the channel and the reconnect loop's
+                # jittered backoff (cap 0.5s) is the re-dial deadline)
                 msg, _ = wire.conn_recv(conn)
             except (EOFError, OSError, wire.WireError):
                 if self._stop.is_set():
@@ -279,7 +282,10 @@ class Raylet:
                         conn.close()
                     except OSError:
                         pass
-                if self._stop.wait(next(delays)):
+                with lock_watchdog.bounded_block(
+                        "raylet.reconnect_backoff"):
+                    stopped = self._stop.wait(next(delays))
+                if stopped:
                     return False
         if not self._stop.is_set():
             logger.error("could not rejoin head; shutting down node")
@@ -410,6 +416,9 @@ class Raylet:
         refcount channel — decided by its first frame."""
         try:
             try:
+                # rtlint: blocks-ok(a dialer writes its attach frame in
+                # the same breath as the dial; one that dies first EOFs
+                # here — worker liveness is the deadline)
                 first = conn.recv()
             except (EOFError, OSError):
                 return
@@ -438,6 +447,9 @@ class Raylet:
                     slot.ctl_conn = conn
         while not self._stop.is_set():
             try:
+                # rtlint: blocks-ok(parks for the worker's lifetime;
+                # worker death EOFs its ctl pipe — the monitored-process
+                # exit IS the deadline, same contract as _worker_loop)
                 conn.recv()
             except (EOFError, OSError):
                 break
@@ -453,6 +465,9 @@ class Raylet:
         oid collapse to a count; the reconcile loop ships the batch."""
         while not self._stop.is_set():
             try:
+                # rtlint: blocks-ok(parks between a local worker's
+                # release oneways; worker death EOFs the pipe and the
+                # reconcile loop settles whatever was already netted)
                 msg = conn.recv()
             except (EOFError, OSError):
                 return
@@ -489,6 +504,9 @@ class Raylet:
         logger.info("worker %s attached", worker_id[:8])
         while not self._stop.is_set():
             try:
+                # rtlint: blocks-ok(parks between a local worker's task
+                # events; worker death EOFs the pipe and the slot is
+                # reaped below — process liveness is the deadline)
                 msg = conn.recv()
             except (EOFError, OSError):
                 break
@@ -612,7 +630,8 @@ class Raylet:
         per task."""
         busy = False
         while not self._stop.is_set():
-            self._done_event.wait(1.0)
+            with lock_watchdog.bounded_block("raylet.done_flush_tick"):
+                self._done_event.wait(1.0)
             if self._stop.is_set():
                 return
             if busy:

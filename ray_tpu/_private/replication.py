@@ -58,7 +58,7 @@ import time
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private import protocol, rtlog, wire
+from ray_tpu._private import lock_watchdog, protocol, rtlog, wire
 
 logger = rtlog.get("replication")
 
@@ -607,7 +607,9 @@ class ReplicationHub:
     def _drain_loop(self) -> None:
         last_hb = 0.0
         while not self._stop.is_set():
-            self._event.wait(timeout=self._hb_period)
+            with lock_watchdog.bounded_block("repl.hub_tick",
+                                             bound=self._hb_period):
+                self._event.wait(timeout=self._hb_period)
             self._event.clear()
             if self._stop.is_set():
                 return
@@ -953,8 +955,15 @@ class StandbyHead:
             saw_frame = False
             while not self._stop.is_set():
                 try:
-                    if not conn.poll(self._timeout):
+                    with lock_watchdog.bounded_block(
+                            "repl.stream_poll", bound=self._timeout):
+                        alive = conn.poll(self._timeout)
+                    if not alive:
                         raise EOFError("replication heartbeat timeout")
+                    # rtlint: blocks-ok(the poll gate above proved a
+                    # frame is buffered — hub heartbeats every
+                    # gcs_repl_heartbeat_s, so self._timeout bounds the
+                    # poll and the recv drains without parking)
                     msg, _ = wire.conn_recv(conn)
                     saw_frame = True
                     self._attach_refused = 0

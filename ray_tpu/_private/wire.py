@@ -290,6 +290,21 @@ REPL_DOWN_KINDS = frozenset({
 })
 REPL_KINDS = REPL_DOWN_KINDS | REPL_UP_KINDS
 
+# ------------------------------------------------------------- data ops
+# Request kinds the data-plane server (``data_plane.DataPlaneServer``)
+# dispatches on (the ``op`` field).  Declared here, next to the control
+# kind tables, so tools/rtlint's protostate pass can assert the
+# ``fetch_stream`` session FSM below and the server's dispatch arms
+# never drift apart.
+DATA_OPS = frozenset({
+    "__proto_hello__",   # data-plane version negotiation (v1+ pullers)
+    "fetch_object",      # legacy size probe (seed protocol)
+    "fetch_chunk",       # legacy request-per-chunk pull (seed protocol)
+    "fetch_stream",      # streamed pull: ack + bulk frames (v1+)
+    "delete_object",     # spool delete (invalidates the fd cache)
+    "stats",             # serve counters (tests / autopilot probes)
+})
+
 # ------------------------------------------------------------ bulk frames
 # Data-plane streaming (``_private/data_plane.py``): after a
 # ``fetch_stream`` request/acknowledge exchange (ordinary control
@@ -336,6 +351,201 @@ def bulk_unpack_header(buf) -> Tuple[int, int]:
 DATA_PROTO_MIN = 0   # request-per-chunk pickled dicts (seed protocol)
 DATA_PROTO_TRACE = 2  # accepts the optional TRACE_FIELD on fetch_stream
 DATA_PROTO_MAX = 2   # fetch_stream + bulk frames + trace field
+
+
+# ------------------------------------------------- session FSMs (§4p)
+# Per-channel session state machines, declared next to the kind tables
+# they constrain.  ``tools/rtlint/protostate.py`` (a) checks every
+# producer and dispatch arm emits/handles only kinds these FSMs allow
+# for its side, and (b) exhaustively explores each FSM across the full
+# old×new version matrix (client max-version × server floor × server
+# max-version) proving no reachable state deadlocks, double-replies,
+# or drops a reply-expected frame.
+#
+# Transition tuples: ``(state, who, kind, min_version, effect, next)``
+#  - who:    "c" = the dialing side, "s" = the serving side, "x" = either
+#  - kind:   a wire kind, or a ``*``-prefixed pseudo-kind (a frame
+#            family or event, not a literal kind string): ``*rpc`` = any
+#            two-way control kind, ``*ref`` = any REF_KINDS oneway,
+#            ``*reply``/``*hello_ok``/``*hello_reject`` = reply frames
+#            (matched by rid, not kind), ``*bulk_*`` = raw binary bulk
+#            frames, ``*eof`` = connection loss/close.
+#  - min_version: the transition exists only at session version >= this
+#            (the version fence: e.g. ``raylet_attach`` at PROTO_RAYLET).
+#  - effect: "request" opens a reply obligation, "reply" settles one,
+#            "oneway" neither, "convert" hands the conn to another
+#            channel (must settle all obligations first), "teardown"
+#            closes the conn (EOF settles obligations by construction —
+#            the peer observes the loss).
+#
+# ``pre_version`` is the wire version of frames before a ``hello``
+# reply pins the negotiated version; channels without a ``hello`` ride
+# a control conn that already negotiated.
+SESSION_FSMS = {
+    # ---- control negotiation + RPC (v1..v5 matrix; ISSUE v2-v5 plus
+    # the v1 floor peers still speak) ---------------------------------
+    "control": {
+        "versions": (PROTO_MIN, PROTO_MAX),
+        "pre_version": PROTO_MIN,
+        "hello": "__proto_hello__",
+        "initial": "start",
+        "finals": ("closed", "converted"),
+        "transitions": (
+            ("start", "c", "__proto_hello__", 1, "request",
+             "hello_wait"),
+            ("hello_wait", "s", "*hello_ok", 1, "reply", "ready"),
+            ("hello_wait", "s", "*hello_reject", 1, "reply", "closed"),
+            # hello-less legacy sessions stay at the floor version
+            ("start", "c", "*rpc", 1, "request", "start_wait"),
+            ("start_wait", "s", "*reply", 1, "reply", "start"),
+            ("start", "c", "*ref", 1, "oneway", "start"),
+            ("ready", "c", "*rpc", 1, "request", "ready_wait"),
+            ("ready_wait", "s", "*reply", 1, "reply", "ready"),
+            ("ready", "c", "*ref", 1, "oneway", "ready"),
+            # channel conversions: the conn leaves the control FSM
+            ("ready", "c", "attach_task_conn", 1, "convert",
+             "converted"),
+            ("ready", "c", "attach_worker_ctl", 1, "convert",
+             "converted"),
+            ("ready", "c", "agent_attach", 1, "convert", "converted"),
+            ("ready", "c", "raylet_attach", PROTO_RAYLET, "convert",
+             "converted"),
+            ("ready", "c", "repl_attach", PROTO_REPL, "convert",
+             "converted"),
+            ("start", "x", "*eof", 1, "teardown", "closed"),
+            ("ready", "x", "*eof", 1, "teardown", "closed"),
+        ),
+    },
+    # ---- raylet lease channel (§4i): pure oneway streams ------------
+    "raylet": {
+        "versions": (PROTO_MIN, PROTO_MAX),
+        "initial": "unattached",
+        # "unattached" is final: at < PROTO_RAYLET the channel simply
+        # never opens (the version fence, byte-identical old traffic)
+        "finals": ("unattached", "closed"),
+        "transitions": (
+            ("unattached", "c", "raylet_attach", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "c", "raylet_done_batch", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "c", "raylet_ref_batch", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "c", "raylet_lease_return", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "c", "raylet_fwd", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "c", "raylet_worker_died", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "c", "raylet_task_blocked", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "c", "raylet_task_unblocked", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "c", "raylet_heartbeat", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "c", "raylet_workers", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "s", "lease_grant", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "s", "lease_revoke", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "s", "worker_ctl", PROTO_RAYLET,
+             "oneway", "attached"),
+            ("attached", "s", "raylet_stop", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("attached", "c", "raylet_detach", PROTO_RAYLET,
+             "oneway", "closed"),
+            # drain: completions/returns still flow after raylet_stop,
+            # and in-flight GCS pushes may race the stop frame
+            ("stopping", "c", "raylet_done_batch", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("stopping", "c", "raylet_ref_batch", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("stopping", "c", "raylet_lease_return", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("stopping", "c", "raylet_fwd", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("stopping", "c", "raylet_worker_died", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("stopping", "c", "raylet_heartbeat", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("stopping", "s", "lease_grant", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("stopping", "s", "lease_revoke", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("stopping", "s", "worker_ctl", PROTO_RAYLET,
+             "oneway", "stopping"),
+            ("stopping", "c", "raylet_detach", PROTO_RAYLET,
+             "oneway", "closed"),
+            ("attached", "x", "*eof", PROTO_RAYLET, "teardown",
+             "closed"),
+            ("stopping", "x", "*eof", PROTO_RAYLET, "teardown",
+             "closed"),
+        ),
+    },
+    # ---- GCS replication stream (§4l): one-way pushes ---------------
+    "repl": {
+        "versions": (PROTO_MIN, PROTO_MAX),
+        "initial": "unattached",
+        "finals": ("unattached", "closed"),
+        "transitions": (
+            ("unattached", "c", "repl_attach", PROTO_REPL,
+             "oneway", "syncing"),
+            # a repl_wal racing the bootstrap snapshot ahead of it is
+            # legal (the standby pre-buffers it; replication.py)
+            ("syncing", "s", "repl_wal", PROTO_REPL,
+             "oneway", "syncing"),
+            ("syncing", "s", "repl_heartbeat", PROTO_REPL,
+             "oneway", "syncing"),
+            ("syncing", "s", "repl_tsdb", PROTO_REPL,
+             "oneway", "syncing"),
+            ("syncing", "s", "repl_snapshot", PROTO_REPL,
+             "oneway", "streaming"),
+            ("streaming", "s", "repl_wal", PROTO_REPL,
+             "oneway", "streaming"),
+            ("streaming", "s", "repl_heartbeat", PROTO_REPL,
+             "oneway", "streaming"),
+            ("streaming", "s", "repl_tsdb", PROTO_REPL,
+             "oneway", "streaming"),
+            ("syncing", "x", "*eof", PROTO_REPL, "teardown", "closed"),
+            ("streaming", "x", "*eof", PROTO_REPL, "teardown",
+             "closed"),
+        ),
+    },
+    # ---- data-plane fetch_stream (DATA_PROTO v0..v2) ----------------
+    "fetch_stream": {
+        "versions": (DATA_PROTO_MIN, DATA_PROTO_MAX),
+        "pre_version": DATA_PROTO_MIN,
+        "hello": "__proto_hello__",
+        "initial": "idle",
+        "finals": ("idle", "closed"),
+        "transitions": (
+            ("idle", "c", "__proto_hello__", 0, "request",
+             "hello_wait"),
+            ("hello_wait", "s", "*hello_ok", 0, "reply", "idle"),
+            # negotiation failure replies {"error"} and KEEPS the conn
+            # serving seed-protocol ops (data_plane._serve)
+            ("hello_wait", "s", "*hello_reject", 0, "reply", "idle"),
+            ("idle", "c", "fetch_object", 0, "request", "req_wait"),
+            ("idle", "c", "fetch_chunk", 0, "request", "req_wait"),
+            ("idle", "c", "delete_object", 0, "request", "req_wait"),
+            ("idle", "c", "stats", 0, "request", "req_wait"),
+            ("req_wait", "s", "*reply", 0, "reply", "idle"),
+            ("idle", "c", "fetch_stream", 1, "request", "stream_wait"),
+            # {size,len} ack opens the bulk-frame phase ...
+            ("stream_wait", "s", "*stream_ack", 1, "reply", "bulk"),
+            # ... unless the payload rode the ack (small-range inline
+            # path) or the request pre-stream missed ({"error"}: the
+            # conn stays pooled, wire.py BULK_ERR contract)
+            ("stream_wait", "s", "*inline_reply", 1, "reply", "idle"),
+            ("stream_wait", "s", "*miss_reply", 1, "reply", "idle"),
+            ("bulk", "s", "*bulk_data", 1, "oneway", "bulk"),
+            ("bulk", "s", "*bulk_end", 1, "oneway", "idle"),
+            ("bulk", "s", "*bulk_err", 1, "oneway", "idle"),
+            ("idle", "x", "*eof", 0, "teardown", "closed"),
+            ("bulk", "x", "*eof", 0, "teardown", "closed"),
+        ),
+    },
+}
 
 _c_codec = None
 _c_codec_tried = False

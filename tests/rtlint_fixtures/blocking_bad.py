@@ -1,0 +1,70 @@
+"""Positive fixture for tools/rtlint/blocking.py — every rule fires.
+
+tests/test_rtlint.py builds a BlockingConfig scoped to THIS file (the
+declaration parsing helpers read the REACTOR_SAFE / BLOCK_BOUNDS
+literals below) and asserts the findings:
+
+- block-reactor      codec() reaches a sleep through _helper();
+                     missing_fn doesn't resolve (stale declaration)
+- block-hot-arm      Server._handle_hot waits on an Event (bounded or
+                     not, a wait is not a leaf-lock acquisition)
+- block-unbounded    Server._serve recv()s with no timeout and no
+                     waiver
+- block-bound-undeclared  a bounded_block site not in BLOCK_BOUNDS
+- block-bound-dead   BLOCK_BOUNDS row with no bounded_block call site
+"""
+
+import threading
+import time
+
+REACTOR_SAFE = {
+    "blocking_bad.codec",
+    "blocking_bad.missing_fn",
+}
+
+BLOCK_BOUNDS = {
+    "fixture.used": 1.0,
+    "fixture.dead": 5.0,
+}
+
+
+class bounded_block:
+    def __init__(self, site, bound=None):
+        self.site = site
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def codec(payload):
+    return _helper(payload)
+
+
+def _helper(payload):
+    time.sleep(0.1)
+    return payload
+
+
+class Server:
+    def _handle_hot(self, msg):
+        ev = threading.Event()
+        ev.wait(1.0)
+        return {}
+
+    def _serve(self, conn):
+        while True:
+            msg = conn.recv()
+            self._handle_hot(msg)
+
+
+def declared_site(ev):
+    with bounded_block("fixture.used"):
+        ev.wait(1.0)
+
+
+def undeclared_site(ev):
+    with bounded_block("fixture.undeclared"):
+        ev.wait(1.0)

@@ -1,0 +1,59 @@
+"""Negative fixture for tools/rtlint/blocking.py — same shapes as
+blocking_bad.py made legal: the reactor-safe codec is pure, the hot
+arm only sends, the serve loop's blocking calls carry bounded timeouts
+or a block-comment waiver citing the bounding deadline, and every
+BLOCK_BOUNDS row has exactly one bounded_block site.  Must produce
+ZERO active findings under the matching BlockingConfig.
+"""
+
+REACTOR_SAFE = {
+    "blocking_ok.codec",
+}
+
+BLOCK_BOUNDS = {
+    "fixture.tick": 1.0,
+}
+
+
+class bounded_block:
+    def __init__(self, site, bound=None):
+        self.site = site
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def codec(payload):
+    return _helper(payload)
+
+
+def _helper(payload):
+    return bytes(payload)
+
+
+class Server:
+    def _handle_hot(self, msg, conn):
+        conn.send({"ok": True})
+        return {}
+
+    def _serve(self, conn, work_q, stop):
+        while not stop.is_set():
+            try:
+                item = work_q.get(timeout=1.0)
+            except Exception:
+                continue
+            # rtlint: blocks-ok(fixture: parks between a peer's frames;
+            # peer death EOFs the conn — liveness is the deadline, and
+            # this reason intentionally spans several comment lines to
+            # exercise the block-comment waiver form)
+            msg = conn.recv()
+            self._handle_hot(msg, conn)
+            del item
+
+
+def declared_site(ev):
+    with bounded_block("fixture.tick"):
+        ev.wait(1.0)

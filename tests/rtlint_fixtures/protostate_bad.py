@@ -1,0 +1,53 @@
+"""Positive fixture for tools/rtlint/protostate.py — every rule fires.
+
+The "demo" channel seeds the two defects the acceptance criteria name
+plus one of each remaining rule:
+
+- proto-deadlock     "stuck" has no outgoing transitions
+- proto-reply-drop   version skew: at negotiated v1 the "ping" reply
+                     needs v2, so the only exit from "waiting"
+                     converts away with the request still pending
+- proto-double-reply "start" enables a reply with nothing outstanding
+- proto-unreachable  "ghost" is never entered
+- proto-drift        "orphan" is in DEMO_KINDS but not the FSM;
+                     "rogue" is in the FSM but not DEMO_KINDS
+- proto-arm-illegal  Client dispatches "ping", a kind only the client
+                     side sends
+- proto-producer-illegal  Server produces "ping" for the same reason
+"""
+
+DEMO_KINDS = frozenset({
+    "ping",
+    "bye",
+    "go",
+    "orphan",
+})
+
+SESSION_FSMS = {
+    "demo": {
+        "versions": (1, 2),
+        "initial": "start",
+        "finals": ("done",),
+        "transitions": (
+            ("start", "c", "ping", 1, "request", "waiting"),
+            ("waiting", "s", "*reply", 2, "reply", "start"),
+            ("waiting", "c", "bye", 1, "convert", "done"),
+            ("start", "s", "*reply", 1, "reply", "start"),
+            ("start", "c", "go", 1, "request", "stuck"),
+            ("ghost", "c", "rogue", 1, "oneway", "start"),
+        ),
+    },
+}
+
+
+class Client:
+    def handle(self, msg):
+        kind = msg.get("kind")
+        if kind == "ping":
+            return {"ok": True}
+        return None
+
+
+class Server:
+    def push(self, conn):
+        conn.send({"kind": "ping", "rid": None})

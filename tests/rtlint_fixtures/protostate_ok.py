@@ -1,0 +1,45 @@
+"""Negative fixture for tools/rtlint/protostate.py — a clean channel.
+
+Request/reply at the floor version, a v2-only server push, teardown
+from every live state, table and FSM in lockstep, and both sides
+speaking only what the FSM grants them.  Must produce ZERO findings
+under the matching ProtoConfig.
+"""
+
+OK_KINDS = frozenset({
+    "ping",
+    "pong_push",
+})
+
+SESSION_FSMS = {
+    "demo": {
+        "versions": (1, 2),
+        "initial": "start",
+        "finals": ("closed",),
+        "transitions": (
+            ("start", "c", "ping", 1, "request", "waiting"),
+            ("waiting", "s", "*reply", 1, "reply", "start"),
+            ("start", "s", "pong_push", 2, "oneway", "start"),
+            ("start", "x", "*eof", 1, "teardown", "closed"),
+            ("waiting", "x", "*eof", 1, "teardown", "closed"),
+        ),
+    },
+}
+
+
+class Client:
+    def handle(self, msg):
+        kind = msg.get("kind")
+        if kind == "pong_push":
+            return None
+        return None
+
+
+class Server:
+    def handle(self, conn, msg):
+        kind = msg.get("kind")
+        if kind == "ping":
+            conn.send({"rid": msg.get("rid"), "error": None})
+
+    def push(self, conn):
+        conn.send({"kind": "pong_push", "rid": None})

@@ -1,0 +1,137 @@
+"""RAY_TPU_BLOCK_WATCHDOG — the runtime oracle for the §4p blocking
+bounds (tools/rtlint/blocking.py is the static half).
+
+Unit layer: ``bounded_block`` is a no-op when disabled, folds the
+blocked thread under the profiler's ``waiting:block:<site>`` namespace
+when enabled, records per-site stats, and raises
+:class:`BlockBoundViolation` when a declared-bounded site overruns its
+bound × slack.  Integration layer: a chaos-style workload with a
+SIGKILLed worker completes under the watchdog with every observed
+block inside its declared bound.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import lock_watchdog as lw
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats():
+    lw.reset_block_stats()
+    yield
+    lw.reset_block_stats()
+
+
+def test_disabled_is_a_noop(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_BLOCK_WATCHDOG", raising=False)
+    with lw.bounded_block("not.even.declared"):
+        time.sleep(0.01)
+    assert lw.block_stats() == {}
+
+
+def test_enabled_records_stats_and_profiler_frame(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_BLOCK_WATCHDOG", "1")
+    from ray_tpu.util import profiler
+    with lw.bounded_block("gcs.dedup_wait"):
+        assert profiler._WAITING[threading.get_ident()] == \
+            "block:gcs.dedup_wait"
+        time.sleep(0.01)
+    assert threading.get_ident() not in profiler._WAITING
+    count, total, worst = lw.block_stats()["gcs.dedup_wait"]
+    assert count == 1
+    assert total >= 0.01
+    assert worst >= 0.01
+
+
+def test_overrun_raises(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_BLOCK_WATCHDOG", "1")
+    with pytest.raises(lw.BlockBoundViolation, match="gcs.dedup_wait"):
+        with lw.bounded_block("gcs.dedup_wait", bound=0.01):
+            time.sleep(0.05)
+    # the overrun is still recorded — post-mortems see the real wait
+    assert lw.block_stats()["gcs.dedup_wait"][2] >= 0.05
+
+
+def test_undeclared_site_raises(monkeypatch):
+    """The runtime oracle enforces the same identity as the static
+    block-bound-undeclared rule: a wrapped site MUST have a
+    BLOCK_BOUNDS row."""
+    monkeypatch.setenv("RAY_TPU_BLOCK_WATCHDOG", "1")
+    with pytest.raises(lw.BlockBoundViolation, match="not declared"):
+        with lw.bounded_block("no.such.site"):
+            pass
+
+
+def test_exception_in_flight_suppresses_the_overrun(monkeypatch):
+    """An overrun concurrent with a real failure must not mask it."""
+    monkeypatch.setenv("RAY_TPU_BLOCK_WATCHDOG", "1")
+    with pytest.raises(ValueError):
+        with lw.bounded_block("gcs.dedup_wait", bound=0.01):
+            time.sleep(0.05)
+            raise ValueError("the real failure")
+
+
+def test_slack_env_is_honored(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_BLOCK_WATCHDOG", "1")
+    monkeypatch.setenv("RAY_TPU_BLOCK_WATCHDOG_SLACK", "20")
+    # 0.05s wait over a 0.01s bound survives under 20x slack
+    with lw.bounded_block("gcs.dedup_wait", bound=0.01):
+        time.sleep(0.05)
+
+
+def test_bounds_table_matches_static_config():
+    """Static-DAG == watchdog identity, extended to blocking bounds:
+    the blocking pass parses the SAME declarations the runtime oracle
+    enforces, so neither can drift."""
+    from tools.rtlint.blocking import default_config
+    from tools.rtlint import REPO_ROOT
+    cfg = default_config(REPO_ROOT)
+    assert set(cfg.bounds) == set(lw.BLOCK_BOUNDS)
+    assert set(cfg.reactor_safe) == set(lw.REACTOR_SAFE)
+
+
+def test_chaos_workload_under_block_watchdog(monkeypatch,
+                                             ray_start_regular_env):
+    """Chaos run under the blocking oracle: worker SIGKILL mid-workload
+    with RAY_TPU_BLOCK_WATCHDOG=1 — the cluster heals, no declared-
+    bounded site overruns (a BlockBoundViolation in any daemon thread
+    would fail the workload), and every recorded block sits inside its
+    declared bound × slack."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(max_retries=-1)
+    def work(i):
+        time.sleep(0.02)
+        return i * 2
+
+    assert ray_tpu.get([work.remote(i) for i in range(8)],
+                       timeout=120) == [i * 2 for i in range(8)]
+    victims = [w for w in state.list_workers()
+               if w["state"] in ("busy", "actor", "idle")
+               and w["pid"] != os.getpid()]
+    assert victims, "no worker to kill"
+    os.kill(victims[0]["pid"], signal.SIGKILL)
+    assert ray_tpu.get([work.remote(i) for i in range(8)],
+                       timeout=120) == [i * 2 for i in range(8)]
+    slack = 1.5
+    for site, (count, _total, worst) in lw.block_stats().items():
+        bound = lw.BLOCK_BOUNDS[site]
+        assert worst <= bound * slack, \
+            f"{site} blocked {worst:.3f}s over declared {bound}s"
+
+
+@pytest.fixture
+def ray_start_regular_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_BLOCK_WATCHDOG", "1")
+    import ray_tpu
+    ray_tpu.init(num_cpus=2)
+    try:
+        yield
+    finally:
+        ray_tpu.shutdown()

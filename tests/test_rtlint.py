@@ -370,6 +370,10 @@ def test_list_rules_catalog_matches_passes():
     emitted = _rules(check_resources([load(FIX / "resources_bad.py")]))
     emitted |= _rules(check_replies(_reply_specs("bad"), ROOT))
     emitted |= _rules(_check_side_channel(load(FIX / "replies_bad.py")))
+    from tools.rtlint.blocking import check_blocking as _cb
+    from tools.rtlint.protostate import check_protostate as _cp
+    emitted |= _rules(_cb(_blocking_cfg("bad")))
+    emitted |= _rules(_cp(_proto_cfg("bad")))
     assert emitted <= catalog, emitted - catalog
 
 
@@ -667,3 +671,168 @@ def test_replication_wire_kinds_checked():
     finally:
         import shutil
         shutil.rmtree(tmpdir)
+
+
+# ------------------------------------------------- blocking flow (§4p)
+from tools.rtlint.blocking import BlockingConfig, _decl_lines_dict, \
+    _decl_lines_set, check_blocking  # noqa: E402
+from tools.rtlint.blocking import \
+    default_config as blocking_config  # noqa: E402
+
+
+def _blocking_cfg(tag: str) -> BlockingConfig:
+    rel = f"tests/rtlint_fixtures/blocking_{tag}.py"
+    sf = load(FIX / f"blocking_{tag}.py")
+    return BlockingConfig(
+        paths=[FIX / f"blocking_{tag}.py"],
+        reactor_safe=_decl_lines_set(sf, "REACTOR_SAFE"),
+        reactor_decl_rel=rel,
+        hot_contexts=[f"blocking_{tag}:Server._handle_hot"],
+        serve_loops=[f"blocking_{tag}:Server._serve"],
+        bounded_modules=set(),
+        bounds=_decl_lines_dict(sf, "BLOCK_BOUNDS"),
+        bounds_decl_rel=rel)
+
+
+def test_blocking_flags_positive_fixture():
+    found = check_blocking(_blocking_cfg("bad"))
+    assert _rules(found) == {
+        "block-reactor", "block-hot-arm", "block-unbounded",
+        "block-bound-undeclared", "block-bound-dead"}, found
+    # the reactor finding carries the interprocedural witness chain
+    reactor = [f for f in found if f.rule == "block-reactor"
+               and "may block" in f.message]
+    assert reactor and "_helper" in reactor[0].message, found
+    # the stale declaration is the other reactor finding
+    assert any("missing_fn" in f.message for f in found
+               if f.rule == "block-reactor"), found
+    assert any("fixture.dead" in f.message for f in found
+               if f.rule == "block-bound-dead"), found
+
+
+def test_blocking_silent_on_negative_fixture_with_waiver():
+    found = _active(check_blocking(_blocking_cfg("ok")))
+    assert found == [], found
+
+
+def test_blocking_family_waiver_covers_block_rules():
+    """`# rtlint: blocks-ok(reason)` — including the multi-line
+    block-comment form — silences any block-* rule on the next
+    statement line."""
+    sf = load(FIX / "blocking_ok.py")
+    src = sf.text.splitlines()
+    recv_line = next(i for i, l in enumerate(src, 1)
+                     if "conn.recv()" in l)
+    assert sf.waived(recv_line, "block-unbounded")
+    assert sf.waived(recv_line, "block-hot-arm")
+    assert not sf.waived(recv_line, "lock-order")
+
+
+def test_blocking_real_tree_contexts_resolve():
+    """The configured hot arms / serve loops exist in the real tree —
+    a renamed handler must fail here, not silently drop coverage."""
+    from tools.rtlint.blocking import CallGraph
+    cfg = blocking_config(ROOT)
+    graph = CallGraph()
+    for p in cfg.paths:
+        if p.exists():
+            graph.add_file(load(p), p.stem)
+    for qual in cfg.hot_contexts + cfg.serve_loops:
+        assert qual in graph.funcs, f"configured context {qual} missing"
+
+
+# -------------------------------------------- protocol sessions (§4p)
+from tools.rtlint.protostate import ChannelSpec, ProtoConfig, \
+    SideSpec, check_protostate, explore_channel, load_fsms  # noqa: E402
+from tools.rtlint.protostate import \
+    default_config as proto_config  # noqa: E402
+
+
+def _proto_cfg(tag: str) -> ProtoConfig:
+    rel = f"tests/rtlint_fixtures/protostate_{tag}.py"
+    tables = ("DEMO_KINDS",) if tag == "bad" else ("OK_KINDS",)
+    return ProtoConfig(
+        fsm_path=FIX / f"protostate_{tag}.py",
+        channels={"demo": ChannelSpec(
+            tables=tables,
+            sides=(SideSpec(rel, "Client", "c"),
+                   SideSpec(rel, "Server", "s")))})
+
+
+def test_protostate_flags_positive_fixture():
+    found = check_protostate(_proto_cfg("bad"))
+    assert _rules(found) == {
+        "proto-deadlock", "proto-reply-drop", "proto-double-reply",
+        "proto-unreachable", "proto-drift", "proto-arm-illegal",
+        "proto-producer-illegal"}, found
+    assert any(f.rule == "proto-deadlock" and "stuck" in f.message
+               for f in found), found
+    # the version-skew drop: the v1 session can only convert away with
+    # the ping still pending (its reply needs v2)
+    assert any(f.rule == "proto-reply-drop" and "ping" in f.message
+               for f in found), found
+
+
+def test_protostate_silent_on_negative_fixture():
+    found = check_protostate(_proto_cfg("ok"))
+    assert found == [], found
+
+
+def test_real_session_fsms_deadlock_free():
+    """The acceptance bar: product-FSM exploration proves all four
+    channels deadlock-free across the full old x new version matrix."""
+    sf = load(ROOT / "ray_tpu" / "_private" / "wire.py")
+    fsms, lines = load_fsms(sf)
+    assert set(fsms) == {"control", "raylet", "repl", "fetch_stream"}
+    for chan, fsm in fsms.items():
+        found = explore_channel(chan, fsm, sf.rel, lines[chan])
+        assert found == [], f"channel {chan}:\n" + \
+            "\n".join(f.render() for f in found)
+
+
+def test_seeded_fsm_deadlock_is_caught():
+    """Removing the drain state's exits (the scratch edit from the
+    acceptance criteria) wedges the raylet channel and the explorer
+    says so."""
+    sf = load(ROOT / "ray_tpu" / "_private" / "wire.py")
+    fsms, _ = load_fsms(sf)
+    fsm = dict(fsms["raylet"])
+    fsm["transitions"] = tuple(
+        t for t in fsm["transitions"] if t[0] != "stopping")
+    found = explore_channel("raylet", fsm, "wire.py", 1)
+    assert any(f.rule == "proto-deadlock" and "stopping" in f.message
+               for f in found), found
+
+
+def test_seeded_version_skew_drop_is_caught():
+    """Raising the control reply's version floor above the session's
+    negotiated version (old client x new server) strands the pending
+    rpc: its only exit converts the channel away and the explorer
+    flags the dropped reply at the skewed combination."""
+    from ray_tpu._private import wire
+    sf = load(ROOT / "ray_tpu" / "_private" / "wire.py")
+    fsms, _ = load_fsms(sf)
+    fsm = dict(fsms["control"])
+    seeded = []
+    for t in fsm["transitions"]:
+        if t[0] == "ready_wait" and t[2] == "*reply":
+            t = ("ready_wait", "s", "*reply", wire.PROTO_REPL,
+                 "reply", "ready")
+        seeded.append(t)
+    seeded.append(("ready_wait", "c", "attach_task_conn", 1,
+                   "convert", "converted"))
+    fsm["transitions"] = tuple(seeded)
+    found = explore_channel("control", fsm, "wire.py", 1)
+    drops = [f for f in found if f.rule == "proto-reply-drop"]
+    assert drops, found
+    assert any("cmax=1" in f.message for f in drops), drops
+
+
+def test_proto_config_channels_match_fsm_declarations():
+    """Every configured channel has an FSM and vice versa — adding a
+    channel to wire.py without wiring its conformance scan (or the
+    reverse) fails here."""
+    cfg = proto_config(ROOT)
+    sf = load(cfg.fsm_path)
+    fsms, _ = load_fsms(sf)
+    assert set(cfg.channels) == set(fsms)

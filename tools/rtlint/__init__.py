@@ -20,6 +20,11 @@ Waiver syntax (checked on the finding's line, or a pure-comment line
 directly above it): ``# rtlint: <rule>-ok(<reason>)``, e.g.
 ``# rtlint: unguarded-ok(init-only, published before threads start)``.
 The reason is mandatory — an empty waiver does not silence the finding.
+A reason may span several comment lines: a waiver opening inside a
+pure-comment block covers the whole block plus the first statement
+after it (long reasons — e.g. the deadline citation the blocking pass
+demands — should not have to fit one line).  ``blocks-ok`` is a family
+waiver covering every ``block-*`` rule on the line.
 
 Driver: ``python -m tools.rtlint`` (wired into ``make rtlint`` /
 ``make lint`` / CI).  Fixture corpus: ``tests/rtlint_fixtures/``,
@@ -35,7 +40,16 @@ from typing import Dict, List, NamedTuple
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
-_WAIVER_RE = re.compile(r"#\s*rtlint:\s*([a-z][a-z0-9-]*)-ok\(([^)]+)\)")
+_WAIVER_OPEN_RE = re.compile(r"#\s*rtlint:\s*([a-z][a-z0-9-]*)-ok\(")
+
+
+def _nonempty_reason(line: str, pos: int) -> bool:
+    """True iff the waiver's reason has content — at least one
+    non-space, non-``)`` character after the opening paren (a reason
+    continuing on the next comment line satisfies the pass because the
+    opening line then ends without the close paren)."""
+    rest = line[pos:]
+    return bool(rest.strip(" \t)")) or ")" not in rest
 
 
 class Finding(NamedTuple):
@@ -58,20 +72,40 @@ class SourceFile:
         self.text = path.read_text()
         self.lines = self.text.splitlines()
         self.tree = ast.parse(self.text, filename=str(path))
-        # line number -> set of waived rule ids (a waiver on a pure
-        # comment line also covers the next line, for long statements)
+        # line number -> set of waived rule ids.  A trailing-comment
+        # waiver covers its own line; one inside a pure-comment block
+        # covers the block AND the first statement line after it, so a
+        # reason can span several comment lines.
         self.waivers: Dict[int, set] = {}
-        for i, line in enumerate(self.lines, 1):
-            rules = {m.group(1) for m in _WAIVER_RE.finditer(line)
-                     if m.group(2).strip()}
+        n = len(self.lines)
+        i = 0
+        while i < n:
+            line = self.lines[i]
+            rules = {m.group(1) for m in _WAIVER_OPEN_RE.finditer(line)
+                     if _nonempty_reason(line, m.end())}
             if not rules:
+                i += 1
                 continue
-            self.waivers.setdefault(i, set()).update(rules)
-            if line.lstrip().startswith("#"):
+            if not line.lstrip().startswith("#"):
                 self.waivers.setdefault(i + 1, set()).update(rules)
+                i += 1
+                continue
+            j = i
+            while j + 1 < n and self.lines[j + 1].lstrip().startswith("#"):
+                j += 1
+            for k in range(i + 1, j + 3):  # block lines + next statement
+                self.waivers.setdefault(k, set()).update(rules)
+            i = j + 1
 
     def waived(self, line: int, rule: str) -> bool:
-        return rule in self.waivers.get(line, ())
+        rules = self.waivers.get(line, ())
+        if rule in rules:
+            return True
+        # family waiver for the blocking pass (DESIGN.md §4p):
+        # ``# rtlint: blocks-ok(<reason>)`` silences every ``block-*``
+        # rule on the line — a blocking site that is policy-reviewed is
+        # reviewed for all blocking rules at once.
+        return rule.startswith("block-") and "blocks" in rules
 
 
 def load(path) -> SourceFile:

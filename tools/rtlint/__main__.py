@@ -1,22 +1,55 @@
 """Driver: ``python -m tools.rtlint [--pass NAME ...] [--show-waived]
-[--list-rules]``.
+[--list-rules] [--sarif OUT] [--changed-only]``.
 
-Runs the seven passes over the real tree (see each pass module for
+Runs the nine passes over the real tree (see each pass module for
 what it enforces), prints ``file:line rule-id message`` per finding,
 and exits non-zero when any unwaived finding remains.
+
+``--sarif OUT`` additionally writes the active findings as SARIF
+2.1.0 (CI uploads it so findings annotate PR diffs).
+
+``--changed-only`` scopes the run to the git-changed file set: passes
+whose input files are untouched are skipped, and the per-file
+``threads`` pass runs only on the changed files.  Interprocedural
+passes (everything else) still run over their FULL input set when any
+input changed — their call-graph/whole-tree summaries are stale the
+moment one file moves, so partial re-analysis would be unsound.  When
+git is unavailable, or the analyzer itself changed, it falls back to
+the full tree.
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
+import time
 from pathlib import Path
-from typing import Dict, List
+from typing import Dict, List, Optional, Set
 
 from tools.rtlint import REPO_ROOT, Finding, SourceFile, load
 
 PASSES = ("locks", "guarded", "wire", "threads", "metrics",
-          "resources", "replies")
+          "resources", "replies", "blocking", "protostate")
+
+# --changed-only: repo-relative prefixes that feed each pass.  A pass
+# runs iff some changed path starts with one of its prefixes (the
+# interprocedural passes then run over their FULL input set — stale
+# summaries make partial re-analysis unsound).
+PASS_SCOPES: Dict[str, tuple] = {
+    "locks": ("ray_tpu/_private/", "ray_tpu/elastic/",
+              "ray_tpu/util/", "ray_tpu/serve/"),
+    "guarded": ("ray_tpu/_private/", "ray_tpu/elastic/",
+                "ray_tpu/util/", "ray_tpu/serve/"),
+    "wire": ("ray_tpu/", "tests/"),
+    "threads": ("ray_tpu/",),
+    "metrics": ("ray_tpu/", "tools/"),
+    "resources": ("ray_tpu/",),
+    "replies": ("ray_tpu/_private/",),
+    "blocking": ("ray_tpu/_private/", "ray_tpu/serve/",
+                 "ray_tpu/elastic/"),
+    "protostate": ("ray_tpu/_private/",),
+}
 
 # pass -> (rule id, one-line contract) — the --list-rules catalog
 RULES: Dict[str, List] = {
@@ -70,6 +103,40 @@ RULES: Dict[str, List] = {
         ("reply-swallow", "serve pumps never swallow a dispatch "
                           "failure and keep looping (reply, re-raise, "
                           "or tear the conn down)"),
+    ],
+    "blocking": [
+        ("block-reactor", "REACTOR_SAFE functions are transitively "
+                          "non-blocking over the in-repo call graph"),
+        ("block-hot-arm", "GCS _HOT_KINDS arms and raylet/data-plane "
+                          "push loops block only on leaf locks, local "
+                          "sends, and spool I/O"),
+        ("block-unbounded", "blocking calls in serve loops and the "
+                            "session-layer files carry a bounded "
+                            "timeout (timeout=None / missing timeout "
+                            "is a finding)"),
+        ("block-bound-undeclared", "every bounded_block site has a "
+                                   "declared bound in "
+                                   "lock_watchdog.BLOCK_BOUNDS"),
+        ("block-bound-dead", "no BLOCK_BOUNDS entry without a live "
+                             "bounded_block site (static == runtime "
+                             "oracle identity)"),
+    ],
+    "protostate": [
+        ("proto-drift", "session FSM kinds == the wire kind tables, "
+                        "both directions"),
+        ("proto-arm-illegal", "no dispatch arm for a channel kind the "
+                              "FSM says that side never receives"),
+        ("proto-producer-illegal", "no producer for a channel kind "
+                                   "the FSM says that side never "
+                                   "sends"),
+        ("proto-deadlock", "no reachable state wedges at any "
+                           "old x new version combination"),
+        ("proto-double-reply", "no reply transition fires without an "
+                               "outstanding request"),
+        ("proto-reply-drop", "no final state / channel conversion "
+                             "drops an unsettled reply obligation"),
+        ("proto-unreachable", "every declared FSM state is reachable "
+                              "somewhere in the version matrix"),
     ],
 }
 
@@ -159,7 +226,52 @@ def run_pass(name: str) -> List[Finding]:
     if name == "replies":
         from tools.rtlint.replies import default_check
         return default_check(REPO_ROOT)
+    if name == "blocking":
+        from tools.rtlint.blocking import default_check
+        return default_check(REPO_ROOT)
+    if name == "protostate":
+        from tools.rtlint.protostate import default_check
+        return default_check(REPO_ROOT)
     raise SystemExit(f"unknown pass {name!r}")
+
+
+def changed_paths() -> Optional[Set[str]]:
+    """Repo-relative changed paths (vs HEAD, plus untracked), or None
+    when git state is unavailable (full-tree fallback)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+            check=True, timeout=10).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+            check=True, timeout=10).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return {ln.strip() for ln in (diff + untracked).splitlines()
+            if ln.strip()}
+
+
+def scope_passes(selected: List[str], changed: Optional[Set[str]]):
+    """(passes to run, threads file subset or None, reason)."""
+    if changed is None:
+        return selected, None, "full tree (git unavailable)"
+    if any(c.startswith("tools/rtlint") for c in changed):
+        # the analyzer itself changed: every summary is stale
+        return selected, None, "full tree (analyzer changed)"
+    keep = []
+    for name in selected:
+        prefixes = PASS_SCOPES.get(name, ("",))
+        if any(c.startswith(prefixes) for c in changed):
+            keep.append(name)
+    thread_files = None
+    if "threads" in keep:
+        thread_files = sorted(
+            REPO_ROOT / c for c in changed
+            if c.startswith("ray_tpu/") and c.endswith(".py")
+            and (REPO_ROOT / c).exists())
+    return keep, thread_files, f"{len(changed)} changed file(s)"
 
 
 def filter_waived(findings: List[Finding]):
@@ -191,30 +303,51 @@ def main(argv=None) -> int:
                     help="also print findings silenced by waivers")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--sarif", metavar="OUT",
+                    help="also write active findings as SARIF 2.1.0")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scope to git-changed files (skip passes "
+                         "whose inputs are untouched; falls back to "
+                         "the full tree when summaries are stale)")
     args = ap.parse_args(argv)
     if args.list_rules:
         for pname in args.passes or PASSES:
             for rule, contract in RULES[pname]:
-                print(f"{pname:<10} {rule:<20} {contract}")
+                print(f"{pname:<10} {rule:<24} {contract}")
         return 0
     if str(REPO_ROOT) not in sys.path:
         sys.path.insert(0, str(REPO_ROOT))
     selected = args.passes or list(PASSES)
+    thread_files = None
+    if args.changed_only:
+        selected, thread_files, why = scope_passes(selected,
+                                                   changed_paths())
+        print(f"rtlint: --changed-only: {why}; running "
+              f"{', '.join(selected) or 'nothing'}")
     all_findings: List[Finding] = []
     counts = {}
+    t0 = time.monotonic()
     for name in selected:
-        found = run_pass(name)
+        if name == "threads" and thread_files is not None:
+            from tools.rtlint.threads import check_threads
+            found = check_threads(thread_files)
+        else:
+            found = run_pass(name)
         counts[name] = len(found)
         all_findings.extend(found)
+    elapsed = time.monotonic() - t0
     active, waived = filter_waived(all_findings)
     for f in sorted(active):
         print(f.render())
     if args.show_waived:
         for f in sorted(waived):
             print(f"[waived] {f.render()}")
+    if args.sarif:
+        from tools.rtlint.sarif import write_sarif
+        write_sarif(args.sarif, active, RULES)
     summary = ", ".join(f"{n}:{counts[n]}" for n in selected)
     print(f"rtlint: {len(active)} finding(s), {len(waived)} waived "
-          f"({summary})")
+          f"({summary}) in {elapsed:.2f}s")
     return 1 if active else 0
 
 

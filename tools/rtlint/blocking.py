@@ -1,0 +1,604 @@
+"""Pass 8: interprocedural blocking-flow analysis (DESIGN.md §4p).
+
+Builds a **may-block summary** for every function across
+``ray_tpu/_private/`` + ``serve/`` + ``elastic/`` to a fixed point over
+the in-repo call graph, then enforces per-context policies.  Blocking
+primitives (classified by call shape, receiver-insensitive):
+
+========== =========================================================
+class      call shapes
+========== =========================================================
+sleep      ``time.sleep`` / any ``.sleep``
+recv       ``recv`` / ``recv_bytes`` / ``recv_into`` / ``recvfrom`` /
+           ``wire.conn_recv`` / ``conn_recv_ex`` / ``recv_exact_into``
+send       ``send`` / ``sendall`` / ``send_bytes`` / ``sendto`` /
+           ``send_msg_writev`` / ``write_all`` / ``writev``
+accept     ``.accept``
+wait       ``Event/Condition.wait`` / ``wait_for`` / ``Popen.wait``
+join       ``Thread.join`` (str.join is filtered by argument shape)
+queue      ``.get`` on a queue-shaped receiver
+future     ``.result``
+poll       ``.poll(t)`` / ``select`` (``poll()`` with no args is an
+           instant readiness probe, not a block)
+io         ``fsync`` / ``sendfile`` / ``os.pread`` / ``open`` /
+           ``read`` / ``write`` / ``readline`` / ``readinto``
+subprocess ``communicate`` / ``check_call`` / ``check_output`` /
+           ``subprocess.run``
+dial       ``protocol.connect*`` / ``Client`` / ``create_connection``
+========== =========================================================
+
+Per-context policies (the contexts and their allowed classes are the
+tables below; the REACTOR_SAFE set and BLOCK_BOUNDS table live in
+``lock_watchdog.py`` next to the lock DAGs):
+
+- ``block-reactor``: every function in ``lock_watchdog.REACTOR_SAFE``
+  must be TRANSITIVELY non-blocking — no class is allowed, not even
+  bounded sends.  Findings anchor on the declaring line in
+  ``lock_watchdog.py`` (grandfathering is an explicit decl-line
+  waiver), so the item-1 reactor lands on a statically proven core.
+- ``block-hot-arm``: GCS ``_HOT_KINDS`` dispatch arms (``_h_<kind>``)
+  and the raylet/data-plane push loops may block only on declared
+  leaf-lock acquisitions plus bounded local sends and spool file I/O
+  (§4c documents pushes riding conn locks).  Reaching ``sleep`` /
+  ``recv`` / ``wait`` / ``join`` / ``queue`` / ``future`` / ``accept``
+  / ``poll`` / ``dial`` / ``subprocess`` is a finding, anchored at the
+  blocking SITE with the call chain in the message (one waiver at the
+  site covers every arm that reaches it).
+- ``block-unbounded``: every *direct* blocking call of an unbounded
+  family (``recv``/``wait``/``join``/``get``/``result``/``accept``/
+  ``poll(None)``) inside a serve-loop function, or anywhere in the
+  session-layer files (``protocol.py``, ``raylet.py``,
+  ``replication.py``), must carry a bounded timeout — a literal
+  ``timeout=None`` or a missing timeout is a finding.
+- ``block-bound-undeclared`` / ``block-bound-dead``: the
+  ``lock_watchdog.bounded_block("<site>")`` call sites and the
+  ``BLOCK_BOUNDS`` table must agree exactly, so the
+  ``RAY_TPU_BLOCK_WATCHDOG=1`` runtime oracle checks precisely the
+  statically declared contract (same identity discipline as the lock
+  DAGs).
+
+Waivers: the family form ``# rtlint: blocks-ok(<reason>)`` silences
+any ``block-*`` rule on the line; per-rule forms work too.  Reasons
+must cite the deadline that actually bounds the wait (reconnect
+deadline, heartbeat timeout, peer-death EOF, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from tools.rtlint import Finding, SourceFile, dotted_name, load
+
+# blocking classes an event loop could tolerate inline: bounded sends
+# ride local pipe/socket buffers (§4c) and spool file I/O is local
+HOT_ALLOWED = frozenset({"send", "io"})
+# classes whose *unbounded* direct sites trip block-unbounded
+UNBOUNDED_FAMILY = frozenset({"recv", "wait", "join", "queue",
+                              "future", "accept", "poll"})
+
+_RECV_NAMES = frozenset({"recv", "recv_bytes", "recv_bytes_into",
+                         "recv_into", "recvfrom", "conn_recv",
+                         "conn_recv_ex", "recv_exact_into"})
+_SEND_NAMES = frozenset({"send", "sendall", "send_bytes", "sendto",
+                         "send_msg_writev", "write_all", "writev",
+                         "conn_send"})
+_IO_NAMES = frozenset({"fsync", "sendfile", "pread", "pwrite", "read",
+                       "write", "readline", "readinto", "open"})
+_SUBPROC_NAMES = frozenset({"communicate", "check_call",
+                            "check_output"})
+_DIAL_NAMES = frozenset({"connect", "connect_tcp", "connect_retry",
+                         "connect_data", "connect_addr",
+                         "tunnel_connect", "create_connection",
+                         "Client"})
+_QUEUE_RECV_RE = re.compile(r"(queue|(^|[._])q\d*s?)$", re.I)
+
+
+class Site(NamedTuple):
+    path: str        # repo-relative
+    line: int
+    bclass: str      # blocking class (table above)
+    bounded: bool
+    desc: str        # rendered call, e.g. "conn.recv"
+
+
+def _is_none(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _is_num(node) -> bool:
+    return isinstance(node, ast.Constant) and \
+        isinstance(node.value, (int, float))
+
+
+def _timeout_kw(node: ast.Call):
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            return kw
+    return None
+
+
+def _kw_bounded(node: ast.Call) -> Optional[bool]:
+    """True/False if a timeout= kwarg decides boundedness, else None."""
+    kw = _timeout_kw(node)
+    if kw is None:
+        return None
+    return not _is_none(kw.value)
+
+
+def classify_call(node: ast.Call, rel: str) -> Optional[Site]:
+    """Classify one call expression as a blocking site, or None."""
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    recv_expr = name.rsplit(".", 1)[0] if "." in name else ""
+
+    def site(bclass: str, bounded: bool) -> Site:
+        return Site(rel, node.lineno, bclass, bounded, name)
+
+    if last == "sleep":
+        return site("sleep", bool(node.args))
+    if last in _RECV_NAMES:
+        # Connection.recv / socket.recv have no timeout parameter:
+        # statically unbounded (a poll-gate or SO_RCVTIMEO bound needs
+        # a waiver naming it)
+        return site("recv", False)
+    if last == "accept":
+        return site("accept", False)
+    if last in _SEND_NAMES:
+        return site("send", True)
+    if last == "wait":
+        b = _kw_bounded(node)
+        if b is None:
+            b = bool(node.args) and not _is_none(node.args[0])
+        return site("wait", b)
+    if last == "wait_for":
+        b = _kw_bounded(node)
+        if b is None:
+            b = len(node.args) >= 2 and not _is_none(node.args[1])
+        return site("wait", b)
+    if last == "join":
+        # Thread.join() takes no positional args (or one numeric
+        # timeout); str.join(seq) takes one non-numeric arg
+        if node.args and not _is_num(node.args[0]):
+            return None
+        b = _kw_bounded(node)
+        if b is None:
+            b = bool(node.args)
+        return site("join", b)
+    if last == "get":
+        if not _QUEUE_RECV_RE.search(recv_expr):
+            return None          # dict/config .get, not a queue
+        b = _kw_bounded(node)
+        if b is None:
+            b = any(kw.arg == "block" for kw in node.keywords)
+        return site("queue", b)
+    if last == "result":
+        b = _kw_bounded(node)
+        if b is None:
+            b = bool(node.args) and not _is_none(node.args[0])
+        return site("future", b)
+    if last == "poll":
+        if not node.args and not node.keywords:
+            return None          # instant readiness probe
+        b = not (node.args and _is_none(node.args[0]))
+        return site("poll", b)
+    if last == "select":
+        b = len(node.args) >= 4 and not _is_none(node.args[3])
+        return site("poll", b)
+    if last in _SUBPROC_NAMES or name == "subprocess.run":
+        return site("subprocess", _kw_bounded(node) or False)
+    if last in _IO_NAMES:
+        return site("io", True)
+    if last in _DIAL_NAMES:
+        # dials are deadline-owned by the caller (connect_retry /
+        # connect_tcp timeouts); tracked for the transitive summary
+        return site("dial", True)
+    return None
+
+
+# ------------------------------------------------------------- call graph
+class FuncNode:
+    __slots__ = ("qual", "module", "cls", "name", "rel", "lineno",
+                 "direct", "calls", "resolved", "reach")
+
+    def __init__(self, qual, module, cls, name, rel, lineno):
+        self.qual = qual
+        self.module = module
+        self.cls = cls
+        self.name = name
+        self.rel = rel
+        self.lineno = lineno
+        self.direct: List[Site] = []
+        self.calls: List[Tuple[str, str, str]] = []  # (mode, a, b)
+        self.resolved: Set[str] = set()
+        # Site -> callee qual that contributed it (None = direct)
+        self.reach: Dict[Site, Optional[str]] = {}
+
+
+def _own_nodes(body):
+    """Walk statements WITHOUT descending into nested defs/classes."""
+    stack = [n for n in body
+             if not isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef))]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _nested_defs(body):
+    """Direct nested function defs (not descending into them)."""
+    for node in _own_nodes(body):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                yield child
+    # defs that are themselves direct statements of the body
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class CallGraph:
+    def __init__(self):
+        self.funcs: Dict[str, FuncNode] = {}
+        self.by_name: Dict[str, List[str]] = {}
+        # module -> {local alias -> module key} import map
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self.modules: Set[str] = set()
+
+    def add_file(self, sf: SourceFile, module: str) -> None:
+        self.modules.add(module)
+        imap = self.imports.setdefault(module, {})
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imap[a.asname or a.name.split(".")[0]] = \
+                        a.name.rsplit(".", 1)[-1]
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    imap[a.asname or a.name] = a.name
+
+        def add_func(fn, cls: Optional[str], prefix: str = "") -> None:
+            qual = f"{module}:{prefix}{fn.name}" if cls is None else \
+                f"{module}:{cls}.{fn.name}"
+            node_ = FuncNode(qual, module, cls, fn.name, sf.rel,
+                             fn.lineno)
+            self.funcs[qual] = node_
+            self.by_name.setdefault(fn.name, []).append(qual)
+            for sub in _own_nodes(fn.body):
+                if isinstance(sub, ast.Call):
+                    s = classify_call(sub, sf.rel)
+                    if s is not None:
+                        node_.direct.append(s)
+                    else:
+                        self._record_call(node_, sub)
+            # nested defs become their own nodes (thread targets,
+            # retry closures) reachable through the by-name index
+            for inner in _nested_defs(fn.body):
+                add_func(inner, cls, prefix=f"{prefix}{fn.name}.")
+
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add_func(node, None)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        add_func(sub, node.name)
+
+    def _record_call(self, fn: FuncNode, call: ast.Call) -> None:
+        f = call.func
+        if isinstance(f, ast.Name):
+            fn.calls.append(("bare", f.id, ""))
+            return
+        if not isinstance(f, ast.Attribute):
+            return
+        name = dotted_name(f)
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            fn.calls.append(("self", parts[1], ""))
+        elif len(parts) == 2:
+            fn.calls.append(("mod", parts[0], parts[1]))
+        else:
+            fn.calls.append(("any", parts[-1], ""))
+
+    def resolve(self) -> None:
+        for fn in self.funcs.values():
+            imap = self.imports.get(fn.module, {})
+            for mode, a, b in fn.calls:
+                target = None
+                if mode == "self":
+                    # same-class only: a self.X miss means X is an
+                    # attribute (often a stored callable) — a global
+                    # unique-name fallback would attribute it to an
+                    # unrelated class's method of the same name
+                    target = self.funcs.get(f"{fn.module}:{fn.cls}.{a}")
+                elif mode == "bare":
+                    target = self.funcs.get(f"{fn.module}:{a}")
+                    if target is None:
+                        target = self._unique(a)
+                elif mode == "mod":
+                    modkey = imap.get(a, a)
+                    if modkey in self.modules:
+                        target = self.funcs.get(f"{modkey}:{b}")
+                    if target is None:
+                        target = self._unique_method(b)
+                elif mode == "any":
+                    target = self._unique_method(a)
+                if target is not None:
+                    fn.resolved.add(target.qual)
+
+    def _unique(self, name: str):
+        quals = self.by_name.get(name, ())
+        return self.funcs[quals[0]] if len(quals) == 1 else None
+
+    def _unique_method(self, name: str):
+        # receiver unknown: resolve only if the name is defined exactly
+        # once in scope (ambiguity = no edge, the sound-enough default)
+        return self._unique(name)
+
+    def fixed_point(self) -> None:
+        for fn in self.funcs.values():
+            for s in fn.direct:
+                fn.reach.setdefault(s, None)
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.funcs.values():
+                for q in fn.resolved:
+                    callee = self.funcs.get(q)
+                    if callee is None or callee is fn:
+                        continue
+                    for s in callee.reach:
+                        if s not in fn.reach:
+                            fn.reach[s] = q
+                            changed = True
+
+    def chain(self, fn: FuncNode, site: Site) -> str:
+        names = [fn.qual]
+        seen = {fn.qual}
+        cur = fn
+        while True:
+            via = cur.reach.get(site)
+            if via is None or via in seen:
+                break
+            seen.add(via)
+            names.append(via)
+            cur = self.funcs[via]
+        return " -> ".join(names)
+
+
+# ---------------------------------------------------------------- config
+class BlockingConfig(NamedTuple):
+    paths: List[Path]           # call-graph scope (module key = stem)
+    reactor_safe: Dict[str, int]  # dotted name -> declaring line
+    reactor_decl_rel: str       # file the REACTOR_SAFE set lives in
+    hot_contexts: List[str]     # transitive no-wait roots (quals)
+    serve_loops: List[str]      # direct-site bounded-timeout contexts
+    bounded_modules: Set[str]   # module stems fully under the rule
+    bounds: Dict[str, int]      # BLOCK_BOUNDS site -> declaring line
+    bounds_decl_rel: str        # file BLOCK_BOUNDS lives in
+
+
+def _decl_lines_set(sf: SourceFile, varname: str) -> Dict[str, int]:
+    """{element: lineno} for a module-level set/frozenset of strings."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == varname
+                   for t in targets):
+            continue
+        val = node.value
+        if isinstance(val, ast.Call) and val.args:
+            val = val.args[0]
+        if isinstance(val, (ast.Set, ast.List, ast.Tuple)):
+            for el in val.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    out[el.value] = el.lineno
+    return out
+
+
+def _decl_lines_dict(sf: SourceFile, varname: str) -> Dict[str, int]:
+    """{key: lineno} for a module-level dict with string keys."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == varname
+                   for t in targets):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    out[k.value] = k.lineno
+    return out
+
+
+# _HOT_KINDS entries whose dispatch arm is not a ``GcsServer._h_<k>``
+# method.  "task_done" rides the worker-event channel — its hot arm is
+# ``_on_task_done``.  "call" is an actor method invocation: its "arm"
+# IS user code executing on the actor's own thread, blocking there is
+# the feature, so it carries no no-wait obligation.
+_HOT_SPECIAL = {
+    "task_done": "gcs:GcsServer._on_task_done",
+    "call": None,
+}
+
+
+def default_config(root: Path) -> BlockingConfig:
+    priv = root / "ray_tpu" / "_private"
+    paths = sorted(priv.glob("*.py")) \
+        + sorted((root / "ray_tpu" / "serve").rglob("*.py")) \
+        + sorted((root / "ray_tpu" / "elastic").rglob("*.py"))
+    paths = [p for p in paths if p.name != "__init__.py"]
+    lw_sf = load(priv / "lock_watchdog.py")
+    reactor = _decl_lines_set(lw_sf, "REACTOR_SAFE")
+    bounds = _decl_lines_dict(lw_sf, "BLOCK_BOUNDS")
+    from tools.rtlint.wirecheck import _kind_decls
+    wire_sf = load(priv / "wire.py")
+    hot = _kind_decls(wire_sf, {"_HOT_KINDS"}).get("_HOT_KINDS", {})
+    hot_contexts = [f"gcs:GcsServer._h_{k}" for k in sorted(hot)
+                    if k not in _HOT_SPECIAL]
+    hot_contexts += [q for q in _HOT_SPECIAL.values() if q]
+    hot_contexts += [
+        "raylet:Raylet._handle_push",
+        "raylet:Raylet._on_worker_event",
+        "data_plane:DataPlaneServer._serve_stream",
+    ]
+    serve_loops = [
+        "data_plane:DataPlaneServer._serve",
+        "gcs:GcsServer._serve_conn",
+        "gcs:GcsServer._attach_raylet_conn",
+        "gcs:GcsServer._attach_agent_conn",
+        "actor_server:ActorServer._conn_reader",
+        "actor_server:ActorServer._exec_loop",
+        "worker:Worker._handle_oob",
+        "raylet:Raylet._upstream_loop",
+        "raylet:Raylet._worker_loop",
+        "raylet:Raylet._ref_loop",
+        "raylet:Raylet._ctl_park",
+        "raylet:Raylet._done_flush_loop",
+        "raylet:Raylet._reconcile_loop",
+        "replication:StandbyHead._stream_loop",
+        "protocol:serve_accept_loop",
+    ]
+    return BlockingConfig(
+        paths=paths,
+        reactor_safe=reactor,
+        reactor_decl_rel=lw_sf.rel,
+        hot_contexts=hot_contexts,
+        serve_loops=serve_loops,
+        bounded_modules={"protocol", "raylet", "replication"},
+        bounds=bounds,
+        bounds_decl_rel=lw_sf.rel)
+
+
+# ---------------------------------------------------------------- checks
+def _dotted_to_qual(dotted: str) -> str:
+    """'wire.encode_frame' -> 'wire:encode_frame';
+    'shm_store.ShmStore.touch' -> 'shm_store:ShmStore.touch'."""
+    mod, _, rest = dotted.partition(".")
+    return f"{mod}:{rest}"
+
+
+def check_blocking(cfg: BlockingConfig) -> List[Finding]:
+    graph = CallGraph()
+    bounded_sites: List[Tuple[str, str, int]] = []  # (site, rel, line)
+    for p in cfg.paths:
+        if not p.exists():
+            continue
+        try:
+            sf = load(p)
+        except SyntaxError:
+            continue
+        graph.add_file(sf, p.stem)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and \
+                    dotted_name(node.func).rsplit(".", 1)[-1] == \
+                    "bounded_block" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                bounded_sites.append(
+                    (node.args[0].value, sf.rel, node.lineno))
+    graph.resolve()
+    graph.fixed_point()
+
+    findings: List[Finding] = []
+
+    # --- block-reactor: REACTOR_SAFE transitively non-blocking -------
+    for dotted, decl_line in sorted(cfg.reactor_safe.items()):
+        fn = graph.funcs.get(_dotted_to_qual(dotted))
+        if fn is None:
+            findings.append(Finding(
+                cfg.reactor_decl_rel, decl_line, "block-reactor",
+                f"REACTOR_SAFE entry {dotted!r} does not resolve to a "
+                f"function in the scanned tree (stale declaration?)"))
+            continue
+        if fn.reach:
+            site = min(fn.reach, key=lambda s: (s.path, s.line))
+            findings.append(Finding(
+                cfg.reactor_decl_rel, decl_line, "block-reactor",
+                f"REACTOR_SAFE function {dotted!r} may block: "
+                f"{site.bclass} at {site.path}:{site.line} "
+                f"({site.desc}) via {graph.chain(fn, site)}"))
+
+    # --- block-hot-arm: hot arms reach only allowed classes ----------
+    seen_hot: Set[Tuple[str, int]] = set()
+    for qual in cfg.hot_contexts:
+        fn = graph.funcs.get(qual)
+        if fn is None:
+            continue
+        for site in sorted(fn.reach, key=lambda s: (s.path, s.line)):
+            if site.bclass in HOT_ALLOWED:
+                continue
+            if (site.path, site.line) in seen_hot:
+                continue
+            seen_hot.add((site.path, site.line))
+            findings.append(Finding(
+                site.path, site.line, "block-hot-arm",
+                f"hot dispatch arm {qual} may block on "
+                f"{site.bclass} ({site.desc}) — hot arms may block "
+                f"only on declared leaf-lock acquisitions and local "
+                f"sends/spool I/O; chain: {graph.chain(fn, site)}"))
+
+    # --- block-unbounded: direct sites need a bounded timeout --------
+    seen_ub: Set[Tuple[str, int]] = set()
+
+    def _flag_unbounded(fn: FuncNode, why: str) -> None:
+        for site in fn.direct:
+            if site.bclass not in UNBOUNDED_FAMILY or site.bounded:
+                continue
+            if (site.path, site.line) in seen_ub:
+                continue
+            seen_ub.add((site.path, site.line))
+            findings.append(Finding(
+                site.path, site.line, "block-unbounded",
+                f"unbounded {site.bclass} ({site.desc}) in {why} — "
+                f"pass a bounded timeout or waive citing the deadline "
+                f"that bounds it (reconnect/backoff/heartbeat/EOF)"))
+
+    for qual in cfg.serve_loops:
+        fn = graph.funcs.get(qual)
+        if fn is not None:
+            _flag_unbounded(fn, f"serve loop {qual}")
+    for fn in graph.funcs.values():
+        if fn.module in cfg.bounded_modules:
+            _flag_unbounded(fn, f"session-layer module "
+                                f"{fn.module}.py ({fn.qual})")
+
+    # --- bounded_block <-> BLOCK_BOUNDS identity ---------------------
+    used: Dict[str, Tuple[str, int]] = {}
+    for site_name, rel, line in bounded_sites:
+        used.setdefault(site_name, (rel, line))
+        if site_name not in cfg.bounds:
+            findings.append(Finding(
+                rel, line, "block-bound-undeclared",
+                f"bounded_block site {site_name!r} has no declared "
+                f"bound in lock_watchdog.BLOCK_BOUNDS"))
+    for site_name, decl_line in sorted(cfg.bounds.items()):
+        if site_name not in used:
+            findings.append(Finding(
+                cfg.bounds_decl_rel, decl_line, "block-bound-dead",
+                f"BLOCK_BOUNDS declares {site_name!r} but no "
+                f"bounded_block call site uses it"))
+    return findings
+
+
+def default_check(root: Path) -> List[Finding]:
+    return check_blocking(default_config(root))

@@ -1,0 +1,444 @@
+"""Pass 9: wire-protocol session conformance (DESIGN.md §4p).
+
+``wire.py`` declares one session FSM per channel (``SESSION_FSMS``,
+next to the kind tables): control negotiation, the raylet lease
+channel, the replication stream, and the data-plane ``fetch_stream``
+exchange.  This pass turns the "version-fenced, byte-identical to old
+peers" prose claims into checked artifacts, two ways:
+
+**Static conformance** — the declarations and the code must agree:
+
+- ``proto-drift``: each channel FSM's concrete kinds (pseudo-kinds
+  ``*...`` excluded) must exactly equal the wire kind tables it is
+  declared against (``RAYLET_*_KINDS``, ``REPL_*_KINDS``,
+  ``DATA_OPS``) — a kind added to a table without an FSM transition,
+  or vice versa, is a finding.
+- ``proto-arm-illegal``: a dispatch arm (literal ``kind ==``/``op ==``
+  comparison) in a side's code for a channel kind the FSM says that
+  side never RECEIVES.
+- ``proto-producer-illegal``: a producer (``{"kind": ...}`` /
+  ``{"op": ...}`` dict literal or ``_send_up("...")`` call) in a
+  side's code for a channel kind the FSM says that side never SENDS.
+
+**Exhaustive exploration** — every channel FSM is model-checked across
+the full old x new version matrix (client max-version x server floor x
+server max-version over the channel's declared range), tracking the
+negotiated session version and outstanding reply obligations:
+
+- ``proto-deadlock``: a reachable non-final state with no enabled
+  transition at the negotiated version (a version skew can strand a
+  session mid-protocol).
+- ``proto-double-reply``: a reply transition enabled with no
+  outstanding request.
+- ``proto-reply-drop``: a final state (or a ``convert`` hand-off)
+  reached with an unsettled reply obligation — the peer would hang
+  forever on a reply nothing will send.  ``teardown`` (``*eof``)
+  settles obligations by construction: the waiter observes the loss.
+- ``proto-unreachable``: a declared state no (version, path) combo
+  ever reaches — dead protocol surface that can silently rot.
+
+Exploration findings anchor on the channel's line in the
+``SESSION_FSMS`` declaration; conformance findings anchor on the
+offending arm/producer/table line.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from tools.rtlint import Finding, SourceFile, load
+from tools.rtlint.wirecheck import _kind_decls
+
+_PENDING_CAP = 2  # real channels never pipeline requests
+
+
+# ------------------------------------------------- declaration loading
+def _const_env(tree) -> Dict[str, object]:
+    """Module-level ``NAME = <int|str>`` constants (PROTO_* etc.)."""
+    env: Dict[str, object] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    env[t.id] = node.value.value
+    return env
+
+
+def _eval_node(node, env: Dict[str, object]):
+    """Literal evaluation extended with Name lookups into ``env`` —
+    SESSION_FSMS may reference PROTO_RAYLET etc. by name."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise ValueError(f"unresolvable name {node.id!r} in FSM decl")
+    if isinstance(node, ast.Tuple):
+        return tuple(_eval_node(e, env) for e in node.elts)
+    if isinstance(node, ast.List):
+        return [_eval_node(e, env) for e in node.elts]
+    if isinstance(node, ast.Set):
+        return {_eval_node(e, env) for e in node.elts}
+    if isinstance(node, ast.Dict):
+        return {_eval_node(k, env): _eval_node(v, env)
+                for k, v in zip(node.keys, node.values)}
+    raise ValueError(f"non-literal node {type(node).__name__} in "
+                     f"FSM decl (keep SESSION_FSMS declarative)")
+
+
+def load_fsms(sf: SourceFile):
+    """(fsms, {channel: decl line}) from a SESSION_FSMS assignment."""
+    env = _const_env(sf.tree)
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SESSION_FSMS"
+                for t in node.targets):
+            if not isinstance(node.value, ast.Dict):
+                raise ValueError("SESSION_FSMS must be a dict literal")
+            lines = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant):
+                    lines[k.value] = k.lineno
+            return _eval_node(node.value, env), lines
+    raise ValueError(f"no SESSION_FSMS declaration in {sf.rel}")
+
+
+class Transition(NamedTuple):
+    state: str
+    who: str          # "c" / "s" / "x"
+    kind: str         # wire kind or "*pseudo"
+    min_v: int
+    effect: str       # request / reply / oneway / convert / teardown
+    next: str
+
+
+def _transitions(fsm) -> List[Transition]:
+    return [Transition(*t) for t in fsm["transitions"]]
+
+
+def fsm_kinds(fsm) -> Set[str]:
+    """Concrete (non-pseudo) kinds a channel FSM speaks."""
+    return {t.kind for t in _transitions(fsm)
+            if not t.kind.startswith("*")}
+
+
+def side_kinds(fsm, side: str) -> Tuple[Set[str], Set[str]]:
+    """(sends, receives) concrete kinds for one side of a channel."""
+    sends: Set[str] = set()
+    for t in _transitions(fsm):
+        if t.kind.startswith("*"):
+            continue
+        if t.who == side or t.who == "x":
+            sends.add(t.kind)
+    other = "s" if side == "c" else "c"
+    recvs = {t.kind for t in _transitions(fsm)
+             if not t.kind.startswith("*")
+             and (t.who == other or t.who == "x")}
+    return sends, recvs
+
+
+# ------------------------------------------------------ static scans
+def _scoped_tree(sf: SourceFile, cls: Optional[str]):
+    if cls is None:
+        return sf.tree
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            return node
+    return None
+
+
+def _arm_lines(tree, keys=("kind", "op")) -> Dict[str, int]:
+    """{kind: line} of literal dispatch-arm comparisons in scope."""
+    arms: Dict[str, int] = {}
+
+    def is_kind_expr(e) -> bool:
+        if isinstance(e, ast.Name) and e.id in keys:
+            return True
+        return isinstance(e, ast.Subscript) and \
+            isinstance(e.slice, ast.Constant) and e.slice.value in keys
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare) or \
+                not is_kind_expr(node.left):
+            continue
+        for cmp_ in node.comparators:
+            if isinstance(cmp_, ast.Constant) and \
+                    isinstance(cmp_.value, str):
+                arms.setdefault(cmp_.value, node.lineno)
+            elif isinstance(cmp_, (ast.Tuple, ast.Set, ast.List)):
+                for el in cmp_.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        arms.setdefault(el.value, node.lineno)
+    return arms
+
+
+def _producer_lines(tree, key: str) -> Dict[str, int]:
+    """{kind: line} of frame producers in scope: ``{key: "<kind>"}``
+    dict literals plus ``_send_up("<kind>")`` / ``_send_up_safe``.
+
+    For ``kind``-keyed channels the dict must also carry a ``rid``
+    key — every control/lease/repl frame does, which is what separates
+    a frame literal from a metrics ``tags={"kind": ...}`` dict."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            keys = {k.value for k in node.keys
+                    if isinstance(k, ast.Constant)}
+            if key == "kind" and "rid" not in keys:
+                continue
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == key \
+                        and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    out.setdefault(v.value, node.lineno)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("_send_up", "_send_up_safe") and \
+                node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.setdefault(node.args[0].value, node.lineno)
+    return out
+
+
+class SideSpec(NamedTuple):
+    path: str            # repo-relative
+    cls: Optional[str]   # restrict the scan to one class (mixed files)
+    side: str            # "c" or "s"
+
+
+class ChannelSpec(NamedTuple):
+    tables: Tuple[str, ...]   # kind tables in the FSM file (drift)
+    sides: Tuple[SideSpec, ...]
+    key: str = "kind"         # frame key carrying the kind
+
+
+class ProtoConfig(NamedTuple):
+    fsm_path: Path
+    channels: Dict[str, ChannelSpec]
+
+
+def default_config(root: Path) -> ProtoConfig:
+    priv = "ray_tpu/_private"
+    return ProtoConfig(
+        fsm_path=root / priv / "wire.py",
+        channels={
+            # control conformance (arm existence/reply shape) is the
+            # wire + replies passes' job; here it is exploration-only
+            "control": ChannelSpec(tables=(), sides=()),
+            "raylet": ChannelSpec(
+                tables=("RAYLET_DOWN_KINDS", "RAYLET_UP_KINDS"),
+                sides=(SideSpec(f"{priv}/raylet.py", None, "c"),
+                       SideSpec(f"{priv}/gcs.py", None, "s"))),
+            "repl": ChannelSpec(
+                tables=("REPL_DOWN_KINDS", "REPL_UP_KINDS"),
+                sides=(SideSpec(f"{priv}/replication.py",
+                                "StandbyHead", "c"),
+                       SideSpec(f"{priv}/replication.py",
+                                "ReplicationHub", "s"),
+                       SideSpec(f"{priv}/gcs.py", None, "s"))),
+            "fetch_stream": ChannelSpec(
+                tables=("DATA_OPS",),
+                sides=(SideSpec(f"{priv}/data_plane.py",
+                                "DataPlaneServer", "s"),),
+                key="op"),
+        })
+
+
+# ------------------------------------------------------- exploration
+def explore_channel(name: str, fsm, decl_rel: str,
+                    decl_line: int) -> List[Finding]:
+    lo, hi = fsm["versions"]
+    trans = _transitions(fsm)
+    finals = set(fsm["finals"])
+    initial = fsm["initial"]
+    hello = fsm.get("hello")
+    pre_v = fsm.get("pre_version", lo)
+    all_states = {initial} | {t.state for t in trans} \
+        | {t.next for t in trans}
+    reached_states: Set[str] = set()
+    by_state: Dict[str, List[Transition]] = {}
+    for t in trans:
+        by_state.setdefault(t.state, []).append(t)
+
+    findings: List[Finding] = []
+    flagged: Set[Tuple[str, str]] = set()
+
+    def flag(rule: str, key: str, msg: str) -> None:
+        if (rule, key) in flagged:
+            return
+        flagged.add((rule, key))
+        findings.append(Finding(decl_rel, decl_line, rule,
+                                f"channel {name!r}: {msg}"))
+
+    for cmax in range(lo, hi + 1):
+        for smin in range(lo, hi + 1):
+            for smax in range(smin, hi + 1):
+                shared = min(cmax, smax)
+                negotiated = shared if shared >= smin else None
+                if hello is None:
+                    # rides an already-negotiated control conn
+                    if negotiated is None:
+                        continue
+                    start_v = negotiated
+                else:
+                    start_v = pre_v
+
+                def enabled(t: Transition, v: int):
+                    if t.kind == "*hello_ok":
+                        return negotiated is not None
+                    if t.kind == "*hello_reject":
+                        return negotiated is None
+                    return t.min_v <= v
+
+                start = (initial, start_v, ())
+                seen = {start}
+                stack = [start]
+                while stack:
+                    state, v, pending = stack.pop()
+                    reached_states.add(state)
+                    moves = 0
+                    for t in by_state.get(state, ()):
+                        if not enabled(t, v):
+                            continue
+                        moves += 1
+                        nv, np = v, pending
+                        if t.effect == "request":
+                            if len(pending) >= _PENDING_CAP:
+                                continue
+                            np = pending + (t.kind,)
+                        elif t.effect == "reply":
+                            if not pending:
+                                flag("proto-double-reply",
+                                     f"{state}/{t.kind}",
+                                     f"reply {t.kind!r} enabled in "
+                                     f"state {state!r} with no "
+                                     f"outstanding request (cmax="
+                                     f"{cmax} smin={smin} smax="
+                                     f"{smax})")
+                                continue
+                            np = pending[1:]
+                            if t.kind == "*hello_ok":
+                                nv = negotiated
+                        elif t.effect == "teardown":
+                            np = ()   # EOF settles: waiter sees loss
+                        if t.effect in ("convert",) and pending:
+                            flag("proto-reply-drop",
+                                 f"{state}/{t.kind}",
+                                 f"convert {t.kind!r} from state "
+                                 f"{state!r} with unsettled request "
+                                 f"{pending[0]!r} (cmax={cmax} "
+                                 f"smin={smin} smax={smax})")
+                            continue
+                        if t.next in finals and np:
+                            flag("proto-reply-drop",
+                                 f"{t.next}/{np[0]}",
+                                 f"final state {t.next!r} reached "
+                                 f"with unsettled request {np[0]!r} "
+                                 f"via {t.kind!r} (cmax={cmax} "
+                                 f"smin={smin} smax={smax})")
+                            continue
+                        nxt = (t.next, nv, np)
+                        if nxt not in seen:
+                            seen.add(nxt)
+                            stack.append(nxt)
+                    if moves == 0 and state not in finals:
+                        flag("proto-deadlock", f"{state}/{v}",
+                             f"state {state!r} is reachable with no "
+                             f"enabled transition at negotiated "
+                             f"version {v} (cmax={cmax} smin={smin} "
+                             f"smax={smax}, pending="
+                             f"{list(pending)!r}) — the session "
+                             f"wedges")
+    for state in sorted(all_states - reached_states):
+        flag("proto-unreachable", state,
+             f"declared state {state!r} is unreachable at every "
+             f"version combination — dead protocol surface")
+    return findings
+
+
+# ------------------------------------------------------------ checker
+def check_protostate(cfg: ProtoConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    fsm_sf = load(cfg.fsm_path)
+    try:
+        fsms, decl_lines = load_fsms(fsm_sf)
+    except ValueError as e:
+        return [Finding(fsm_sf.rel, 1, "proto-drift", str(e))]
+
+    for chan, spec in sorted(cfg.channels.items()):
+        fsm = fsms.get(chan)
+        if fsm is None:
+            findings.append(Finding(
+                fsm_sf.rel, 1, "proto-drift",
+                f"configured channel {chan!r} has no SESSION_FSMS "
+                f"declaration"))
+            continue
+        decl_line = decl_lines.get(chan, 1)
+        kinds = fsm_kinds(fsm)
+
+        # drift against the wire kind tables
+        if spec.tables:
+            decls = _kind_decls(fsm_sf, set(spec.tables))
+            table_kinds: Dict[str, int] = {}
+            for tname in spec.tables:
+                table_kinds.update(decls.get(tname, {}))
+            for k in sorted(set(table_kinds) - kinds):
+                findings.append(Finding(
+                    fsm_sf.rel, table_kinds[k], "proto-drift",
+                    f"channel {chan!r}: kind {k!r} is declared in "
+                    f"{'/'.join(spec.tables)} but the session FSM "
+                    f"has no transition for it"))
+            for k in sorted(kinds - set(table_kinds)):
+                findings.append(Finding(
+                    fsm_sf.rel, decl_line, "proto-drift",
+                    f"channel {chan!r}: FSM transition kind {k!r} is "
+                    f"not declared in {'/'.join(spec.tables)}"))
+
+        # per-side arm/producer direction legality
+        for side in spec.sides:
+            p = cfg.fsm_path.parent.parent.parent / side.path \
+                if not Path(side.path).is_absolute() else Path(side.path)
+            if not p.exists():
+                continue
+            try:
+                side_sf = load(p)
+            except SyntaxError:
+                continue
+            scope = _scoped_tree(side_sf, side.cls)
+            if scope is None:
+                findings.append(Finding(
+                    side_sf.rel, 1, "proto-arm-illegal",
+                    f"channel {chan!r}: configured class "
+                    f"{side.cls!r} not found in {side.path}"))
+                continue
+            sends, recvs = side_kinds(fsm, side.side)
+            where = f"{side.path}" + \
+                (f"::{side.cls}" if side.cls else "")
+            for k, line in sorted(_arm_lines(scope).items()):
+                if k in kinds and k not in recvs:
+                    findings.append(Finding(
+                        side_sf.rel, line, "proto-arm-illegal",
+                        f"channel {chan!r}: {where} (side "
+                        f"{side.side!r}) dispatches kind {k!r} which "
+                        f"the session FSM says this side never "
+                        f"receives"))
+            for k, line in sorted(
+                    _producer_lines(scope, spec.key).items()):
+                if k in kinds and k not in sends:
+                    findings.append(Finding(
+                        side_sf.rel, line, "proto-producer-illegal",
+                        f"channel {chan!r}: {where} (side "
+                        f"{side.side!r}) produces kind {k!r} which "
+                        f"the session FSM says this side never "
+                        f"sends"))
+
+        findings += explore_channel(chan, fsm, fsm_sf.rel, decl_line)
+    return findings
+
+
+def default_check(root: Path) -> List[Finding]:
+    return check_protostate(default_config(root))

@@ -1,0 +1,76 @@
+"""SARIF 2.1.0 output for rtlint findings.
+
+``python -m tools.rtlint --sarif out.sarif`` writes every ACTIVE
+(unwaived) finding as a SARIF result so CI can annotate PR diffs
+(GitHub code scanning ingests the file via
+``github/codeql-action/upload-sarif``).  Waived findings are omitted —
+a waiver is a reviewed decision, not a diff annotation.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List
+
+from tools.rtlint import Finding
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings: List[Finding],
+             rules: Dict[str, List]) -> dict:
+    """SARIF run dict from findings + the --list-rules catalog."""
+    rule_ids = []
+    rule_objs = []
+    for pname, entries in rules.items():
+        for rule, contract in entries:
+            if rule in rule_ids:
+                continue
+            rule_ids.append(rule)
+            rule_objs.append({
+                "id": rule,
+                "shortDescription": {"text": contract},
+                "properties": {"pass": pname},
+            })
+    results = []
+    for f in sorted(findings):
+        res = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        if f.rule in rule_ids:
+            res["ruleIndex"] = rule_ids.index(f.rule)
+        results.append(res)
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "rtlint",
+                "rules": rule_objs,
+            }},
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file:///"},
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path, findings: List[Finding],
+                rules: Dict[str, List]) -> None:
+    Path(path).write_text(
+        json.dumps(to_sarif(findings, rules), indent=2,
+                   sort_keys=True) + "\n")
