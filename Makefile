@@ -2,7 +2,8 @@
 
 PY ?= python
 
-.PHONY: test sanitize fuzz bench lint rtlint check-metrics microbench-quick \
+.PHONY: test sanitize fuzz bench lint rtlint jaxlint xlacheck \
+	check-metrics microbench-quick \
 	databench-quick servebench-quick llmbench-quick tracebench-quick \
 	releasebench-quick fleetbench-quick obsbench-quick \
 	profbench-quick failoverbench-quick trainbench-quick leakcheck
@@ -15,24 +16,49 @@ test:
 # plus rtlint in incremental mode: passes whose git-changed input set
 # is empty are skipped (interprocedural passes still run over their
 # full inputs when any input moved — partial summaries are unsound).
-# CI and `make rtlint` run the full tree.
-lint:
+# CI and `make rtlint` run the full tree.  Incremental timings on
+# this tree (13 passes): full 8.1s, doc-only change 0.07s ("running
+# nothing"), one-file util/ change 4.6s (6 of 13 passes; the §4q
+# compute-plane passes only wake when ops/models/parallel/serve-llm/
+# bench inputs move).
+lint: jaxlint
 	$(PY) tools/lint.py
 	$(PY) -m tools.rtlint --changed-only
 
-# rtlint (DESIGN.md §4d/§4f/§4p): machine-enforces the GCS locking
+# rtlint (DESIGN.md §4d/§4f/§4p/§4q): machine-enforces the GCS locking
 # discipline (lock-order DAG, no blocking under leaf locks),
 # guarded-field annotations, wire-protocol exhaustiveness,
 # spawned-thread hygiene, metrics-catalog honesty, resource lifecycle
 # (close/transfer on every exit path incl. exception edges), wire
 # reply discipline (exactly-one-reply per two-way dispatch arm),
 # interprocedural blocking-flow (REACTOR_SAFE / hot-arm / bounded-
-# timeout policies + the BLOCK_BOUNDS static==runtime identity), and
-# session-FSM conformance over the old x new version matrix.
+# timeout policies + the BLOCK_BOUNDS static==runtime identity),
+# session-FSM conformance over the old x new version matrix, and the
+# compute-plane jaxlint passes (§4q: donation discipline, retrace
+# triggers, host-sync freedom of step paths, mesh-axis/activation-rule
+# drift).
 # Fixture corpus: tests/rtlint_fixtures/.  `--list-rules` prints the
-# catalog.
+# catalog.  `--waiver-audit` (CI) additionally fails on stale waivers.
 rtlint:
 	$(PY) -m tools.rtlint
+
+# Compute-plane passes alone (DESIGN.md §4q): donation / retrace /
+# host-sync / mesh-axes over ray_tpu/{ops,models,parallel,serve/llm}
+# and the benches, pinned to the lock_watchdog.py declaration tables
+# (STEP_PATHS / DONATED / COMPILE_BUDGETS) and mesh.py's AXES /
+# ACTIVATION_RULES.  Also rides `make lint` and full `make rtlint`.
+jaxlint:
+	$(PY) -m tools.rtlint --pass donation --pass retrace \
+		--pass hostsync --pass meshaxes
+
+# Runtime half of the §4q contract (the XLA hygiene oracle): the
+# train-step + LLM-engine suite under RAY_TPU_XLA_WATCHDOG=1 — zero
+# host transfers inside step regions, zero steady-state recompiles
+# over the declared COMPILE_BUDGETS, injected violations raise with
+# site + stack (leakcheck pattern).
+xlacheck:
+	JAX_PLATFORMS=cpu RAY_TPU_XLA_WATCHDOG=1 $(PY) -m pytest \
+		tests/test_xla_watchdog.py -q -x
 
 # Runtime half of the resource pass (DESIGN.md §4f): the leak-hammer
 # suite under RAY_TPU_RESOURCE_SANITIZER=1 — N pulls/tasks/actor churns

@@ -498,6 +498,75 @@ def reset_block_stats() -> None:
         _BLOCK_STATS.clear()
 
 
+# ======================================================================
+# XLA hygiene policy (DESIGN.md §4q) — the machine-readable side of
+# tools/rtlint's ``jaxlint`` passes, and the declared contract the
+# ``RAY_TPU_XLA_WATCHDOG=1`` runtime oracle (xla_watchdog.py) enforces.
+# Same identity discipline as REACTOR_SAFE / BLOCK_BOUNDS above: the
+# static passes parse THESE tables, the runtime oracle imports them,
+# so neither can drift.
+
+# Step paths: the compute-plane functions that make up a steady-state
+# step — the train step body, the LLM prefill/decode programs and the
+# engine's batching step, and the decomposed-collective ring bodies.
+# Quals are ``module:qualname`` over the jaxlint call-graph scope
+# (module key = file stem, nested defs dotted — same scheme as the
+# blocking pass).  jaxlint proves each is transitively free of host
+# syncs (``host-sync``) and scans everything reachable from them for
+# retrace hazards (``retrace-*``).
+STEP_PATHS: Set[str] = {
+    # the one-jit distributed train step (forward+backward+optimizer)
+    "spmd:build_train_program._step",
+    # LLM serving programs (bucketed jits) + the engine batching step
+    "gpt2:forward_prefill",
+    "gpt2:forward_decode",
+    "llama:forward_prefill",
+    "llama:forward_decode",
+    "engine:LLMEngine.step",
+    # decomposed collective-matmul rings + the KV ring (§4m): a host
+    # sync inside a ring body would serialize the whole ring
+    "collective_matmul:all_gather_matmul",
+    "collective_matmul:matmul_reduce_scatter",
+    "ring_attention:ring_attention",
+}
+
+# Donating callables: bound name of a ``jax.jit(..., donate_argnums=)``
+# result -> the argnums that are ALWAYS donated.  jaxlint checks the
+# jit sites against this map both directions (``donate-undeclared`` /
+# ``donate-dead``), diffs literal donate_argnums against it
+# (``donate-drift``), and flags any read of a donated binding after a
+# call to the named callable (``donate-use-after``).  ``step_fn``
+# donates the whole TrainState (argnum 0) — params AND both Adam
+# moments alias their outputs; the optional ``donate_batch`` argnum is
+# deliberately NOT declared (callers that enable it feed fresh batches
+# and the static rule covers the unconditional donation only).
+DONATED: Dict[str, Tuple[int, ...]] = {
+    "step_fn": (0,),
+}
+
+# compile_budget site -> declared steady-state compile ceiling (count
+# of distinct XLA programs one region owner may build).  The runtime
+# oracle raises :class:`XlaHygieneViolation` (xla_watchdog.py) when a
+# site's owner exceeds ``budget + RAY_TPU_XLA_WATCHDOG_WARMUP``;
+# jaxlint pins each ``compile_budget("<site>")`` call to exactly one
+# row here (``compile-budget-undeclared`` / ``compile-budget-dead``).
+# Keep ceilings honest: the bucket-table length for the bucketed LLM
+# programs (a site override passes the live ``len(buckets)``), one
+# program for the train step.
+COMPILE_BUDGETS: Dict[str, int] = {
+    # spmd.build_train_program: one program per SpmdProgram, ever —
+    # shapes are pinned by the batch sharding, a second compile in
+    # steady state means a retrace hazard escaped jaxlint
+    "train.step": 1,
+    # model_runner: one program per declared length/batch bucket
+    # (site override passes len(cfg.prefill_len_buckets) /
+    # len(cfg.decode_batch_buckets); these rows are the config-default
+    # ceilings)
+    "llm.prefill": 6,
+    "llm.decode": 5,
+}
+
+
 class bounded_block:
     """Context manager wrapping one declared-bounded blocking site.
 

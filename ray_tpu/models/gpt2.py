@@ -238,6 +238,14 @@ def _block(x: jax.Array, lp: Params, cfg: GPT2Config,
     from jax.ad_checkpoint import checkpoint_name
     qkv = checkpoint_name(qkv, "attn_qkv")
     q, k, v = [qkv[:, :, i, :].reshape(B, T, H, D) for i in range(3)]
+    # Pin the attention-region layout (DESIGN.md §4q / ACTIVATION_RULES):
+    # heads shard over tensor, sequence-through-attention over context
+    # (ring CP), per-head features replicated.  No-op without an
+    # ambient mesh; GSPMD otherwise guesses from the qkv matmul.
+    from ray_tpu.parallel import mesh as mesh_lib
+    q = mesh_lib.constrain(q, "batch", "seq_attn", "heads", "kv")
+    k = mesh_lib.constrain(k, "batch", "seq_attn", "heads", "kv")
+    v = mesh_lib.constrain(v, "batch", "seq_attn", "heads", "kv")
     a = attn(q, k, v, cfg).reshape(B, T, E)
     a = a @ lp["attn_out"]["kernel"].astype(cfg.dtype) \
         + lp["attn_out"]["bias"].astype(cfg.dtype)
@@ -245,6 +253,9 @@ def _block(x: jax.Array, lp: Params, cfg: GPT2Config,
     h = _layer_norm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"])
     h = h @ lp["mlp_in"]["kernel"].astype(cfg.dtype) \
         + lp["mlp_in"]["bias"].astype(cfg.dtype)
+    # MLP hidden shards over tensor (Megatron TP): pinned so the gelu
+    # runs on the sharded layout instead of an all-gathered one.
+    h = mesh_lib.constrain(h, "batch", "seq_attn", "mlp")
     h = jax.nn.gelu(h, approximate=True)
     h = h @ lp["mlp_out"]["kernel"].astype(cfg.dtype) \
         + lp["mlp_out"]["bias"].astype(cfg.dtype)
@@ -494,6 +505,10 @@ def forward(params: Params, tokens: jax.Array,
     """tokens (B, T) int32 → logits (B, T, vocab) in f32."""
     x = forward_hidden(params, tokens, cfg)
     logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(cfg.dtype))
+    # Vocab dim shards over tensor (the wte is tensor-sharded on vocab):
+    # pinned so the (B, T, V) f32 logits never replicate.
+    from ray_tpu.parallel import mesh as mesh_lib
+    logits = mesh_lib.constrain(logits, "batch", None, "vocab")
     return logits.astype(jnp.float32)
 
 

@@ -216,6 +216,54 @@ def activation_spec(*logical: Optional[str]) -> P:
     return P(*parts)
 
 
+def constrain(x, *logical: Optional[str]):
+    """Pin an intermediate activation to its logical placement.
+
+    ``constrain(q, "batch", "seq_attn", "heads", "kv")`` applies
+    ``with_sharding_constraint`` against the ambient program mesh
+    (:func:`get_ambient_mesh`, set by spmd.build_train_program at trace
+    time) — inside jit this forces GSPMD to materialize the declared
+    layout at that point instead of whatever propagation guessed;
+    outside any ambient mesh (unit tests, the serving engine's
+    single-host jit) it is a no-op passthrough.  Dims whose mesh-axis
+    product does not divide the dim size are left unconstrained (same
+    tolerance the param rules get from NamedSharding itself).
+
+    This is the live half of the ``ACTIVATION_RULES`` contract: rtlint's
+    meshaxes pass fails on rules no ``constrain()``/``activation_spec()``
+    names (``mesh-activation-dead``) and on names no rule declares
+    (``mesh-activation-undeclared``).
+    """
+    mesh = get_ambient_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    sizes = dict(mesh.shape)
+    parts = []
+    for dim, name in zip(getattr(x, "shape", ()), logical):
+        axes = ACTIVATION_RULES.get(name) if name is not None else None
+        if name is not None and name not in ACTIVATION_RULES:
+            raise KeyError(f"unknown logical activation axis {name!r} "
+                           f"(have {sorted(ACTIVATION_RULES)})")
+        if axes is None:
+            parts.append(None)
+            continue
+        group = axes if isinstance(axes, tuple) else (axes,)
+        group = tuple(a for a in group if a in sizes)
+        total = 1
+        for a in group:
+            total *= sizes[a]
+        if not group or total <= 1 or dim % total:
+            parts.append(None)
+        else:
+            parts.append(axes)
+    import jax
+    if all(p is None for p in parts):
+        return x
+    from jax.sharding import NamedSharding
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*parts)))
+
+
 def spec_for_path(path: str, rules: Rules) -> P:
     for pat, spec in rules:
         if re.fullmatch(pat, path):

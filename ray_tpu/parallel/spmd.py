@@ -226,8 +226,19 @@ def build_train_program(
         out_shardings=(state_sh, NamedSharding(mesh, P())),
         donate_argnums=donate)
 
+    # XLA watchdog step region (DESIGN.md §4q): one program for this
+    # SpmdProgram's life (COMPILE_BUDGETS["train.step"]), zero host
+    # transfers inside the dispatch.  Callers' device_get of the
+    # metrics dict happens on THEIR side of the region and stays legal.
+    from ray_tpu._private.xla_watchdog import compile_budget
+    step_budget = compile_budget("train.step")
+
+    def guarded_step(state: TrainState, batch: Any):
+        with step_budget:
+            return step_fn(state, batch)
+
     return SpmdProgram(mesh=mesh, mesh_config=mesh_config, init_fn=init_fn,
-                       step_fn=step_fn, state_shardings=state_sh,
+                       step_fn=guarded_step, state_shardings=state_sh,
                        batch_sharding=batch_sh)
 
 

@@ -25,6 +25,7 @@ from typing import Tuple
 import numpy as np
 
 from ray_tpu._private import rtlog
+from ray_tpu._private.xla_watchdog import compile_budget
 from ray_tpu.serve.llm.config import EngineConfig, SamplingParams, \
     resolve_model
 
@@ -60,6 +61,14 @@ class ModelRunner:
                                        cfg=self.mcfg))
         self.compiles = 0          # observability: distinct programs built
         self._shapes_seen: set = set()
+        # XLA watchdog step regions (DESIGN.md §4q): one compile per
+        # bucket for the runner's life, zero host transfers inside the
+        # dispatch.  The post-dispatch np.asarray pulls are designed
+        # syncs and sit OUTSIDE the regions.
+        self._prefill_budget = compile_budget(
+            "llm.prefill", len(cfg.prefill_len_buckets))
+        self._decode_budget = compile_budget(
+            "llm.decode", len(cfg.decode_batch_buckets))
 
     def _load_params(self):
         import jax
@@ -87,8 +96,10 @@ class ModelRunner:
         toks[0, :n] = token_ids
         # last_pos is TRACED (one compile per bucket, not per length);
         # only the last real position's (1, V) logits come back to host
-        logits, ks, vs = self._prefill(self.params, toks,
-                                       last_pos=jnp.int32(n - 1))
+        last_pos = jnp.int32(n - 1)
+        with self._prefill_budget:
+            logits, ks, vs = self._prefill(self.params, toks,
+                                           last_pos=last_pos)
         logits = np.asarray(logits)[0]                           # (V,)
         ks = np.asarray(ks)[:, 0]                                # (L,T,KV,D)
         vs = np.asarray(vs)[:, 0]
@@ -119,8 +130,10 @@ class ModelRunner:
             block_tables = np.concatenate(
                 [block_tables, np.zeros((pad, block_tables.shape[1]),
                                         np.int32)])
-        logits, ks, vs = self._decode(self.params, tokens, positions,
-                                      kv_pool, block_tables, ctx_lens)
+        with self._decode_budget:
+            logits, ks, vs = self._decode(self.params, tokens,
+                                          positions, kv_pool,
+                                          block_tables, ctx_lens)
         return (np.asarray(logits)[:b], np.asarray(ks)[:, :b],
                 np.asarray(vs)[:, :b])
 

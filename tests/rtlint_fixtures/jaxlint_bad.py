@@ -1,0 +1,88 @@
+"""jaxlint positive fixture: every §4q rule fires at least once.
+
+Parsed (never imported) by tests/test_rtlint.py, which builds a
+JaxlintConfig whose declaration tables are THIS file's module-level
+STEP_PATHS / DONATED / COMPILE_BUDGETS / AXES / ACTIVATION_RULES, so
+the fixture is self-contained the way blocking_bad.py is.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu._private.xla_watchdog import compile_budget
+from ray_tpu.parallel.mesh import activation_spec, constrain
+
+# --- declarations (stand-ins for lock_watchdog.py / mesh.py) ---------
+# gone_fn does not exist -> step-path-stale
+STEP_PATHS = {"jaxlint_bad:train_loop", "jaxlint_bad:step_impl",
+              "jaxlint_bad:gone_fn"}
+# ghost_fn is never bound by a donating jit -> donate-dead
+DONATED = {"step_fn": (0,), "ghost_fn": (0,)}
+# fixture.dead has no compile_budget site -> compile-budget-dead
+COMPILE_BUDGETS = {"fixture.step": 1, "fixture.dead": 1}
+AXES = ("data", "tensor")
+# deadrule is never used -> mesh-activation-dead
+ACTIVATION_RULES = {"batch": "data", "heads": "tensor",
+                    "deadrule": None}
+
+
+def _impl(state, batch):
+    return state, {"loss": jnp.float32(0)}
+
+
+# declared (0,) but the site donates (0, 1) -> donate-drift
+step_fn = jax.jit(_impl, donate_argnums=(0, 1))
+
+# bound name not in DONATED -> donate-undeclared
+other_fn = jax.jit(_impl, donate_argnums=(0,))
+
+
+def train_loop(state, batches):
+    # donated arg never rebound inside the loop -> donate-use-after
+    for b in batches:
+        metrics = step_fn(state, b)
+    # undeclared budget site -> compile-budget-undeclared
+    with compile_budget("fixture.unknown"):
+        pass
+    # host pull on a step path -> host-sync
+    return jax.device_get(metrics)
+
+
+def step_impl(x: jax.Array, lr: float):
+    z = jnp.dot(x, x)
+    n = int(z)                      # retrace-coerce
+    w = np.abs(z)                   # retrace-np
+    if z > 0:                       # retrace-branch
+        z = z + 1.0
+    h = _helper(z)
+    return z.item() + n + w + h    # retrace-coerce (.item on tracer)
+
+
+def _helper(v: jax.Array):
+    print("loss", v)               # host-sync (transitive, with chain)
+    return jnp.sum(v)
+
+
+fast = jax.jit(lambda x, mode: x, static_argnums=(1,))
+
+
+def run_static(x):
+    # unhashable literal in a static position -> retrace-static
+    return fast(x, [1, 2, 3])
+
+
+def build_programs():
+    progs = []
+    for scale in range(3):
+        # loop var captured by reference -> retrace-late-bind
+        progs.append(jax.jit(lambda x: x * scale))
+    return progs
+
+
+def collectives(x):
+    y = jax.lax.psum(x, "nonaxis")             # mesh-axis-unknown
+    y = jax.lax.ppermute(y, "data",
+                         perm=[(0, 1), (1, 1)])  # mesh-ppermute-perm
+    spec = activation_spec("batch", "bogus")   # mesh-activation-undeclared
+    return constrain(y, "heads"), spec

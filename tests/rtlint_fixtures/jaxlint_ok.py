@@ -1,0 +1,71 @@
+"""jaxlint negative fixture: the same shapes done right — zero active
+findings under all four §4q passes (one deliberate finding is waived,
+proving waiver plumbing covers the new rules).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu._private.xla_watchdog import compile_budget
+from ray_tpu.parallel.mesh import constrain
+
+# --- declarations (stand-ins for lock_watchdog.py / mesh.py) ---------
+STEP_PATHS = {"jaxlint_ok:train_loop", "jaxlint_ok:step_impl"}
+DONATED = {"step_fn": (0,)}
+COMPILE_BUDGETS = {"fixture.step": 1}
+AXES = ("data", "tensor")
+ACTIVATION_RULES = {"batch": "data", "heads": "tensor"}
+
+
+def _impl(state, batch):
+    return state, {"loss": jnp.float32(0)}
+
+
+step_fn = jax.jit(_impl, donate_argnums=(0,))
+fast = jax.jit(lambda x, mode: x, static_argnums=(1,))
+_budget = compile_budget("fixture.step")
+
+
+def train_loop(state, batches):
+    for b in batches:
+        with _budget:
+            state, metrics = step_fn(state, b)   # rebound: donation ok
+    return state, metrics
+
+
+def step_impl(x: jax.Array, flags):
+    if x is None:                   # structure check, not a value read
+        return None
+    if x.shape[0] > 1:              # shape branch is static
+        x = x + 1.0
+    n = int(x.shape[0])             # int() of static metadata
+    pad = np.zeros(n)               # np on host metadata, not a tracer
+    jax.debug.print("x {}", x)      # sanctioned in-trace print
+    y = jax.lax.psum(x, "data")     # declared axis
+    y = jax.lax.ppermute(
+        y, "data", perm=[(d, (d + 1) % 4) for d in range(4)])
+    y = fast(y, (1, 2))             # hashable static arg
+    z = constrain(y, "batch", "heads")   # both rules live
+    return _scratch(z), pad, flags
+
+
+def _scratch(v: jax.Array):
+    # deliberate finding, silenced: proves the waiver plumbing covers
+    # the jaxlint rules end to end
+    return float(jnp.sum(v))  # rtlint: retrace-coerce-ok(fixture waiver-path check)
+
+
+def build_programs():
+    progs = []
+    for scale in range(3):
+        progs.append(jax.jit(lambda x, s=scale: x * s))  # default-bound
+    return progs
+
+
+def bench_loop(state, batches):
+    # NOT in STEP_PATHS: a designed timing sync outside step paths is
+    # legal (bench.py pattern)
+    for b in batches:
+        state, metrics = step_fn(state, b)
+    return jax.device_get(metrics)

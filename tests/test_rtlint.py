@@ -836,3 +836,141 @@ def test_proto_config_channels_match_fsm_declarations():
     sf = load(cfg.fsm_path)
     fsms, _ = load_fsms(sf)
     assert set(cfg.channels) == set(fsms)
+
+
+# ------------------------------------------------ jaxlint (§4q, v4)
+from tools.rtlint.jaxlint import JaxlintConfig, _decl_dict_int_tuples, \
+    check_donation, check_hostsync, check_meshaxes, \
+    check_retrace  # noqa: E402
+from tools.rtlint.jaxlint import \
+    default_config as jaxlint_config  # noqa: E402
+
+
+def _jaxlint_cfg(tag: str) -> JaxlintConfig:
+    """Self-contained config: the fixture file carries its own
+    declaration tables (stand-ins for lock_watchdog.py / mesh.py)."""
+    rel = f"tests/rtlint_fixtures/jaxlint_{tag}.py"
+    p = FIX / f"jaxlint_{tag}.py"
+    sf = load(p)
+    return JaxlintConfig(
+        paths=[p],
+        step_paths=_decl_lines_set(sf, "STEP_PATHS"),
+        donated=_decl_lines_dict(sf, "DONATED"),
+        donated_map=_decl_dict_int_tuples(sf, "DONATED"),
+        compile_budgets=_decl_lines_dict(sf, "COMPILE_BUDGETS"),
+        decl_rel=rel,
+        axes=set(_decl_lines_set(sf, "AXES")),
+        activation_rules=_decl_lines_dict(sf, "ACTIVATION_RULES"),
+        mesh_rel=rel)
+
+
+def _jaxlint_all(cfg: JaxlintConfig):
+    return (check_donation(cfg) + check_retrace(cfg)
+            + check_hostsync(cfg) + check_meshaxes(cfg))
+
+
+def test_jaxlint_flags_positive_fixture():
+    found = _jaxlint_all(_jaxlint_cfg("bad"))
+    assert _rules(found) == {
+        "donate-use-after", "donate-undeclared", "donate-dead",
+        "donate-drift", "compile-budget-undeclared",
+        "compile-budget-dead", "retrace-coerce", "retrace-np",
+        "retrace-branch", "retrace-static", "retrace-late-bind",
+        "host-sync", "step-path-stale", "mesh-axis-unknown",
+        "mesh-ppermute-perm", "mesh-activation-dead",
+        "mesh-activation-undeclared"}, found
+    # the seeded defects come back with their exact diagnostics:
+    # loop-carried use-after-donate names the unrebound binding...
+    assert any(f.rule == "donate-use-after" and "'state'" in f.message
+               and "loop" in f.message for f in found), found
+    # ...tracer int() is located in the step-path function...
+    assert any(f.rule == "retrace-coerce" and "int()" in f.message
+               and "step_impl" in f.message for f in found), found
+    # ...the transitive host sync carries the §4p-style witness chain...
+    assert any(f.rule == "host-sync" and "chain:" in f.message
+               and "_helper" in f.message for f in found), found
+    # ...the bad ppermute names the repeated endpoint...
+    assert any(f.rule == "mesh-ppermute-perm"
+               and "repeats" in f.message for f in found), found
+    # ...and the dead activation rule points at its declaration
+    assert any(f.rule == "mesh-activation-dead"
+               and "'deadrule'" in f.message for f in found), found
+
+
+def test_jaxlint_silent_on_negative_fixture_with_waiver():
+    found = _jaxlint_all(_jaxlint_cfg("ok"))
+    active = _active(found)
+    assert active == [], active
+    # exactly one raw finding exists and the waiver silences it — the
+    # ok fixture proves waiver plumbing covers the jaxlint rules
+    assert _rules(found) == {"retrace-coerce"}, found
+
+
+def test_jaxlint_real_tree_declarations_resolve():
+    """Every STEP_PATHS qual resolves in the real tree (a renamed step
+    function must fail here, not silently drop coverage), and the
+    runtime tables are the static config (static == runtime identity,
+    BLOCK_BOUNDS discipline)."""
+    from ray_tpu._private import lock_watchdog as lw
+    cfg = jaxlint_config(ROOT)
+    found = check_hostsync(cfg)
+    assert not [f for f in found if f.rule == "step-path-stale"], found
+    assert set(cfg.step_paths) == set(lw.STEP_PATHS)
+    assert set(cfg.compile_budgets) == set(lw.COMPILE_BUDGETS)
+    assert set(cfg.donated) == set(lw.DONATED)
+    assert {k: tuple(v) for k, v in cfg.donated_map.items()} == \
+        dict(lw.DONATED)
+    from ray_tpu.parallel import mesh as mesh_lib
+    assert cfg.axes == set(mesh_lib.AXES)
+    assert set(cfg.activation_rules) == set(mesh_lib.ACTIVATION_RULES)
+
+
+def test_jaxlint_rules_in_catalog():
+    """Every rule the jaxlint fixture corpus emits is in --list-rules."""
+    catalog = {rule for rules in RULES.values() for rule, _ in rules}
+    emitted = _rules(_jaxlint_all(_jaxlint_cfg("bad")))
+    assert emitted <= catalog, emitted - catalog
+
+
+# ------------------------------------------------------- SARIF catalog
+def test_sarif_catalog_has_every_rule():
+    """Every registered rule id appears in the SARIF rule catalog with
+    a helpUri into DESIGN.md (CI's upload-sarif step annotates diffs
+    with a link to the contract prose)."""
+    from tools.rtlint.sarif import to_sarif
+    doc = to_sarif([], RULES)
+    driver_rules = doc["runs"][0]["tool"]["driver"]["rules"]
+    by_id = {r["id"]: r for r in driver_rules}
+    declared = {rule for rules in RULES.values() for rule, _ in rules}
+    assert set(by_id) == declared
+    for r in driver_rules:
+        assert r["helpUri"].startswith("DESIGN.md#"), r
+        assert r["shortDescription"]["text"], r
+
+
+# ------------------------------------------------------- waiver audit
+def test_waiver_audit_flags_stale_and_keeps_live(tmp_path):
+    """--waiver-audit: a waiver whose rule no longer fires on its
+    covered lines is a waiver-stale finding; one that still silences a
+    raw finding is kept."""
+    from tools.rtlint import Finding
+    from tools.rtlint.__main__ import audit_waivers
+    # the real tree's waivers must all be live against the real
+    # findings (the burn-down acceptance bar)
+    raw = []
+    for name in PASSES:
+        raw.extend(run_pass(name))
+    stale = audit_waivers(raw)
+    assert stale == [], "\n".join(f.render() for f in stale)
+
+
+def test_waiver_decls_recorded():
+    """SourceFile tracks waiver declaration sites (line, rule, covered
+    lines) for the audit — trailing form covers its own line, block
+    form covers the block plus the next statement."""
+    sf = load(FIX / "jaxlint_ok.py")
+    decls = [(rule, covered) for _, rule, covered in sf.waiver_decls]
+    assert len(decls) == 1
+    rule, covered = decls[0]
+    assert rule == "retrace-coerce"
+    assert len(covered) == 1  # trailing-comment form

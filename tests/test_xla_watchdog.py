@@ -1,0 +1,343 @@
+"""RAY_TPU_XLA_WATCHDOG — the runtime oracle for §4q compute-plane
+hygiene (tools/rtlint/jaxlint.py is the static half).
+
+Unit layer: ``compile_budget`` is a no-op when disabled; armed, it
+raises :class:`XlaHygieneViolation` on a host transfer inside a step
+region (with the transferred shape + acquiring stack) and on
+steady-state recompiles over the declared ``COMPILE_BUDGETS`` ceiling
+(+ ``RAY_TPU_XLA_WATCHDOG_WARMUP``), folding the in-flight overrun
+under the profiler's ``waiting:recompile:<site>`` namespace.
+
+Live layer: the real SPMD train step and the real LLM runner complete
+under the armed oracle with zero violations, while an injected
+per-step recompile (shape churn / bucketing bypass) and an injected
+``device_get`` each raise with an actionable site/stack.  Chaos: a
+SIGKILLed worker mid-workload recovers cleanly with zero violations.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import lock_watchdog as lw
+from ray_tpu._private import xla_watchdog as xw
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats():
+    xw.reset_xla_stats()
+    yield
+    xw.reset_xla_stats()
+
+
+# ------------------------------------------------------------ unit layer
+def test_disabled_is_a_noop(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_XLA_WATCHDOG", raising=False)
+    import jax.numpy as jnp
+    x = jnp.ones((2, 2))
+    with xw.compile_budget("not.even.declared"):
+        # host reads and fresh compiles are all legal when disarmed
+        assert float(np.asarray(x).sum()) == 4.0
+    assert xw.xla_stats() == {}
+
+
+def test_undeclared_site_raises(monkeypatch):
+    """Runtime half of the compile-budget-undeclared identity: an
+    armed region MUST have a COMPILE_BUDGETS row."""
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    with pytest.raises(xw.XlaHygieneViolation, match="not declared"):
+        with xw.compile_budget("no.such.site"):
+            pass
+
+
+def test_transfer_violation_has_shape_and_stack(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    import jax.numpy as jnp
+    x = jnp.ones((2, 3), jnp.float32)
+    with pytest.raises(xw.XlaHygieneViolation) as ei:
+        with xw.compile_budget("train.step"):
+            np.asarray(x)          # implicit device->host pull
+    msg = str(ei.value)
+    assert "train.step" in msg
+    assert "(2, 3)" in msg                       # transferred shape
+    assert "Transfer point" in msg               # acquiring stack...
+    assert "test_xla_watchdog" in msg            # ...pointing here
+    assert xw.xla_stats()["train.step"][1] == 1
+
+
+def test_device_get_inside_region_raises(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((4,))
+    with pytest.raises(xw.XlaHygieneViolation, match="device_get"):
+        with xw.compile_budget("train.step"):
+            jax.device_get(x)
+    # outside any region the same call is a designed sync and legal
+    assert jax.device_get(x).shape == (4,)
+
+
+def test_warmup_then_steady_state_recompile_raises(monkeypatch):
+    """Compiles inside the declared budget + warmup pass; the next
+    distinct program after steady state raises with the site named."""
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG_WARMUP", "2")
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    xs = [jnp.ones((i + 1,)) for i in range(4)]   # built outside
+    budget = xw.compile_budget("train.step", budget=1)
+    for i in range(3):                 # 3 distinct programs <= 1 + 2
+        with budget:
+            f(xs[i])
+    assert xw.xla_stats()["train.step"][0] == 3
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG_WARMUP", "0")
+    with pytest.raises(xw.XlaHygieneViolation) as ei:
+        with budget:
+            f(xs[3])                   # 4th program: steady-state churn
+    assert "train.step" in str(ei.value)
+    assert "retrace" in str(ei.value)  # actionable: points at the pass
+
+
+def test_overrun_folds_into_profiler_namespace(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.util import profiler
+
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    x = jnp.ones((7,))
+    budget = xw.compile_budget("train.step", budget=0)
+    with pytest.raises(xw.XlaHygieneViolation):
+        with budget:
+            f(x)   # compile 1 > budget 0: in-flight overrun
+            assert profiler._WAITING[threading.get_ident()] == \
+                "recompile:train.step"
+    # the synthetic frame clears with the region
+    assert threading.get_ident() not in profiler._WAITING
+
+
+def test_real_failure_is_not_masked_by_overrun(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x - 1
+
+    budget = xw.compile_budget("train.step", budget=0)
+    with pytest.raises(ValueError, match="the real failure"):
+        with budget:
+            f(jnp.ones((9,)))
+            raise ValueError("the real failure")
+
+
+def test_budget_tables_match_static_config():
+    """Static == runtime identity, BLOCK_BOUNDS discipline: jaxlint
+    parses the SAME declarations the oracle enforces."""
+    from tools.rtlint import REPO_ROOT
+    from tools.rtlint.jaxlint import default_config
+    cfg = default_config(REPO_ROOT)
+    assert set(cfg.compile_budgets) == set(lw.COMPILE_BUDGETS)
+    assert set(cfg.step_paths) == set(lw.STEP_PATHS)
+    assert {k: tuple(v) for k, v in cfg.donated_map.items()} == \
+        dict(lw.DONATED)
+
+
+# ------------------------------------------------------------ live train
+def _tiny_train_program(loss_fn=None):
+    import jax
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import spmd
+    from ray_tpu.parallel.mesh import MeshConfig
+    cfg = gpt2.tiny()
+    prog = spmd.build_train_program(
+        loss_fn=loss_fn or (lambda p, b: gpt2.loss_fn(p, b, cfg)),
+        init_params_fn=lambda rng: gpt2.init_params(rng, cfg),
+        optimizer=spmd.default_optimizer(lr=1e-2, warmup=1,
+                                         total_steps=50),
+        mesh_config=MeshConfig(data=8))
+    state = prog.init_fn(jax.random.key(0))
+    toks = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 33)).astype(np.int32)
+    batch = spmd.shard_batch(prog, {"tokens": toks})
+    return prog, state, batch, cfg
+
+
+def test_live_train_step_zero_violations(monkeypatch):
+    """The real SPMD train step under the armed oracle: N steady-state
+    steps, ONE compile, zero transfer violations — the caller-side
+    device_get of the metrics stays outside the region and legal."""
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    import jax
+    prog, state, batch, _cfg = _tiny_train_program()
+    for _ in range(3):
+        state, m = prog.step_fn(state, batch)
+    assert float(jax.device_get(m["loss"])) > 0    # designed sync: legal
+    compiles, transfers = xw.xla_stats()["train.step"]
+    assert compiles == 1, xw.xla_stats()
+    assert transfers == 0
+
+
+def test_live_train_injected_recompile_raises(monkeypatch):
+    """Shape churn on the step input — the retrace bug class — raises
+    at the region with the site named instead of silently halving MFU."""
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    from ray_tpu.parallel import spmd
+    prog, state, batch, _cfg = _tiny_train_program()
+    state, _ = prog.step_fn(state, batch)          # the one program
+    churned = spmd.shard_batch(
+        prog, {"tokens": np.asarray(
+            np.random.default_rng(1).integers(0, 64, (8, 17)),
+            np.int32)})
+    with pytest.raises(xw.XlaHygieneViolation, match="train.step"):
+        prog.step_fn(state, churned)               # distinct program #2
+
+
+def test_live_train_injected_device_get_raises(monkeypatch):
+    """A host pull inside the traced step (the hidden-sync bug class)
+    raises with the site + transfer stack."""
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    import jax
+    from ray_tpu.models import gpt2
+    cfg_holder = {}
+
+    def bad_loss(p, b):
+        jax.device_get(b["tokens"])    # host sync at trace time
+        return gpt2.loss_fn(p, b, cfg_holder["cfg"])
+
+    import ray_tpu.models.gpt2 as _g
+    cfg_holder["cfg"] = _g.tiny()
+    prog, state, batch, _cfg = _tiny_train_program(loss_fn=bad_loss)
+    with pytest.raises(xw.XlaHygieneViolation,
+                       match="train.step") as ei:
+        prog.step_fn(state, batch)
+    assert "device_get" in str(ei.value)
+
+
+# ----------------------------------------------------------- live engine
+def _engine_cfg(**kw):
+    from ray_tpu.serve.llm import EngineConfig
+    base = dict(model="gpt2:tiny", num_blocks=64, block_size=8,
+                max_num_seqs=4, max_model_len=64, max_prefill_tokens=32,
+                prefill_len_buckets=(16, 32, 64),
+                decode_batch_buckets=(1, 2, 4),
+                share_weights=False)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def test_live_engine_zero_violations(monkeypatch):
+    """The real LLM engine under the armed oracle: a request storm
+    completes with compiles bounded by the bucket space and zero
+    transfer violations (the runner's np.asarray pulls are outside the
+    regions by construction)."""
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    from ray_tpu.serve.llm import LLMEngine, SamplingParams
+    eng = LLMEngine(_engine_cfg())
+    try:
+        rng = np.random.default_rng(3)
+        streams = [eng.submit(
+            rng.integers(1, 100, size=int(rng.integers(3, 12))).tolist(),
+            SamplingParams(max_tokens=4)) for _ in range(4)]
+        assert all(len(s.tokens()) == 4 for s in streams)
+    finally:
+        eng.shutdown()
+    stats = xw.xla_stats()
+    pf_compiles, pf_transfers = stats["llm.prefill"]
+    dc_compiles, dc_transfers = stats["llm.decode"]
+    assert pf_compiles == 1 and pf_transfers == 0, stats
+    assert 1 <= dc_compiles <= 3 and dc_transfers == 0, stats
+
+
+def test_engine_injected_recompile_raises(monkeypatch):
+    """Bypassing the length bucketing (the PR-6 bucketing-edge bug
+    class) makes every prompt length a distinct program — the prefill
+    budget trips instead of compiling forever."""
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    from ray_tpu.serve.llm import model_runner as mr
+    monkeypatch.setattr(mr, "_bucket", lambda n, buckets: n)
+    runner = mr.ModelRunner(_engine_cfg())
+    with pytest.raises(xw.XlaHygieneViolation) as ei:
+        for n in (3, 5, 7, 9):     # budget = len(buckets) = 3
+            runner.prefill(list(range(1, n + 1)))
+    assert "llm.prefill" in str(ei.value)
+    assert "COMPILE_BUDGETS" in str(ei.value)
+
+
+def test_engine_injected_device_get_raises(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    import jax
+    from ray_tpu.serve.llm import model_runner as mr
+    runner = mr.ModelRunner(_engine_cfg())
+    orig = runner._prefill
+    runner._prefill = lambda *a, **kw: jax.device_get(orig(*a, **kw))
+    with pytest.raises(xw.XlaHygieneViolation,
+                       match="llm.prefill") as ei:
+        runner.prefill([1, 2, 3])
+    assert "device_get" in str(ei.value)
+
+
+# ----------------------------------------------------------------- chaos
+def test_chaos_workload_under_xla_watchdog(ray_start_regular_env):
+    """Worker SIGKILL mid-workload with the oracle armed in every
+    worker: retried tasks re-enter their compile_budget regions on
+    fresh processes and the workload completes with zero violations
+    (any XlaHygieneViolation would fail the task past its retries)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(max_retries=-1)
+    def work(i):
+        os.environ["RAY_TPU_XLA_WATCHDOG"] = "1"
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu._private import xla_watchdog as wxw
+
+        # stats are process-global and a worker process serves many
+        # tasks (each building a fresh jit) — scope them to this task
+        wxw.reset_xla_stats()
+        f = jax.jit(lambda x: x * 2.0)
+        x = jnp.float32(i)           # built OUTSIDE the region
+        budget = wxw.compile_budget("train.step", budget=1)
+        out = 0.0
+        for _ in range(3):
+            with budget:
+                y = f(x)
+            out = float(y)           # pull OUTSIDE the region
+        compiles, transfers = wxw.xla_stats()["train.step"]
+        assert compiles <= 1 and transfers == 0
+        return out
+
+    assert ray_tpu.get([work.remote(i) for i in range(6)],
+                       timeout=180) == [i * 2.0 for i in range(6)]
+    victims = [w for w in state.list_workers()
+               if w["state"] in ("busy", "actor", "idle")
+               and w["pid"] != os.getpid()]
+    assert victims, "no worker to kill"
+    os.kill(victims[0]["pid"], signal.SIGKILL)
+    assert ray_tpu.get([work.remote(i) for i in range(6)],
+                       timeout=180) == [i * 2.0 for i in range(6)]
+
+
+@pytest.fixture
+def ray_start_regular_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_XLA_WATCHDOG", "1")
+    import ray_tpu
+    ray_tpu.init(num_cpus=2)
+    try:
+        yield
+    finally:
+        ray_tpu.shutdown()

@@ -77,6 +77,10 @@ class SourceFile:
         # covers the block AND the first statement line after it, so a
         # reason can span several comment lines.
         self.waivers: Dict[int, set] = {}
+        # declaration sites for --waiver-audit: (decl line, rule,
+        # covered lines) per waiver comment, so the audit can prove a
+        # waiver still silences at least one raw finding.
+        self.waiver_decls: List[tuple] = []
         n = len(self.lines)
         i = 0
         while i < n:
@@ -88,13 +92,18 @@ class SourceFile:
                 continue
             if not line.lstrip().startswith("#"):
                 self.waivers.setdefault(i + 1, set()).update(rules)
+                for r in rules:
+                    self.waiver_decls.append((i + 1, r, (i + 1,)))
                 i += 1
                 continue
             j = i
             while j + 1 < n and self.lines[j + 1].lstrip().startswith("#"):
                 j += 1
-            for k in range(i + 1, j + 3):  # block lines + next statement
+            covered = tuple(range(i + 1, j + 3))
+            for k in covered:  # block lines + next statement
                 self.waivers.setdefault(k, set()).update(rules)
+            for r in rules:
+                self.waiver_decls.append((i + 1, r, covered))
             i = j + 1
 
     def waived(self, line: int, rule: str) -> bool:

@@ -1,9 +1,14 @@
 """Driver: ``python -m tools.rtlint [--pass NAME ...] [--show-waived]
-[--list-rules] [--sarif OUT] [--changed-only]``.
+[--list-rules] [--sarif OUT] [--changed-only] [--waiver-audit]``.
 
-Runs the nine passes over the real tree (see each pass module for
+Runs the thirteen passes over the real tree (see each pass module for
 what it enforces), prints ``file:line rule-id message`` per finding,
 and exits non-zero when any unwaived finding remains.
+
+``--waiver-audit`` additionally fails on stale waivers — a
+``# rtlint: <rule>-ok(...)`` that no longer silences any raw finding
+on its covered lines (CI runs this so dead waivers get deleted before
+they can swallow a future regression).
 
 ``--sarif OUT`` additionally writes the active findings as SARIF
 2.1.0 (CI uploads it so findings annotate PR diffs).
@@ -30,7 +35,13 @@ from typing import Dict, List, Optional, Set
 from tools.rtlint import REPO_ROOT, Finding, SourceFile, load
 
 PASSES = ("locks", "guarded", "wire", "threads", "metrics",
-          "resources", "replies", "blocking", "protostate")
+          "resources", "replies", "blocking", "protostate",
+          "donation", "retrace", "hostsync", "meshaxes")
+
+# --waiver-audit scope: product code only.  tools/ and tests/ contain
+# the waiver syntax in docstrings/fixtures by design, which the
+# line-based waiver scanner cannot tell from real waivers.
+_AUDIT_PREFIXES = ("ray_tpu/", "bench.py", "benchmarks/")
 
 # --changed-only: repo-relative prefixes that feed each pass.  A pass
 # runs iff some changed path starts with one of its prefixes (the
@@ -49,6 +60,20 @@ PASS_SCOPES: Dict[str, tuple] = {
     "blocking": ("ray_tpu/_private/", "ray_tpu/serve/",
                  "ray_tpu/elastic/"),
     "protostate": ("ray_tpu/_private/",),
+    # jaxlint (§4q): compute-plane inputs + the declaration tables in
+    # lock_watchdog.py / the runtime oracle they must stay 1:1 with
+    "donation": ("ray_tpu/ops/", "ray_tpu/models/", "ray_tpu/parallel/",
+                 "ray_tpu/serve/llm/", "bench.py", "benchmarks/",
+                 "ray_tpu/_private/lock_watchdog.py",
+                 "ray_tpu/_private/xla_watchdog.py"),
+    "retrace": ("ray_tpu/ops/", "ray_tpu/models/", "ray_tpu/parallel/",
+                "ray_tpu/serve/llm/", "bench.py", "benchmarks/",
+                "ray_tpu/_private/lock_watchdog.py"),
+    "hostsync": ("ray_tpu/ops/", "ray_tpu/models/", "ray_tpu/parallel/",
+                 "ray_tpu/serve/llm/", "bench.py", "benchmarks/",
+                 "ray_tpu/_private/lock_watchdog.py"),
+    "meshaxes": ("ray_tpu/ops/", "ray_tpu/models/", "ray_tpu/parallel/",
+                 "ray_tpu/serve/llm/", "bench.py", "benchmarks/"),
 }
 
 # pass -> (rule id, one-line contract) — the --list-rules catalog
@@ -138,7 +163,63 @@ RULES: Dict[str, List] = {
         ("proto-unreachable", "every declared FSM state is reachable "
                               "somewhere in the version matrix"),
     ],
+    "donation": [
+        ("donate-use-after", "no read of a donated binding after the "
+                             "donating call on any path (incl. the "
+                             "next loop iteration)"),
+        ("donate-undeclared", "every jit with donate_argnums binds a "
+                              "name declared in lock_watchdog.DONATED"),
+        ("donate-dead", "no DONATED entry without a live donating jit "
+                        "site"),
+        ("donate-drift", "literal donation maps match the declared "
+                         "argnums"),
+        ("compile-budget-undeclared", "every compile_budget site has a "
+                                      "declared ceiling in "
+                                      "COMPILE_BUDGETS"),
+        ("compile-budget-dead", "no COMPILE_BUDGETS entry without a "
+                                "live compile_budget site (static == "
+                                "runtime oracle identity)"),
+    ],
+    "retrace": [
+        ("retrace-coerce", "no int()/float()/bool()/.item() on "
+                           "tracer-derived values in STEP_PATHS-"
+                           "reachable functions"),
+        ("retrace-np", "no np.* applied to traced values on step "
+                       "paths"),
+        ("retrace-branch", "no value-dependent Python branch on "
+                           "tracer-derived data on step paths"),
+        ("retrace-static", "no unhashable/per-call-fresh literal in a "
+                           "static jit argument position"),
+        ("retrace-late-bind", "no closure built in a loop captures the "
+                              "loop variable by reference into a trace "
+                              "entry point"),
+    ],
+    "hostsync": [
+        ("host-sync", "STEP_PATHS functions are transitively free of "
+                      "device_get/block_until_ready/print (witness "
+                      "chain on violation)"),
+        ("step-path-stale", "every STEP_PATHS entry resolves to a live "
+                            "function in the jaxlint scope"),
+    ],
+    "meshaxes": [
+        ("mesh-axis-unknown", "every literal collective axis_name / "
+                              "PartitionSpec axis exists in "
+                              "parallel/mesh.py AXES"),
+        ("mesh-ppermute-perm", "ppermute perms are true permutations "
+                               "(literals proven, ring comprehensions "
+                               "proven by shape)"),
+        ("mesh-activation-dead", "no ACTIVATION_RULES entry without a "
+                                 "live activation_spec()/constrain() "
+                                 "use"),
+        ("mesh-activation-undeclared", "no activation_spec()/"
+                                       "constrain() use names an "
+                                       "undeclared rule"),
+    ],
 }
+
+# --waiver-audit: rule-id prefix families a waiver token covers (the
+# ``blocks-ok`` form silences every ``block-*`` rule at once).
+_WAIVER_FAMILIES = {"blocks": "block-"}
 
 
 def run_pass(name: str) -> List[Finding]:
@@ -232,7 +313,61 @@ def run_pass(name: str) -> List[Finding]:
     if name == "protostate":
         from tools.rtlint.protostate import default_check
         return default_check(REPO_ROOT)
+    if name == "donation":
+        from tools.rtlint.jaxlint import default_check_donation
+        return default_check_donation(REPO_ROOT)
+    if name == "retrace":
+        from tools.rtlint.jaxlint import default_check_retrace
+        return default_check_retrace(REPO_ROOT)
+    if name == "hostsync":
+        from tools.rtlint.jaxlint import default_check_hostsync
+        return default_check_hostsync(REPO_ROOT)
+    if name == "meshaxes":
+        from tools.rtlint.jaxlint import default_check_meshaxes
+        return default_check_meshaxes(REPO_ROOT)
     raise SystemExit(f"unknown pass {name!r}")
+
+
+def audit_waivers(all_findings: List[Finding]) -> List[Finding]:
+    """``--waiver-audit``: a waiver declaration is stale when no RAW
+    (pre-waiver) finding of its rule (or rule family) lands on a line
+    it covers — the hazard it silenced is gone, so the waiver must go
+    too before it silently swallows a future regression."""
+    fired: Dict[str, Dict[int, Set[str]]] = {}
+    for f in all_findings:
+        fired.setdefault(f.path, {}).setdefault(f.line, set()).add(f.rule)
+    out: List[Finding] = []
+    paths = sorted(
+        p for p in (REPO_ROOT / "ray_tpu").rglob("*.py")) + [
+        REPO_ROOT / "bench.py"] + sorted(
+        (REPO_ROOT / "benchmarks").glob("*.py"))
+    for p in paths:
+        if not p.exists():
+            continue
+        try:
+            sf = load(p)
+        except SyntaxError:
+            continue
+        if not sf.waiver_decls:
+            continue
+        by_line = fired.get(sf.rel, {})
+        for decl_line, rule, covered in sf.waiver_decls:
+            prefix = _WAIVER_FAMILIES.get(rule)
+            hit = False
+            for ln in covered:
+                for r in by_line.get(ln, ()):
+                    if r == rule or (prefix and r.startswith(prefix)):
+                        hit = True
+                        break
+                if hit:
+                    break
+            if not hit:
+                out.append(Finding(
+                    sf.rel, decl_line, "waiver-stale",
+                    f"waiver '{rule}-ok' no longer silences any "
+                    f"finding on its covered lines — delete it (a "
+                    f"dead waiver hides the next real regression)"))
+    return out
 
 
 def changed_paths() -> Optional[Set[str]]:
@@ -309,7 +444,15 @@ def main(argv=None) -> int:
                     help="scope to git-changed files (skip passes "
                          "whose inputs are untouched; falls back to "
                          "the full tree when summaries are stale)")
+    ap.add_argument("--waiver-audit", action="store_true",
+                    help="fail on stale waivers: run every pass over "
+                         "the full tree and flag waiver comments that "
+                         "no longer silence any finding")
     args = ap.parse_args(argv)
+    if args.waiver_audit:
+        # staleness is a whole-tree property: every pass, full scope
+        args.passes = None
+        args.changed_only = False
     if args.list_rules:
         for pname in args.passes or PASSES:
             for rule, contract in RULES[pname]:
@@ -337,6 +480,8 @@ def main(argv=None) -> int:
         all_findings.extend(found)
     elapsed = time.monotonic() - t0
     active, waived = filter_waived(all_findings)
+    if args.waiver_audit:
+        active.extend(audit_waivers(all_findings))
     for f in sorted(active):
         print(f.render())
     if args.show_waived:

@@ -248,12 +248,21 @@ def _nested_defs(body):
 
 
 class CallGraph:
-    def __init__(self):
+    """Interprocedural call graph + site summaries.
+
+    ``classifier`` maps an ``ast.Call`` to a :class:`Site` or None —
+    the blocking pass classifies blocking primitives (the default);
+    the jaxlint host-sync pass plugs in a host-transfer classifier and
+    reuses the resolution/fixed-point/witness machinery unchanged.
+    """
+
+    def __init__(self, classifier=None):
         self.funcs: Dict[str, FuncNode] = {}
         self.by_name: Dict[str, List[str]] = {}
         # module -> {local alias -> module key} import map
         self.imports: Dict[str, Dict[str, str]] = {}
         self.modules: Set[str] = set()
+        self._classify = classifier or classify_call
 
     def add_file(self, sf: SourceFile, module: str) -> None:
         self.modules.add(module)
@@ -276,7 +285,7 @@ class CallGraph:
             self.by_name.setdefault(fn.name, []).append(qual)
             for sub in _own_nodes(fn.body):
                 if isinstance(sub, ast.Call):
-                    s = classify_call(sub, sf.rel)
+                    s = self._classify(sub, sf.rel)
                     if s is not None:
                         node_.direct.append(s)
                     else:

@@ -1,0 +1,883 @@
+"""Passes 10-13: compute-plane "jaxlint" (DESIGN.md §4q).
+
+Interprocedural analysis over ``ray_tpu/ops/``, ``ray_tpu/models/``,
+``ray_tpu/parallel/``, ``ray_tpu/serve/llm/`` and the ``bench.py`` /
+``benchmarks/train_bench.py`` step closures, reusing the §4p
+call-graph / fixed-point machinery (``blocking.CallGraph`` with a
+pluggable site classifier).  Four passes:
+
+- **donation**: every ``jax.jit``/``pjit`` carrying ``donate_argnums``
+  is pinned to a row in ``lock_watchdog.DONATED`` (``donate-undeclared``
+  / ``donate-dead``), literal donation maps may not drift from the
+  declared one (``donate-drift``), and no caller may read a donated
+  binding after the donating call on any path — including re-passing
+  it on the next loop iteration (``donate-use-after``).  The
+  ``compile_budget("<site>")`` <-> ``COMPILE_BUDGETS`` identity rides
+  here too (``compile-budget-undeclared`` / ``compile-budget-dead``,
+  the BLOCK_BOUNDS discipline applied to the XLA watchdog).
+- **retrace**: recompile hazards in functions reachable from
+  ``lock_watchdog.STEP_PATHS``: Python coercions of tracer-derived
+  values (``int()``/``float()``/``bool()``/``.item()``,
+  ``retrace-coerce``), ``np.*`` applied to traced values
+  (``retrace-np``), value-dependent Python branches on tracer-derived
+  data (``retrace-branch``; ``is None`` structure checks and
+  ``.shape``/``.dtype``-derived tests are static and exempt),
+  unhashable literals in static-arg positions (``retrace-static``),
+  and late-binding loop-variable captures flowing into a trace entry
+  (``retrace-late-bind`` — the train_bench bug class fixed in PR 12:
+  a closure built in a loop must bind loop vars as argument defaults).
+- **hostsync**: every STEP_PATHS function is TRANSITIVELY free of
+  ``device_get`` / ``block_until_ready`` / ``print`` (``jax.debug.print``
+  is the sanctioned in-trace print), with the §4p-style witness chain
+  in the finding (``host-sync``); stale entries are findings on the
+  declaring line (``step-path-stale``).
+- **meshaxes**: every literal collective ``axis_name`` and every
+  literal ``PartitionSpec``/``shard_map`` axis must exist in
+  ``parallel/mesh.py`` AXES (``mesh-axis-unknown``); literal/ring
+  ``ppermute`` perms must be true permutations
+  (``mesh-ppermute-perm``); ``ACTIVATION_RULES`` and
+  ``activation_spec()``/``constrain()`` uses must agree both ways
+  (``mesh-activation-dead`` / ``mesh-activation-undeclared`` — the
+  metrics-catalog discipline applied to activation placement).
+
+Taint model (retrace): a value is tracer-derived if it flows from a
+parameter annotated as an array (``jax.Array``/``Params``/...), from a
+``jnp.``/``lax.``/``jax.nn.`` call, or from arithmetic/indexing/method
+calls on such values.  ``.shape``/``.ndim``/``.dtype``/``.size``
+reads, ``len()``, and ``is (not) None`` checks are static and
+sanitize.  The model is deliberately under-approximate — no finding
+fires on values the analysis cannot prove tracer-derived.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from tools.rtlint import Finding, SourceFile, dotted_name, load
+from tools.rtlint.blocking import CallGraph, Site, _decl_lines_dict, \
+    _decl_lines_set, _own_nodes
+
+# ---------------------------------------------------------------- config
+
+# parameter annotations that mark tracer inputs (whole-token match on
+# the rendered annotation, so Optional[jax.Array] counts but
+# SamplingParams does not match Params)
+import re as _re
+_TRACER_ANNOT_RE = _re.compile(
+    r"(?<![\w.])(jax\.Array|jnp\.ndarray|chex\.Array|Params)(?![\w])")
+
+# dotted-call prefixes whose results are traced arrays
+_TRACER_CALL_PREFIXES = ("jnp.", "lax.", "jax.lax.", "jax.nn.",
+                        "jax.numpy.", "jax.random.")
+
+# attribute reads that return static (host) metadata, not tracers
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "at"})
+
+# collective -> positional index of its axis-name argument
+_COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+                "ppermute": 1, "all_gather": 1, "all_to_all": 1,
+                "psum_scatter": 1, "pshuffle": 1, "axis_index": 0}
+
+# callables a loop-built closure may flow into and get traced later
+_TRACE_ENTRIES = frozenset({"jit", "pjit", "build_train_program",
+                            "shard_map", "checkpoint"})
+
+
+class JaxlintConfig(NamedTuple):
+    paths: List[Path]              # analysis scope (module key = stem)
+    step_paths: Dict[str, int]     # qual -> declaring line
+    donated: Dict[str, int]        # donating callable -> declaring line
+    donated_map: Dict[str, Tuple[int, ...]]  # callable -> argnums
+    compile_budgets: Dict[str, int]  # site -> declaring line
+    decl_rel: str                  # file the three tables live in
+    axes: Set[str]                 # parallel/mesh.py AXES
+    activation_rules: Dict[str, int]  # rule name -> declaring line
+    mesh_rel: str                  # file ACTIVATION_RULES lives in
+
+
+def _decl_dict_int_tuples(sf: SourceFile,
+                          varname: str) -> Dict[str, Tuple[int, ...]]:
+    """{key: literal int-tuple value} for a module-level dict."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == varname
+                   for t in targets):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    continue
+                try:
+                    val = ast.literal_eval(v)
+                except (ValueError, SyntaxError):
+                    continue
+                if isinstance(val, int):
+                    val = (val,)
+                if isinstance(val, tuple) and \
+                        all(isinstance(x, int) for x in val):
+                    out[k.value] = val
+    return out
+
+
+def default_config(root: Path) -> JaxlintConfig:
+    paths = sorted((root / "ray_tpu" / "ops").glob("*.py")) \
+        + sorted((root / "ray_tpu" / "models").glob("*.py")) \
+        + sorted((root / "ray_tpu" / "parallel").glob("*.py")) \
+        + sorted((root / "ray_tpu" / "serve" / "llm").glob("*.py")) \
+        + [root / "bench.py", root / "benchmarks" / "train_bench.py"]
+    paths = [p for p in paths if p.name != "__init__.py" and p.exists()]
+    lw_sf = load(root / "ray_tpu" / "_private" / "lock_watchdog.py")
+    mesh_sf = load(root / "ray_tpu" / "parallel" / "mesh.py")
+    return JaxlintConfig(
+        paths=paths,
+        step_paths=_decl_lines_set(lw_sf, "STEP_PATHS"),
+        donated=_decl_lines_dict(lw_sf, "DONATED"),
+        donated_map=_decl_dict_int_tuples(lw_sf, "DONATED"),
+        compile_budgets=_decl_lines_dict(lw_sf, "COMPILE_BUDGETS"),
+        decl_rel=lw_sf.rel,
+        axes=set(_decl_lines_set(mesh_sf, "AXES")),
+        activation_rules=_decl_lines_dict(mesh_sf, "ACTIVATION_RULES"),
+        mesh_rel=mesh_sf.rel)
+
+
+def _load_scope(cfg: JaxlintConfig) -> List[SourceFile]:
+    out = []
+    for p in cfg.paths:
+        try:
+            out.append(load(p))
+        except (SyntaxError, OSError):
+            continue
+    return out
+
+
+def _null_classifier(call: ast.Call, rel: str) -> Optional[Site]:
+    return None
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    last = dotted_name(node.func).rsplit(".", 1)[-1]
+    return last in ("jit", "pjit")
+
+
+def _kwarg(node: ast.Call, name: str):
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+# ================================================================ donation
+def check_donation(cfg: JaxlintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    bound_donors: Dict[str, Tuple[str, int]] = {}   # name -> site
+    budget_sites: Dict[str, Tuple[str, int]] = {}   # site -> first use
+
+    for sf in _load_scope(cfg):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            last = dotted_name(node.func).rsplit(".", 1)[-1]
+            if last == "compile_budget" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                budget_sites.setdefault(node.args[0].value,
+                                        (sf.rel, node.lineno))
+            if not _is_jit_call(node):
+                continue
+            dk = _kwarg(node, "donate_argnums")
+            if dk is None:
+                dk = _kwarg(node, "donate_argnames")
+            if dk is None:
+                continue
+            # bound name: `step_fn = jax.jit(...)`
+            bound = None
+            parent = _jit_assign_target(sf.tree, node)
+            if parent is not None:
+                bound = parent
+            if bound is None:
+                findings.append(Finding(
+                    sf.rel, node.lineno, "donate-undeclared",
+                    "donating jit result is not bound to a name — "
+                    "bind it and declare the name in "
+                    "lock_watchdog.DONATED so callers are checked "
+                    "for use-after-donate"))
+                continue
+            bound_donors.setdefault(bound, (sf.rel, node.lineno))
+            if bound not in cfg.donated:
+                findings.append(Finding(
+                    sf.rel, node.lineno, "donate-undeclared",
+                    f"jit with donate_argnums bound to {bound!r} has "
+                    f"no row in lock_watchdog.DONATED"))
+                continue
+            # literal donation map must not drift from the declaration
+            try:
+                lit = ast.literal_eval(dk)
+            except (ValueError, SyntaxError):
+                lit = None
+            if lit is not None:
+                lit = (lit,) if isinstance(lit, int) else tuple(lit)
+                declared = set(cfg.donated_map.get(bound, ()))
+                extra = [a for a in lit if a not in declared]
+                if extra:
+                    findings.append(Finding(
+                        sf.rel, node.lineno, "donate-drift",
+                        f"jit site donates argnums {sorted(lit)} but "
+                        f"DONATED[{bound!r}] declares "
+                        f"{sorted(declared)} — update the declaration "
+                        f"or the site"))
+
+    for name, decl_line in sorted(cfg.donated.items()):
+        if name not in bound_donors:
+            findings.append(Finding(
+                cfg.decl_rel, decl_line, "donate-dead",
+                f"DONATED declares {name!r} but no jit site with "
+                f"donate_argnums binds that name"))
+
+    # --- use-after-donate over every function in scope ---------------
+    for sf in _load_scope(cfg):
+        for fn in _walk_funcs(sf.tree):
+            findings.extend(_use_after_donate(sf, fn, cfg))
+
+    # --- compile_budget <-> COMPILE_BUDGETS identity -----------------
+    for site, (rel, line) in sorted(budget_sites.items()):
+        if site not in cfg.compile_budgets:
+            findings.append(Finding(
+                rel, line, "compile-budget-undeclared",
+                f"compile_budget site {site!r} has no declared ceiling "
+                f"in lock_watchdog.COMPILE_BUDGETS"))
+    for site, decl_line in sorted(cfg.compile_budgets.items()):
+        if site not in budget_sites:
+            findings.append(Finding(
+                cfg.decl_rel, decl_line, "compile-budget-dead",
+                f"COMPILE_BUDGETS declares {site!r} but no "
+                f"compile_budget call site uses it"))
+    return findings
+
+
+def _jit_assign_target(tree: ast.AST, call: ast.Call) -> Optional[str]:
+    """Name a `x = jax.jit(...)` result is bound to, else None."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    return t.id
+        if isinstance(node, ast.AnnAssign) and node.value is call and \
+                isinstance(node.target, ast.Name):
+            return node.target.id
+    return None
+
+
+def _walk_funcs(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _use_after_donate(sf: SourceFile, fn, cfg: JaxlintConfig
+                      ) -> List[Finding]:
+    findings: List[Finding] = []
+    # parent links for loop-ancestor checks, own statements only
+    parents: Dict[ast.AST, ast.AST] = {}
+    own = list(_own_nodes(fn.body))
+    own_ids = {id(n) for n in own}
+    for node in own:
+        for child in ast.iter_child_nodes(node):
+            if id(child) in own_ids or isinstance(child, ast.expr):
+                parents.setdefault(child, node)
+
+    def loop_ancestor(node):
+        cur = parents.get(node)
+        seen = 0
+        while cur is not None and seen < 500:
+            if isinstance(cur, (ast.For, ast.While)):
+                return cur
+            cur = parents.get(cur)
+            seen += 1
+        return None
+
+    # name -> sorted store/load linenos (own statements only)
+    stores: Dict[str, List[int]] = {}
+    loads: Dict[str, List[int]] = {}
+    for node in own:
+        if isinstance(node, ast.Name):
+            d = stores if isinstance(node.ctx, ast.Store) else loads
+            d.setdefault(node.id, []).append(node.lineno)
+
+    for node in own:
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func).rsplit(".", 1)[-1]
+        if callee not in cfg.donated:
+            continue
+        argnums = cfg.donated_map.get(callee, (0,))
+        donated_vars = [a.id for i, a in enumerate(node.args)
+                        if i in argnums and isinstance(a, ast.Name)]
+        if not donated_vars:
+            continue
+        # rebound by the call's own assignment?
+        assign = parents.get(node)
+        rebound: Set[str] = set()
+        if isinstance(assign, ast.Assign):
+            for t in assign.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        rebound.add(sub.id)
+        for var in donated_vars:
+            if var in rebound:
+                continue
+            loop = loop_ancestor(node)
+            if loop is not None:
+                findings.append(Finding(
+                    sf.rel, node.lineno, "donate-use-after",
+                    f"{var!r} is donated to {callee}() inside a loop "
+                    f"without being rebound — the next iteration "
+                    f"re-reads a donated (freed) buffer; bind the "
+                    f"result back to {var!r}"))
+                continue
+            later_loads = [ln for ln in loads.get(var, ())
+                           if ln > node.lineno]
+            if not later_loads:
+                continue
+            first = min(later_loads)
+            restored = any(node.lineno < s <= first
+                           for s in stores.get(var, ()))
+            if not restored:
+                findings.append(Finding(
+                    sf.rel, first, "donate-use-after",
+                    f"{var!r} was donated to {callee}() at line "
+                    f"{node.lineno} and read again here — its buffer "
+                    f"is aliased to the output; rebind or drop the "
+                    f"read"))
+    return findings
+
+
+# ================================================================= retrace
+def _annot_str(node) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+class _Taint:
+    """Intra-function tracer-taint computation (see module docstring)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.names: Set[str] = set()
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            if _TRACER_ANNOT_RE.search(_annot_str(a.annotation)):
+                self.names.add(a.arg)
+        self._fixed_point()
+
+    def _fixed_point(self) -> None:
+        for _ in range(8):
+            changed = False
+            for node in _own_nodes(self.fn.body):
+                tgt = None
+                if isinstance(node, ast.Assign):
+                    tgt, val = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    tgt, val = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    tgt, val = [node.target], node.value
+                elif isinstance(node, ast.For):
+                    # for t in <tainted iter>: t is tainted
+                    if self.tainted(node.iter):
+                        for sub in ast.walk(node.target):
+                            if isinstance(sub, ast.Name) and \
+                                    sub.id not in self.names:
+                                self.names.add(sub.id)
+                                changed = True
+                    continue
+                else:
+                    continue
+                if not self.tainted(val):
+                    continue
+                for t in tgt:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name) and \
+                                sub.id not in self.names:
+                            self.names.add(sub.id)
+                            changed = True
+            if not changed:
+                return
+
+    def tainted(self, node) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.tainted(node.value)
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name.startswith(_TRACER_CALL_PREFIXES) or \
+                    name.endswith(".einsum") or name == "einsum":
+                return True
+            last = name.rsplit(".", 1)[-1]
+            if last in ("device_get", "asarray", "array", "item",
+                        "int", "float", "bool", "len", "range"):
+                return False       # host-valued (flagged elsewhere)
+            # method call on a tainted receiver (x.astype, x.reshape)
+            if isinstance(node.func, ast.Attribute):
+                return self.tainted(node.func.value)
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.tainted(node.left) or self.tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` reads structure, not value
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return self.tainted(node.left) or \
+                any(self.tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.tainted(e) for e in node.elts)
+        if isinstance(node, ast.ListComp):
+            return self.tainted(node.elt)
+        if isinstance(node, ast.IfExp):
+            return self.tainted(node.body) or self.tainted(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self.tainted(node.value)
+        return False
+
+
+def _reachable_quals(graph: CallGraph,
+                     roots: List[str]) -> Set[str]:
+    seen: Set[str] = set()
+    work = [q for q in roots if q in graph.funcs]
+    while work:
+        q = work.pop()
+        if q in seen:
+            continue
+        seen.add(q)
+        work.extend(graph.funcs[q].resolved - seen)
+    return seen
+
+
+def check_retrace(cfg: JaxlintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    graph = CallGraph(classifier=_null_classifier)
+    sfs = _load_scope(cfg)
+    # ast index so reachable quals map back to their defs
+    fn_index: Dict[Tuple[str, int], Tuple[SourceFile, ast.AST]] = {}
+    for sf in sfs:
+        graph.add_file(sf, sf.path.stem)
+        for fn in _walk_funcs(sf.tree):
+            fn_index[(sf.rel, fn.lineno)] = (sf, fn)
+    graph.resolve()
+    reach = _reachable_quals(graph, sorted(cfg.step_paths))
+
+    seen: Set[Tuple[str, int, str]] = set()
+
+    def emit(rel, line, rule, msg):
+        key = (rel, line, rule)
+        if key not in seen:
+            seen.add(key)
+            findings.append(Finding(rel, line, rule, msg))
+
+    for qual in sorted(reach):
+        node = graph.funcs[qual]
+        entry = fn_index.get((node.rel, node.lineno))
+        if entry is None:
+            continue
+        sf, fn = entry
+        taint = _Taint(fn)
+        for sub in _own_nodes(fn.body):
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func)
+                last = name.rsplit(".", 1)[-1]
+                if isinstance(sub.func, ast.Name) and \
+                        sub.func.id in ("int", "float", "bool") and \
+                        any(taint.tainted(a) for a in sub.args):
+                    emit(sf.rel, sub.lineno, "retrace-coerce",
+                         f"{sub.func.id}() of a tracer-derived value "
+                         f"in step-path function {qual} forces a "
+                         f"host sync / retrace per call")
+                elif last == "item" and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        taint.tainted(sub.func.value):
+                    emit(sf.rel, sub.lineno, "retrace-coerce",
+                         f".item() on a tracer-derived value in "
+                         f"step-path function {qual}")
+                elif name.split(".", 1)[0] in ("np", "numpy") and \
+                        any(taint.tainted(a) for a in sub.args):
+                    emit(sf.rel, sub.lineno, "retrace-np",
+                         f"{name}() applied to a tracer-derived value "
+                         f"in step-path function {qual} — use the "
+                         f"jnp equivalent (np.* forces a concrete "
+                         f"array and breaks the trace)")
+            elif isinstance(sub, (ast.If, ast.While)) and \
+                    taint.tainted(sub.test):
+                emit(sf.rel, sub.lineno, "retrace-branch",
+                     f"Python branch on tracer-derived data in "
+                     f"step-path function {qual} — the branch bakes "
+                     f"one side into the compiled program (use "
+                     f"jnp.where / lax.cond)")
+            elif isinstance(sub, ast.IfExp) and \
+                    taint.tainted(sub.test):
+                emit(sf.rel, sub.lineno, "retrace-branch",
+                     f"conditional expression on tracer-derived data "
+                     f"in step-path function {qual} (use jnp.where)")
+
+    # --- retrace-static: unhashable literals in static positions -----
+    for sf in sfs:
+        static_map = _static_jit_map(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func).rsplit(".", 1)[-1]
+            spec = static_map.get(callee)
+            if spec is None:
+                continue
+            argnums, argnames = spec
+            bad = []
+            for i, a in enumerate(node.args):
+                if i in argnums and _unhashable_literal(a):
+                    bad.append(a)
+            for kw in node.keywords:
+                if kw.arg in argnames and _unhashable_literal(kw.value):
+                    bad.append(kw.value)
+            for a in bad:
+                findings.append(Finding(
+                    sf.rel, a.lineno, "retrace-static",
+                    f"unhashable/per-call-fresh literal passed in a "
+                    f"static argument of {callee}() — every call "
+                    f"builds a fresh cache key and recompiles"))
+
+    # --- retrace-late-bind: loop-var captures into trace entries -----
+    for sf in sfs:
+        for fn_or_mod in [sf.tree] + list(_walk_funcs(sf.tree)):
+            body = fn_or_mod.body
+            for loop in [n for n in ast.walk(fn_or_mod)
+                         if isinstance(n, (ast.For, ast.While))]:
+                targets: Set[str] = set()
+                if isinstance(loop, ast.For):
+                    for sub in ast.walk(loop.target):
+                        if isinstance(sub, ast.Name):
+                            targets.add(sub.id)
+                if not targets:
+                    continue
+                for call in [n for n in ast.walk(loop)
+                             if isinstance(n, ast.Call)]:
+                    callee = dotted_name(call.func).rsplit(".", 1)[-1]
+                    if callee not in _TRACE_ENTRIES:
+                        continue
+                    closures = [a for a in list(call.args)
+                                + [kw.value for kw in call.keywords]
+                                if isinstance(a, ast.Lambda)]
+                    for lam in closures:
+                        captured = _lambda_free_names(lam) & targets
+                        for name in sorted(captured):
+                            findings.append(Finding(
+                                sf.rel, lam.lineno, "retrace-late-bind",
+                                f"closure passed to {callee}() "
+                                f"captures loop variable {name!r} by "
+                                f"reference — every iteration's "
+                                f"closure sees the LAST value (and "
+                                f"each is a fresh trace key); bind it "
+                                f"as a default: `{name}={name}`"))
+            break  # module scope covers nested loops via ast.walk
+    return findings
+
+
+def _static_jit_map(tree: ast.AST
+                    ) -> Dict[str, Tuple[Set[int], Set[str]]]:
+    """{callable name: (static argnums, static argnames)} from jit
+    assignments and @partial(jax.jit, static_...) decorators."""
+    out: Dict[str, Tuple[Set[int], Set[str]]] = {}
+
+    def spec_from(call: ast.Call):
+        nums: Set[int] = set()
+        names: Set[str] = set()
+        for kwname, store in (("static_argnums", nums),
+                              ("static_argnames", names)):
+            v = _kwarg(call, kwname)
+            if v is None:
+                continue
+            try:
+                lit = ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                continue
+            if isinstance(lit, (int, str)):
+                lit = (lit,)
+            store.update(lit)
+        return (nums, names) if (nums or names) else None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _is_jit_call(node.value):
+            spec = spec_from(node.value)
+            if spec:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = spec
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                        _is_jit_call(dec)
+                        or (dotted_name(dec.func).rsplit(".", 1)[-1]
+                            == "partial" and dec.args
+                            and isinstance(dec.args[0], (ast.Name,
+                                                         ast.Attribute))
+                            and _is_jit_call(ast.Call(
+                                func=dec.args[0], args=[],
+                                keywords=[])))):
+                    spec = spec_from(dec)
+                    if spec:
+                        out[node.name] = spec
+    return out
+
+
+def _unhashable_literal(node) -> bool:
+    return isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp, ast.Lambda))
+
+
+def _lambda_free_names(lam: ast.Lambda) -> Set[str]:
+    bound = {a.arg for a in (list(lam.args.posonlyargs)
+                             + list(lam.args.args)
+                             + list(lam.args.kwonlyargs))}
+    if lam.args.vararg:
+        bound.add(lam.args.vararg.arg)
+    if lam.args.kwarg:
+        bound.add(lam.args.kwarg.arg)
+    free: Set[str] = set()
+    for node in ast.walk(lam.body):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and node.id not in bound:
+            free.add(node.id)
+    return free
+
+
+# ================================================================ hostsync
+def _sync_classifier(call: ast.Call, rel: str) -> Optional[Site]:
+    name = dotted_name(call.func)
+    last = name.rsplit(".", 1)[-1]
+    if last == "device_get":
+        return Site(rel, call.lineno, "device_get", True, name)
+    if last == "block_until_ready":
+        return Site(rel, call.lineno, "block_until_ready", True, name)
+    if last == "print" and "debug" not in name:
+        return Site(rel, call.lineno, "print", True, name)
+    return None
+
+
+def check_hostsync(cfg: JaxlintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    graph = CallGraph(classifier=_sync_classifier)
+    for sf in _load_scope(cfg):
+        graph.add_file(sf, sf.path.stem)
+    graph.resolve()
+    graph.fixed_point()
+
+    seen: Set[Tuple[str, int]] = set()
+    for qual, decl_line in sorted(cfg.step_paths.items()):
+        fn = graph.funcs.get(qual)
+        if fn is None:
+            findings.append(Finding(
+                cfg.decl_rel, decl_line, "step-path-stale",
+                f"STEP_PATHS entry {qual!r} does not resolve to a "
+                f"function in the jaxlint scope (stale declaration?)"))
+            continue
+        for site in sorted(fn.reach, key=lambda s: (s.path, s.line)):
+            if (site.path, site.line) in seen:
+                continue
+            seen.add((site.path, site.line))
+            findings.append(Finding(
+                site.path, site.line, "host-sync",
+                f"step path {qual} reaches a host sync "
+                f"({site.bclass}: {site.desc}) — steady-state step "
+                f"code must stay on device; chain: "
+                f"{graph.chain(fn, site)}"))
+    return findings
+
+
+# ================================================================ meshaxes
+def check_meshaxes(cfg: JaxlintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    live_rules: Set[str] = set()
+
+    def check_axis_literal(node, sf, what):
+        vals = []
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            vals = [node.value]
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            vals = [e.value for e in node.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+        elif isinstance(node, ast.Call) and \
+                dotted_name(node.func).rsplit(".", 1)[-1] == \
+                "frozenset" and node.args:
+            check_axis_literal(node.args[0], sf, what)
+            return
+        for v in vals:
+            if v not in cfg.axes:
+                findings.append(Finding(
+                    sf.rel, node.lineno, "mesh-axis-unknown",
+                    f"{what} names axis {v!r}, which is not in "
+                    f"parallel/mesh.py AXES {sorted(cfg.axes)}"))
+
+    for sf in _load_scope(cfg):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            last = name.rsplit(".", 1)[-1]
+            # ---- collectives: literal axis names must exist --------
+            if last in _COLLECTIVES:
+                axis = _kwarg(node, "axis_name")
+                if axis is None:
+                    axis = _kwarg(node, "axis")
+                if axis is None:
+                    idx = _COLLECTIVES[last]
+                    if len(node.args) > idx:
+                        axis = node.args[idx]
+                if axis is not None:
+                    check_axis_literal(axis, sf, f"{last}()")
+                if last == "ppermute":
+                    perm = _kwarg(node, "perm")
+                    if perm is None and len(node.args) > 2:
+                        perm = node.args[2]
+                    if perm is not None:
+                        findings.extend(_check_perm(perm, sf))
+            # ---- axis_name=/axis_names= kwargs anywhere ------------
+            elif last in ("shard_map", "ring_attention",
+                          "ring_attention_sharded", "ulysses_attention",
+                          "ring_scan"):
+                for kwname in ("axis_name", "axis_names", "axis"):
+                    v = _kwarg(node, kwname)
+                    if v is not None:
+                        check_axis_literal(v, sf, f"{last}({kwname}=)")
+            # ---- PartitionSpec literals ----------------------------
+            elif last in ("P", "PartitionSpec", "NamedSharding"):
+                for a in node.args:
+                    check_axis_literal(a, sf, f"{last}()")
+            # ---- activation rules ----------------------------------
+            if last in ("activation_spec", "constrain"):
+                for a in node.args:
+                    if isinstance(a, ast.Constant) and \
+                            isinstance(a.value, str):
+                        if a.value not in cfg.activation_rules:
+                            findings.append(Finding(
+                                sf.rel, a.lineno,
+                                "mesh-activation-undeclared",
+                                f"{last}() names activation rule "
+                                f"{a.value!r}, not declared in "
+                                f"mesh.ACTIVATION_RULES"))
+                        else:
+                            live_rules.add(a.value)
+
+    for rule, decl_line in sorted(cfg.activation_rules.items()):
+        if rule not in live_rules:
+            findings.append(Finding(
+                cfg.mesh_rel, decl_line, "mesh-activation-dead",
+                f"ACTIVATION_RULES declares {rule!r} but no "
+                f"activation_spec()/constrain() use names it — dead "
+                f"placement rules drift silently; use it or delete "
+                f"it"))
+    return findings
+
+
+def _check_perm(perm, sf: SourceFile) -> List[Finding]:
+    """Validate a ppermute perm: literal pair lists must be true
+    permutations; `[(d, (d ± k) % N) for d in range(N)]` rotations are
+    proven by shape; anything else is left to the runtime."""
+    out: List[Finding] = []
+    if isinstance(perm, ast.List):
+        try:
+            pairs = ast.literal_eval(perm)
+        except (ValueError, SyntaxError):
+            return out
+        if not all(isinstance(p, tuple) and len(p) == 2 for p in pairs):
+            return out
+        srcs = [p[0] for p in pairs]
+        dsts = [p[1] for p in pairs]
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            out.append(Finding(
+                sf.rel, perm.lineno, "mesh-ppermute-perm",
+                f"ppermute perm {pairs} repeats a source or "
+                f"destination — not a permutation"))
+        elif set(srcs) != set(dsts):
+            out.append(Finding(
+                sf.rel, perm.lineno, "mesh-ppermute-perm",
+                f"ppermute perm {pairs} is not a true permutation of "
+                f"the axis (sources {sorted(set(srcs))} != "
+                f"destinations {sorted(set(dsts))}) — rings must "
+                f"wrap"))
+        return out
+    if isinstance(perm, ast.ListComp):
+        comp = perm.generators[0] if perm.generators else None
+        elt = perm.elt
+        ok = (comp is not None
+              and isinstance(comp.target, ast.Name)
+              and isinstance(comp.iter, ast.Call)
+              and dotted_name(comp.iter.func).rsplit(".", 1)[-1]
+              == "range"
+              and len(comp.iter.args) == 1
+              and isinstance(elt, ast.Tuple) and len(elt.elts) == 2)
+        if not ok:
+            return out
+        d = comp.target.id
+        n_expr = ast.dump(comp.iter.args[0])
+        src, dst = elt.elts
+        # accept (d, (d ± k) % N) and ((d ± k) % N, d) with the SAME N
+        def is_rot(node) -> bool:
+            return (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Mod)
+                    and isinstance(node.left, ast.BinOp)
+                    and isinstance(node.left.op, (ast.Add, ast.Sub))
+                    and isinstance(node.left.left, ast.Name)
+                    and node.left.left.id == d
+                    and ast.dump(node.right) == n_expr)
+
+        def is_d(node) -> bool:
+            return isinstance(node, ast.Name) and node.id == d
+
+        if not ((is_d(src) and is_rot(dst))
+                or (is_rot(src) and is_d(dst))):
+            out.append(Finding(
+                sf.rel, perm.lineno, "mesh-ppermute-perm",
+                "ppermute perm comprehension is not a provable "
+                "rotation `[(d, (d ± k) % N) for d in range(N)]` — "
+                "make the wrap-around explicit or use a literal "
+                "permutation"))
+    return out
+
+
+# ================================================================= drivers
+def default_check_donation(root: Path) -> List[Finding]:
+    return check_donation(default_config(root))
+
+
+def default_check_retrace(root: Path) -> List[Finding]:
+    return check_retrace(default_config(root))
+
+
+def default_check_hostsync(root: Path) -> List[Finding]:
+    return check_hostsync(default_config(root))
+
+
+def default_check_meshaxes(root: Path) -> List[Finding]:
+    return check_meshaxes(default_config(root))

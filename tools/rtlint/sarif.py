@@ -18,6 +18,38 @@ from tools.rtlint import Finding
 _SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
            "master/Schemata/sarif-schema-2.1.0.json")
 
+# pass -> DESIGN.md anchor documenting its rule family (helpUri, so a
+# PR annotation links straight to the contract prose)
+_PASS_ANCHORS: Dict[str, str] = {
+    "locks": "4d-machine-enforced-invariants-rtlint--the-lock-watchdog",
+    "guarded": "4d-machine-enforced-invariants-rtlint--the-lock-watchdog",
+    "wire": "4d-machine-enforced-invariants-rtlint--the-lock-watchdog",
+    "threads": "4d-machine-enforced-invariants-rtlint--the-lock-watchdog",
+    "metrics": "4b-metrics-plane-in-process-registries-kv-transport-no-agent",
+    "resources": ("4f-resource-ownership--reply-discipline-rtlint-v2--"
+                  "the-leak-sanitizer"),
+    "replies": ("4f-resource-ownership--reply-discipline-rtlint-v2--"
+                "the-leak-sanitizer"),
+    "blocking": ("4p-rtlint-v3-interprocedural-blocking-flow--"
+                 "session-fsm-conformance"),
+    "protostate": ("4p-rtlint-v3-interprocedural-blocking-flow--"
+                   "session-fsm-conformance"),
+    "donation": ("4q-rtlint-v4-compute-plane-jaxlint--the-xla-hygiene-"
+                 "oracle"),
+    "retrace": ("4q-rtlint-v4-compute-plane-jaxlint--the-xla-hygiene-"
+                "oracle"),
+    "hostsync": ("4q-rtlint-v4-compute-plane-jaxlint--the-xla-hygiene-"
+                 "oracle"),
+    "meshaxes": ("4q-rtlint-v4-compute-plane-jaxlint--the-xla-hygiene-"
+                 "oracle"),
+}
+
+
+def help_uri(pname: str) -> str:
+    anchor = _PASS_ANCHORS.get(
+        pname, "4d-machine-enforced-invariants-rtlint--the-lock-watchdog")
+    return f"DESIGN.md#{anchor}"
+
 
 def to_sarif(findings: List[Finding],
              rules: Dict[str, List]) -> dict:
@@ -32,6 +64,7 @@ def to_sarif(findings: List[Finding],
             rule_objs.append({
                 "id": rule,
                 "shortDescription": {"text": contract},
+                "helpUri": help_uri(pname),
                 "properties": {"pass": pname},
             })
     results = []
